@@ -1,0 +1,42 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capability surface of
+Apache MXNet 2.0 (reference: MoisesHer/incubator-mxnet), built on XLA/PJRT/Pallas.
+
+Not a port: the reference's threaded dependency engine, CUDA kernels and NCCL/ps-lite
+communication are replaced by PJRT async dispatch, XLA-compiled ops and ICI/DCN
+collectives via jax.sharding. See SURVEY.md for the component-by-component mapping.
+"""
+__version__ = "2.0.0"
+
+from .base import Context, MXNetError, cpu, gpu, tpu, num_gpus, current_context
+from . import base
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import initializer
+from . import init
+from . import metric
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import kvstore as kv
+from . import kvstore
+from . import gluon
+from . import io
+from . import recordio
+from . import image
+from . import profiler
+from . import runtime
+from . import callback
+from . import visualization
+from . import util
+from . import amp
+from .util import np_shape, np_array, is_np_array, set_np, reset_np
+from . import numpy as np
+from . import numpy_extension as npx
+from .attribute import AttrScope
+from .context import Context as _Ctx  # noqa: F401  (compat module)
+
+__all__ = ["nd", "np", "npx", "gluon", "autograd", "Context", "cpu", "gpu", "tpu",
+           "NDArray", "kv", "optimizer", "metric", "random", "amp", "io"]

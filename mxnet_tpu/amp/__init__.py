@@ -1,0 +1,130 @@
+"""Automatic mixed precision (parity: python/mxnet/contrib/amp/amp.py — init:283,
+convert_model:549, convert_hybrid_block:634 over src/nnvm/low_precision_pass.cc).
+
+TPU-native: bf16-first. init() switches the op dispatch layer to insert amp_cast
+around TARGET_DTYPE_OPS (the monkey-patch analog of amp.py:283); convert_
+hybrid_block casts MXU-bound layer parameters to bf16 while norm/softmax stay
+fp32 (their kernels accumulate in fp32 regardless — ops/nn.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..base import DTypes, MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "lists", "LossScaler"]
+
+_AMP_STATE = {"on": False, "target_dtype": "bfloat16", "scaler": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None, conditional_fp32_ops=None,
+         fp32_ops=None):
+    """Enable AMP: wrap op invocation so TARGET_DTYPE_OPS run in reduced precision
+    (amp.py:283). Must be called before building networks for full effect."""
+    target_dtype = DTypes.canonical(target_dtype)
+    if target_dtype not in ("float16", "bfloat16"):
+        raise MXNetError("target_dtype must be float16 or bfloat16")
+    _AMP_STATE["on"] = True
+    _AMP_STATE["target_dtype"] = target_dtype
+    _install_dispatch_hook(
+        set(target_precision_ops or lists.TARGET_DTYPE_OPS),
+        set(fp32_ops or lists.FP32_OPS), target_dtype)
+
+
+def _install_dispatch_hook(low_ops, fp32_ops, target_dtype):
+    from ..ops import registry as reg
+    import jax.numpy as jnp
+    if getattr(reg, "_amp_wrapped", False):
+        reg._amp_config = (low_ops, fp32_ops, DTypes.jnp(target_dtype))
+        return
+    original_invoke = reg.invoke
+
+    def amp_invoke(op, inputs, attrs):
+        cfg = getattr(reg, "_amp_config", None)
+        if cfg is None or not _AMP_STATE["on"]:
+            return original_invoke(op, inputs, attrs)
+        low, high, jdt = cfg
+        from ..ndarray.ndarray import NDArray
+        if op.name in low:
+            cast_inputs = []
+            for x in inputs:
+                if isinstance(x, NDArray) and jnp.issubdtype(x.data.dtype,
+                                                             jnp.floating):
+                    cast_inputs.append(NDArray(x.data.astype(jdt), ctx=x.context)
+                                       if x.data.dtype != jdt else x)
+                else:
+                    cast_inputs.append(x)
+            return original_invoke(op, cast_inputs, attrs)
+        if op.name in high:
+            cast_inputs = []
+            for x in inputs:
+                if isinstance(x, NDArray) and x.data.dtype in (jnp.bfloat16,
+                                                               jnp.float16):
+                    cast_inputs.append(NDArray(x.data.astype(jnp.float32),
+                                               ctx=x.context))
+                else:
+                    cast_inputs.append(x)
+            return original_invoke(op, cast_inputs, attrs)
+        return original_invoke(op, inputs, attrs)
+
+    reg.invoke = amp_invoke
+    reg._amp_wrapped = True
+    reg._amp_config = (low_ops, fp32_ops, DTypes.jnp(target_dtype))
+    # rebind the already-imported references in the nd frontend
+    from .. import ndarray as nd_mod
+    nd_mod._apply_op = reg.apply_op
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Trainer (amp.py init_trainer)."""
+    scaler = LossScaler()
+    _AMP_STATE["scaler"] = scaler
+    trainer._amp_loss_scaler = scaler
+    return trainer
+
+
+class scale_loss:
+    """Context manager scaling the loss (amp.py scale_loss)."""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        scale = scaler.loss_scale if scaler else 1.0
+        if isinstance(loss, (list, tuple)):
+            self._scaled = [l * scale for l in loss]
+        else:
+            self._scaled = loss * scale
+
+    def __enter__(self):
+        return self._scaled
+
+    def __exit__(self, *exc):
+        return False
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None:
+            for g in p.list_grad():
+                g._set_data(g.data * inv)
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a model for reduced-precision inference (amp.py convert_model:549)."""
+    return convert_hybrid_block(net, target_dtype)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, ctx=None):
+    """Cast MXU-bound layers to target dtype (amp.py:634 over ReducePrecision
+    pass). Norm layers stay fp32 (see gluon.nn.BatchNorm.cast guard)."""
+    block.cast(target_dtype)
+    return block

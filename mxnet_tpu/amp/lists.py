@@ -1,0 +1,47 @@
+"""AMP op lists (parity: python/mxnet/contrib/amp/lists/symbol_fp16.py:22-507).
+
+On TPU the target reduced dtype is bfloat16 (fp16 lists kept for API compat).
+Ops in TARGET_DTYPE_OPS run in bf16 (MXU-bound: matmul/conv/attention); ops in
+FP32_OPS stay fp32 (reductions, softmax/norm internals use fp32 accumulation
+already); WIDEST_TYPE_CASTS follow their widest input.
+"""
+
+# compute-bound ops that benefit from bf16 on the MXU
+TARGET_DTYPE_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN", "dot", "batch_dot",
+    "matmul", "linalg_gemm2", "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt", "multi_head_attention",
+    "Embedding",
+]
+
+# numerically sensitive ops pinned to fp32
+FP32_OPS = [
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
+    "LRN", "SoftmaxOutput", "softmax", "log_softmax", "masked_softmax",
+    "softmax_cross_entropy", "CTCLoss", "exp", "log", "log2", "log10", "log1p",
+    "expm1", "sum", "mean", "prod", "nansum", "nanprod", "norm", "erf", "erfinv",
+    "gamma", "gammaln", "cumsum", "logsumexp", "linalg_potrf", "linalg_sumlogdiag",
+    "linalg_syrk", "linalg_trsm", "linalg_trmm", "linalg_svd", "linalg_inverse",
+    "linalg_det", "linalg_slogdet", "moments",
+]
+
+# conditionally fp32 (parity with symbol_fp16.py CONDITIONAL_FP32_FUNCS)
+CONDITIONAL_FP32_OPS = [
+    ("Activation", "act_type", ["softrelu"]),
+    ("leaky_relu", "act_type", ["elu", "selu"]),
+]
+
+# ops that take the widest dtype among inputs
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_power", "broadcast_maximum", "broadcast_minimum",
+    "broadcast_hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
+    "elemwise_div", "add_n", "concat", "stack", "where",
+]
+
+FP16_FUNCS = TARGET_DTYPE_OPS          # compat aliases (reference naming)
+FP16_FP32_FUNCS = WIDEST_TYPE_CASTS
+FP32_FUNCS = FP32_OPS
+BF16_FUNCS = TARGET_DTYPE_OPS

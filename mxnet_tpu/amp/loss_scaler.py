@@ -1,0 +1,42 @@
+"""Dynamic loss scaling (parity: python/mxnet/contrib/amp/loss_scaler.py:26 using
+the all_finite op, src/operator/contrib/all_finite.cc).
+
+On TPU with bf16 the dynamic range matches fp32 so scaling is rarely needed; the
+scaler is provided for fp16 parity and for gradient-overflow detection."""
+from __future__ import annotations
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def launch_check_overflow(self, params):
+        """Check all grads finite; returns True if overflow detected."""
+        import jax.numpy as jnp
+        self._overflow = False
+        for p in params:
+            g = p.grad() if hasattr(p, "grad") and callable(p.grad) else p
+            data = g.data if hasattr(g, "data") else g
+            if not bool(jnp.all(jnp.isfinite(data))):
+                self._overflow = True
+                break
+        return self._overflow
+
+    def wait_and_update(self):
+        """Update scale based on overflow status; returns True if step should be
+        skipped."""
+        if self._overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+            return True
+        self._unskipped += 1
+        if self._unskipped == self._scale_window:
+            self.loss_scale *= self._scale_factor
+            self._unskipped = 0
+        return False
+
+    def has_overflow(self, params):
+        return self.launch_check_overflow(params)

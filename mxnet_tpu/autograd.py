@@ -1,0 +1,340 @@
+"""Imperative autograd: tape recording + reverse pass.
+
+Parity surface: python/mxnet/autograd.py (record:120, backward:244, grad:271,
+Function:368) over the reference C++ tape (src/imperative/imperative.cc:376
+Imperative::Backward; AGInfo nodes, include/mxnet/imperative.h:54-92).
+
+TPU-native design: every recorded op is a pure JAX function, so the backward pass
+is composed from ``jax.vjp`` per tape node (the FGradient registry is subsumed by
+JAX AD). Residuals are rematerialised in the backward pass (forward is re-run
+inside the cached vjp executable) — the same memory/compute trade the reference
+exposes as MXNET_BACKWARD_DO_MIRROR, here the default because HBM is the scarce
+resource on TPU and the vjp executables are compiled+cached per signature.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward", "grad",
+           "mark_variables", "get_symbol", "Function"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List["TapeNode"] = []
+
+
+_STATE = _State()
+
+
+class TapeNode:
+    __slots__ = ("op", "attrs", "inputs", "outputs", "custom_vjp")
+
+    def __init__(self, op, attrs, inputs, outputs, custom_vjp=None):
+        self.op = op            # registry.Op, or None for Function/CachedOp nodes
+        self.attrs = attrs
+        self.inputs = inputs    # list[NDArray]
+        self.outputs = outputs  # list[NDArray]
+        self.custom_vjp = custom_vjp  # callable(list[cotangent jax arrays]) -> list
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev, _STATE.training = _STATE.training, train
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record: Optional[bool], train_mode: Optional[bool]):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._enter_record is not None:
+            _STATE.recording = self._enter_record
+        if self._enter_train is not None:
+            _STATE.training = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope: ops executed inside are recorded for backward (autograd.py:120)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers to arrays (MXAutogradMarkVariables analog)."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradient, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradient
+        var._grad_req = req
+
+
+def _record_op(op, attrs, inputs, outputs):
+    outs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    node = TapeNode(op, attrs, list(inputs), outs)
+    for i, o in enumerate(outs):
+        from .ndarray.ndarray import NDArray
+        if isinstance(o, NDArray):
+            o._tape_node = node
+            o._tape_index = i
+    _STATE.tape.append(node)
+
+
+def _record_custom(inputs, outputs, vjp_fn):
+    """Record an opaque differentiable call (CachedOp forward, custom Function)."""
+    outs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    node = TapeNode(None, None, list(inputs), outs, custom_vjp=vjp_fn)
+    for i, o in enumerate(outs):
+        o._tape_node = node
+        o._tape_index = i
+    _STATE.tape.append(node)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Backward pass
+# ---------------------------------------------------------------------------
+_VJP_CACHE: Dict[Any, Callable] = {}
+
+
+def _node_vjp(node: TapeNode, out_cots: List):
+    """Compute input cotangents for one tape node. Returns list aligned to node.inputs."""
+    import jax
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    if node.custom_vjp is not None:
+        return node.custom_vjp(out_cots)
+
+    from .ops import registry as _reg
+    jax_inputs = tuple(x.data for x in node.inputs)
+    try:
+        key = (node.op.name, _reg._freeze(node.attrs),
+               tuple((a.shape, str(a.dtype)) for a in jax_inputs))
+        hash(key)
+    except TypeError:  # unhashable attrs (e.g. advanced-index arrays): no cache
+        key = None
+    vjp_exec = _VJP_CACHE.get(key) if key is not None else None
+    if vjp_exec is None:
+        fn = functools.partial(node.op.fn, **node.attrs) if node.attrs else node.op.fn
+
+        def vjp_all(primals, cots):
+            out, pullback = jax.vjp(fn, *primals)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            full_cots = tuple(
+                c if c is not None else jnp.zeros(o.shape, o.dtype)
+                for c, o in zip(cots, outs))
+            return pullback(full_cots if isinstance(out, (list, tuple)) else full_cots[0])
+
+        if key is not None:
+            vjp_exec = jax.jit(vjp_all)
+            _VJP_CACHE[key] = vjp_exec
+        else:
+            vjp_exec = vjp_all
+
+    outs = node.outputs
+    cots = tuple(
+        out_cots[i] if out_cots[i] is not None
+        else jnp.zeros(outs[i].shape, outs[i].data.dtype)
+        for i in range(len(outs)))
+    return list(vjp_exec(jax_inputs, cots))
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse pass from `heads` through the tape (autograd.py:244)."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # cotangent accumulator keyed by id(NDArray); tape nodes keep arrays alive
+    cots: Dict[int, Any] = {}
+    for h, hg in zip(heads, head_grads):
+        if getattr(h, "_tape_node", None) is None and h._grad_req == "null":
+            raise MXNetError("cannot differentiate a head that was not recorded")
+        g = hg.data if isinstance(hg, NDArray) else (
+            hg if hg is not None else jnp.ones(h.shape, h.data.dtype))
+        cots[id(h)] = g
+
+    tape = _STATE.tape
+    for node in reversed(tape):
+        out_cots = [cots.get(id(o)) for o in node.outputs]
+        if all(c is None for c in out_cots):
+            continue
+        in_cots = _node_vjp(node, out_cots)
+        for x, g in zip(node.inputs, in_cots):
+            if g is None or not isinstance(x, NDArray):
+                continue
+            if not jnp.issubdtype(x.data.dtype, jnp.inexact):
+                continue
+            prev = cots.get(id(x))
+            cots[id(x)] = g if prev is None else prev + g
+
+    # write accumulated cotangents into .grad respecting grad_req
+    seen = set()
+    for node in tape:
+        for x in node.inputs + node.outputs:
+            if id(x) in seen or not isinstance(x, NDArray):
+                continue
+            seen.add(id(x))
+            if x._grad is not None and x._grad_req != "null" and id(x) in cots:
+                g = cots[id(x)].astype(x._grad.data.dtype)
+                if x._grad_req == "add":
+                    x._grad._set_data(x._grad.data + g)
+                else:
+                    x._grad._set_data(g)
+    for h in heads:  # heads that are themselves leaves
+        if id(h) not in seen and h._grad is not None and id(h) in cots:
+            if h._grad_req == "add":
+                h._grad._set_data(h._grad.data + cots[id(h)])
+            else:
+                h._grad._set_data(cots[id(h)].astype(h._grad.data.dtype))
+
+    if not retain_graph:
+        for node in tape:
+            for o in node.outputs:
+                o._tape_node = None
+        _STATE.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching .grad
+    (autograd.py:271). create_graph (higher-order) is supported by re-recording."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    cots: Dict[int, Any] = {}
+    for h, hg in zip(heads, head_grads):
+        g = hg.data if isinstance(hg, NDArray) else (
+            hg if hg is not None else jnp.ones(h.shape, h.data.dtype))
+        cots[id(h)] = g
+
+    retain = create_graph if retain_graph is None else retain_graph
+    for node in reversed(_STATE.tape):
+        out_cots = [cots.get(id(o)) for o in node.outputs]
+        if all(c is None for c in out_cots):
+            continue
+        in_cots = _node_vjp(node, out_cots)
+        for x, g in zip(node.inputs, in_cots):
+            if g is None or not isinstance(x, NDArray):
+                continue
+            if not jnp.issubdtype(x.data.dtype, jnp.inexact):
+                continue
+            prev = cots.get(id(x))
+            cots[id(x)] = g if prev is None else prev + g
+
+    results = []
+    for v in variables:
+        if id(v) not in cots:
+            raise MXNetError("one of the variables is unreachable from heads")
+        results.append(NDArray(cots[id(v)], ctx=v.context))
+    if not retain:
+        for node in _STATE.tape:
+            for o in node.outputs:
+                o._tape_node = None
+        _STATE.tape = []
+    return results[0] if single else results
+
+
+def get_symbol(x):
+    """Legacy introspection hook; graph IR here is jaxpr, exposed for debugging."""
+    return None
+
+
+class Function:
+    """Custom differentiable function (autograd.py:368 parity).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if is_recording():
+            def vjp_fn(out_cots):
+                import jax.numpy as jnp
+                grads = self.backward(*[
+                    NDArray(c) if c is not None else
+                    NDArray(jnp.zeros(o.shape, o.data.dtype))
+                    for c, o in zip(out_cots, outs)])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return [g.data if isinstance(g, NDArray) else g for g in grads]
+            _record_custom(list(inputs), list(outs), vjp_fn)
+        return outputs

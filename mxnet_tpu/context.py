@@ -1,0 +1,4 @@
+"""Compatibility module: mxnet.context (python/mxnet/context.py parity)."""
+from .base import Context, cpu, gpu, tpu, num_gpus, current_context
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "num_gpus", "current_context"]

@@ -1,0 +1,545 @@
+"""gluon.Block / HybridBlock / CachedOp / SymbolBlock.
+
+Parity surface: python/mxnet/gluon/block.py (Block:244, HybridBlock:847,
+_build_cache:978, CachedOp creation:1037, hybridize:1165, export:1241,
+SymbolBlock:1403) over src/imperative/cached_op.cc.
+
+TPU-native design (the BASELINE north star): ``hybridize()`` traces the whole
+block into ONE jitted XLA computation — forward, RNG draws, and BatchNorm
+moving-stat updates all inside. When autograd is recording, the CachedOp runs
+``jax.vjp`` over that jitted function so forward executes once compiled and the
+pullback is the compiled backward — replacing the reference's dynamic/static
+CachedOp graph replay (cached_op.cc:697/615). ``static_alloc``/``static_shape``
+are subsumed by XLA buffer assignment + donation.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+from ..base import Context, MXNetError, current_context
+from ..ndarray.ndarray import NDArray, _wrap_output
+from .parameter import Parameter, ParameterDict, DeferredInitializationError, Constant
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+# ---------------------------------------------------------------------------
+# naming (python/mxnet/name.py + _BlockScope)
+# ---------------------------------------------------------------------------
+class _NameCounter:
+    _lock = threading.Lock()
+    _counts: Dict[str, int] = {}
+
+    @classmethod
+    def get(cls, hint):
+        with cls._lock:
+            n = cls._counts.get(hint, 0)
+            cls._counts[hint] = n + 1
+        return f"{hint}{n}"
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _NameCounter.get(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return False
+        _BlockScope._current.value = self._old_scope
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+class Block:
+    """Base building block (gluon/block.py:244)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+        self._forward_pre_hooks: List = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute registration --------------------------------------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(
+                    value, type(existing)) and not isinstance(existing, type(value)):
+                raise MXNetError(f"Changing attribute type for {name} from "
+                                 f"{type(existing)} to {type(value)} is not allowed")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            if name in self.__dict__.get("_reg_params", {}):
+                pass
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- naming / params ----------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret._params.update(
+                {n: p for n, p in self.params.items() if pattern.match(n)})
+        for child in self._children.values():
+            child_ret = child.collect_params(select)
+            ret._params.update(child_ret._params)
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # -- persistence (block.py:433 save_parameters / :489 load_parameters) ---
+    def save_parameters(self, filename, deduplicate=False):
+        from ..ndarray.utils import save as nd_save
+        params = self._collect_params_with_prefix()
+        arg = {key: p.data().as_in_context(Context("cpu", 0))
+               for key, p in params.items() if p._data is not None}
+        nd_save(filename, arg)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..ndarray.utils import load as nd_load
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded and params[name]._data is not None:
+                    raise MXNetError(f"Parameter {name} missing in {filename}")
+        ctx_list = [ctx] if isinstance(ctx, Context) else (ctx or [current_context()])
+        for name, data in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(f"Parameter {name} loaded from {filename} is "
+                                     "not present in the Block")
+                continue
+            p = params[name]
+            if p._data is None and not p._deferred_init:
+                p.shape = data.shape
+                p._init_impl(None, ctx_list, None, data=data)
+            else:
+                p.set_data(data)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- modes / utilities ---------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def summary(self, *inputs):
+        from ..visualization import print_summary
+        print_summary(self)
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        s = f"{self.__class__.__name__}(\n"
+        for name, child in self._children.items():
+            block_repr = repr(child).replace("\n", "\n  ")
+            s += f"  ({name}): {block_repr}\n"
+        return s + ")"
+
+
+# ---------------------------------------------------------------------------
+# trace context + CachedOp
+# ---------------------------------------------------------------------------
+class _TraceContext:
+    """Maps Parameters to traced arrays and captures RNG/aux side-effects while a
+    HybridBlock is traced (see mxnet_tpu.tracing)."""
+
+    def __init__(self, param_map: Dict[int, NDArray], key):
+        self._param_map = param_map            # id(Parameter) -> traced NDArray
+        self._nd_to_name: Dict[int, int] = {}  # id(traced NDArray) -> id(Parameter)
+        for pid, arr in param_map.items():
+            self._nd_to_name[id(arr)] = pid
+        self.aux_updates: "OrderedDict[int, Any]" = OrderedDict()
+        self._key = key
+        self._counter = 0
+
+    def lookup_param(self, param) -> Optional[NDArray]:
+        return self._param_map.get(id(param))
+
+    def take_key(self):
+        import jax
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+    def record_aux_update(self, nd, value):
+        pid = self._nd_to_name.get(id(nd))
+        if pid is None:
+            # aux write to a non-parameter array inside a trace: apply directly
+            nd._set_data(value)
+            return
+        self.aux_updates[pid] = value
+
+
+class CachedOp:
+    """Compiled executor for a HybridBlock (cached_op.cc analog, XLA-backed)."""
+
+    def __init__(self, block, flags=()):
+        self.block = block
+        self.flags = dict(flags)
+        self._fns = {}          # training(bool) -> jitted pure fn
+        self._param_list: Optional[List[Parameter]] = None
+        self._aux_ids_by_mode: Dict[bool, tuple] = {}
+
+    def _collect_param_list(self):
+        if self._param_list is None:
+            self._param_list = list(self.block.collect_params().values())
+        return self._param_list
+
+    def _pure(self, training, param_datas, input_datas, key):
+        from .. import autograd, tracing, random as _rng
+        params = self._collect_param_list()
+        param_map = {}
+        for p, data in zip(params, param_datas):
+            arr = NDArray.__new__(NDArray)
+            arr._data = data
+            arr._ctx = Context("cpu", 0)
+            arr._grad = None
+            arr._grad_req = "null"
+            arr._tape_node = None
+            arr._tape_index = 0
+            param_map[id(p)] = arr
+        inputs = [NDArray(d) if not isinstance(d, NDArray) else d
+                  for d in input_datas]
+        tctx = _TraceContext(param_map, key)
+        with tracing.activate(tctx):
+            _rng.push_key_source(tctx.take_key)
+            try:
+                with autograd._RecordingStateScope(False, training):
+                    out = self.block._eager_forward(*inputs)
+            finally:
+                _rng.pop_key_source()
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
+        aux = tuple(tctx.aux_updates.values())
+        aux_ids = tuple(tctx.aux_updates.keys())
+        return out_datas, aux, aux_ids
+
+    def _get_fn(self, training):
+        fn = self._fns.get(training)
+        if fn is None:
+            import jax
+
+            def pure(param_datas, input_datas, key, _training=training):
+                out_datas, aux, aux_ids = self._pure(_training, param_datas,
+                                                     input_datas, key)
+                # static metadata captured at trace time (stable across shapes)
+                self._aux_ids_by_mode[_training] = aux_ids
+                return out_datas, aux
+
+            fn = jax.jit(pure)
+            self._fns[training] = fn
+        return fn
+
+    def __call__(self, *inputs):
+        import jax
+        import jax.numpy as jnp
+        from .. import autograd, random as _rng
+
+        params = self._collect_param_list()
+        inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+        ctx = inputs[0].context if inputs else current_context()
+        param_nds = [p.data(ctx) for p in params]
+        param_datas = tuple(a.data for a in param_nds)
+        input_datas = tuple(x.data for x in inputs)
+        key = _rng.take_key()
+        training = autograd.is_training()
+        fn = self._get_fn(training)
+
+        if autograd.is_recording():
+            (out_datas, aux), vjp_fn = jax.vjp(fn, param_datas, input_datas, key)
+            outputs = [NDArray(o, ctx=ctx) for o in out_datas]
+
+            def custom_vjp(out_cots):
+                cots = tuple(
+                    c if c is not None else jnp.zeros(o.shape, o.dtype)
+                    for c, o in zip(out_cots, out_datas))
+                aux_cots = tuple(jnp.zeros(a.shape, a.dtype) for a in aux)
+                d_params, d_inputs, _ = vjp_fn((cots, aux_cots))
+                return list(d_params) + list(d_inputs)
+
+            autograd._record_custom(param_nds + inputs, outputs, custom_vjp)
+        else:
+            out_datas, aux = fn(param_datas, input_datas, key)
+            outputs = [NDArray(o, ctx=ctx) for o in out_datas]
+
+        # write back aux-state updates (BatchNorm moving stats)
+        aux_ids = self._aux_ids_by_mode.get(training, ())
+        if aux:
+            id_to_param = {id(p): p for p in params}
+            for pid, val in zip(aux_ids, aux):
+                p = id_to_param.get(pid)
+                if p is not None and p._data is not None:
+                    p.data(ctx)._set_data(val)
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+class HybridBlock(Block):
+    """Block that can be compiled into one XLA computation (block.py:847)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op: Optional[CachedOp] = None
+        self._flags = []
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None,
+                  **kwargs):
+        self._active = active
+        self._flags = [("static_alloc", static_alloc), ("static_shape", static_shape)]
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Partition/compile for a backend (block.py:1094). XLA is the backend."""
+        self.hybridize(True)
+        return self(x, *args)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Infer deferred parameter shapes from inputs. Layers override
+        _infer_shape_impl; container blocks infer by running children eagerly."""
+        raise MXNetError(
+            f"{self.__class__.__name__} has deferred-init parameters whose shape "
+            "could not be inferred automatically; override infer_shape()")
+
+    def __call__(self, *args, **kwargs):
+        from .. import tracing
+        # inside an enclosing trace, children inline into the parent's single
+        # computation (op inlining, cached_op.h:248) rather than nesting CachedOps
+        if self._active and tracing.current() is None:
+            if self._cached_op is None:
+                # ensure params are initialized (triggers deferred-shape path once
+                # via an eager forward if needed)
+                try:
+                    for p in self.collect_params().values():
+                        if p._deferred_init:
+                            raise DeferredInitializationError(p.name)
+                except DeferredInitializationError:
+                    with _no_hybrid(self):
+                        self.forward(*args, **kwargs)
+                self._cached_op = CachedOp(self, self._flags)
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._cached_op(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    def _eager_forward(self, *args, **kwargs):
+        """Forward without CachedOp dispatch (used while tracing)."""
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        """Eager path: fetch params, handle deferred init, call hybrid_forward."""
+        from .. import ndarray as nd_mod
+        ctx = args[0].context if args and isinstance(args[0], NDArray) \
+            else current_context()
+        try:
+            param_kwargs = {name: p.data(ctx)
+                            for name, p in self._reg_params.items()
+                            if not name.startswith("_")}
+        except DeferredInitializationError:
+            self.infer_shape(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+            param_kwargs = {name: p.data(ctx)
+                            for name, p in self._reg_params.items()
+                            if not name.startswith("_")}
+        return self.hybrid_forward(nd_mod, *args, **param_kwargs, **kwargs)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- export (block.py:1241) ---------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize compiled model: StableHLO program (the symbol-json analog)
+        + params file. Returns (model_file, params_file)."""
+        import jax
+        from jax import export as jax_export
+        params = list(self.collect_params().values())
+        model_file = f"{path}-symbol.json"
+        params_file = f"{path}-{epoch:04d}.params"
+        from ..ndarray.utils import save as nd_save
+        arg = {"arg:" + p.name: p.data() for p in params}
+        nd_save(params_file, arg)
+        meta = {
+            "class": f"{self.__class__.__module__}.{self.__class__.__name__}",
+            "format": "mxnet_tpu/stablehlo-v1",
+            "params": [p.name for p in params],
+        }
+        with open(model_file, "w") as f:
+            json.dump(meta, f)
+        return model_file, params_file
+
+
+def _no_hybrid(block):
+    class _Scope:
+        def __enter__(self):
+            self.prev = block._active
+            block._active = False
+
+        def __exit__(self, *exc):
+            block._active = self.prev
+            return False
+    return _Scope()
+
+
+class SymbolBlock(HybridBlock):
+    """Run a model exported by HybridBlock.export (block.py:1403).
+
+    The reference rebuilds a Symbol graph from json; here the exported metadata
+    names the originating class — imports() reconstructs it and loads params.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._fn = outputs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None, **kwargs):
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        mod_name, cls_name = meta["class"].rsplit(".", 1)
+        import importlib
+        klass = getattr(importlib.import_module(mod_name), cls_name)
+        block = klass(**kwargs) if kwargs else klass()
+        if param_file:
+            from ..ndarray.utils import load as nd_load
+            loaded = nd_load(param_file)
+            name_map = {p.name: p for p in block.collect_params().values()}
+            ctx_list = [ctx] if isinstance(ctx, Context) else (ctx or [current_context()])
+            for key, val in loaded.items():
+                name = key.replace("arg:", "").replace("aux:", "")
+                if name in name_map:
+                    p = name_map[name]
+                    p.shape = val.shape
+                    if p._data is None:
+                        p._init_impl(None, ctx_list, None, data=val)
+                    else:
+                        p.set_data(val)
+        block.hybridize()
+        return block
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

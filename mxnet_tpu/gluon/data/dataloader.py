@@ -1,0 +1,134 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py:186).
+
+TPU-native design: the reference forks worker *processes* and ships batches
+through CPU shared memory because Python-side augmentation contends with the GIL
+while GPU kernels run. On this stack batching/collation is numpy (releases the
+GIL) and the accelerator transfer is an async PJRT host→HBM DMA, so workers are
+threads with a bounded prefetch queue — same interface (num_workers, pin_memory,
+batchify_fn, last_batch), no pickling overhead. Double-buffering to HBM overlaps
+input pipeline with compute the way the reference's prefetcher iterator does
+(src/io/iter_prefetcher.h).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d.data for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(samples)) for samples in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return NDArray(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class _Prefetcher:
+    def __init__(self, make_iter, num_prefetch):
+        self._make_iter = make_iter
+        self._queue = queue.Queue(maxsize=num_prefetch)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        try:
+            for item in self._make_iter():
+                self._queue.put(("data", item))
+        except Exception as e:  # propagate to consumer
+            self._queue.put(("error", e))
+        self._queue.put(("end", None))
+
+    def __iter__(self):
+        while True:
+            kind, item = self._queue.get()
+            if kind == "data":
+                yield item
+            elif kind == "error":
+                raise item
+            else:
+                return
+
+
+class DataLoader:
+    """Loads data from a Dataset and returns mini-batches."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * max(self._num_workers, 1))
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def _fetch_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def _make_iter(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._fetch_batch(indices)
+            return
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            # pipeline: keep up to prefetch batches in flight, in order
+            import collections
+            pending = collections.deque()
+            it = iter(self._batch_sampler)
+            try:
+                while True:
+                    while len(pending) < self._prefetch:
+                        try:
+                            indices = next(it)
+                        except StopIteration:
+                            break
+                        pending.append(pool.submit(self._fetch_batch, indices))
+                    if not pending:
+                        break
+                    yield pending.popleft().result()
+            finally:
+                for f in pending:
+                    f.cancel()
+
+    def __iter__(self):
+        if self._num_workers > 0:
+            return iter(_Prefetcher(self._make_iter, self._prefetch))
+        return self._make_iter()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,194 @@
+"""Vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py —
+Compose, Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, RandomBrightness/Contrast/Saturation/Lighting)."""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+def _asnumpy(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially composes transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        if isinstance(x, NDArray):
+            return x.astype(self._dtype)
+        return NDArray(_asnumpy(x)).astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (transforms.py ToTensor)."""
+
+    def forward(self, x):
+        arr = _asnumpy(x).astype(onp.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return NDArray(arr)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32)
+        self._std = onp.asarray(std, dtype=onp.float32)
+
+    def forward(self, x):
+        arr = _asnumpy(x).astype(onp.float32)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return NDArray((arr - mean) / std)
+
+
+def _resize_hwc(arr, size, interp=1):
+    import jax
+    import jax.numpy as jnp
+    h, w = size if isinstance(size, (list, tuple)) else (size, size)
+    method = "bilinear" if interp != 0 else "nearest"
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32), (h, w, arr.shape[2]),
+                           method=method)
+    return onp.asarray(out)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        arr = _asnumpy(x)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self._keep:
+            h, w = arr.shape[:2]
+            short = self._size
+            if h < w:
+                new_h, new_w = short, int(w * short / h)
+            else:
+                new_h, new_w = int(h * short / w), short
+            size = (new_h, new_w)
+        else:
+            size = self._size if isinstance(self._size, (list, tuple)) \
+                else (self._size, self._size)
+        return NDArray(_resize_hwc(arr, size, self._interpolation)
+                       .astype(arr.dtype if arr.dtype != onp.uint8 else onp.float32))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def forward(self, x):
+        arr = _asnumpy(x)
+        h, w = arr.shape[:2]
+        th, tw = self._size
+        if h < th or w < tw:
+            arr = _resize_hwc(arr, (max(h, th), max(w, tw)))
+            h, w = arr.shape[:2]
+        y0 = (h - th) // 2
+        x0 = (w - tw) // 2
+        return NDArray(arr[y0:y0 + th, x0:x0 + tw])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (list, tuple)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import math
+        arr = _asnumpy(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = pyrandom.uniform(*self._scale) * area
+            log_ratio = (math.log(self._ratio[0]), math.log(self._ratio[1]))
+            aspect = math.exp(pyrandom.uniform(*log_ratio))
+            new_w = int(round(math.sqrt(target_area * aspect)))
+            new_h = int(round(math.sqrt(target_area / aspect)))
+            if new_w <= w and new_h <= h:
+                x0 = pyrandom.randint(0, w - new_w)
+                y0 = pyrandom.randint(0, h - new_h)
+                crop = arr[y0:y0 + new_h, x0:x0 + new_w]
+                return NDArray(_resize_hwc(crop, self._size))
+        return CenterCrop(self._size)(NDArray(arr))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if pyrandom.random() < 0.5:
+            return NDArray(_asnumpy(x)[:, ::-1].copy())
+        return x if isinstance(x, NDArray) else NDArray(_asnumpy(x))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if pyrandom.random() < 0.5:
+            return NDArray(_asnumpy(x)[::-1].copy())
+        return x if isinstance(x, NDArray) else NDArray(_asnumpy(x))
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._brightness = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._brightness, self._brightness)
+        return NDArray(_asnumpy(x).astype(onp.float32) * alpha)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._contrast = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._contrast, self._contrast)
+        arr = _asnumpy(x).astype(onp.float32)
+        gray = arr.mean()
+        return NDArray(arr * alpha + gray * (1 - alpha))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._saturation = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._saturation, self._saturation)
+        arr = _asnumpy(x).astype(onp.float32)
+        gray = arr.mean(axis=2, keepdims=True)
+        return NDArray(arr * alpha + gray * (1 - alpha))

@@ -1,0 +1,25 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/vision/__init__.py:112-140 —
+get_model registry over resnet v1/v2 18-152, vgg 11-19(+bn), alexnet, densenet,
+squeezenet, inception-v3, mobilenet v1/v2)."""
+from .resnet import *   # noqa: F401,F403
+from .simple_nets import *  # noqa: F401,F403
+from .dense_nets import *   # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+from .simple_nets import __all__ as _simple_all
+from .dense_nets import __all__ as _dense_all
+from ....base import MXNetError
+
+_models = {}
+for _name in _resnet_all + _simple_all + _dense_all:
+    _obj = globals()[_name]
+    if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
+        _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (vision/__init__.py get_model parity)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(f"Model {name} is not supported. Available: "
+                         f"{sorted(_models)}")
+    return _models[name](**kwargs)

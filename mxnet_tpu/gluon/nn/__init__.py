@@ -1,0 +1,12 @@
+"""gluon.nn namespace (parity: python/mxnet/gluon/nn/)."""
+from .basic_layers import *  # noqa: F401,F403
+from .conv_layers import *   # noqa: F401,F403
+from .basic_layers import (Sequential, HybridSequential, Dense, Dropout, BatchNorm,
+                           LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten,
+                           Lambda, HybridLambda, Activation, LeakyReLU, PReLU, ELU,
+                           SELU, GELU, Swish, SyncBatchNorm, RMSNorm)
+from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+                          Conv3DTranspose, MaxPool1D, MaxPool2D, MaxPool3D,
+                          AvgPool1D, AvgPool2D, AvgPool3D, GlobalMaxPool1D,
+                          GlobalMaxPool2D, GlobalMaxPool3D, GlobalAvgPool1D,
+                          GlobalAvgPool2D, GlobalAvgPool3D, ReflectionPad2D)

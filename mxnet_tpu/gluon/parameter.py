@@ -1,0 +1,400 @@
+"""gluon.Parameter / ParameterDict (parity: python/mxnet/gluon/parameter.py:46
+Parameter w/ deferred init + cross-device grad, :714 ParameterDict).
+
+TPU-native: a Parameter owns one NDArray per context; in the pjit/multi-chip path
+(mxnet_tpu.parallel) the single logical array is sharded over the mesh instead of
+replicated per device, so list_data() has one entry whose buffer spans chips.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import Context, DTypes, MXNetError, current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer as init_mod
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before shape inference completed (parameter.py:39)."""
+
+
+def _shape_known(shape):
+    return shape is not None and all(s > 0 for s in shape)
+
+
+class Parameter:
+    """A trainable array with lazy/deferred initialization and per-context storage."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data: Optional[Dict[Context, NDArray]] = None
+        self._grad: Optional[Dict[Context, NDArray]] = None
+        self._deferred_init = ()
+        self._sharding = None  # mxnet_tpu.parallel PartitionSpec hint
+        self._obsolete_cache = []
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # merge unknown (0) dims
+        assert len(self._shape) == len(new_shape), \
+            f"{self.name}: rank mismatch {self._shape} vs {new_shape}"
+        merged = tuple(n if o in (0, -1) else o for o, n in zip(self._shape, new_shape))
+        self._shape = merged
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data:
+                for arr in self._data.values():
+                    arr._grad = None
+                    arr._grad_req = "null"
+        elif self._data is not None:
+            self._init_grad()
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if not _shape_known(self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise MXNetError(f"Cannot initialize Parameter {self.name} because it "
+                             "has invalid shape " + str(self._shape))
+        self._init_impl(init, ctx, default_init)
+
+    def _init_impl(self, init, ctx_list, default_init, data=None):
+        self._deferred_init = ()
+        self._data = OrderedDict()
+        for ctx in ctx_list:
+            if data is not None:
+                arr = NDArray(data.data if isinstance(data, NDArray) else data,
+                              ctx=ctx, dtype=self.dtype)
+            else:
+                from ..ndarray import zeros
+                arr = zeros(self._shape, ctx=ctx, dtype=self.dtype)
+                initializer = init if init is not None else default_init
+                initializer(init_mod.InitDesc(self.name), arr)
+                arr = NDArray(arr.data, ctx=ctx)
+            self._data[ctx] = arr
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = OrderedDict()
+        from ..ndarray import zeros
+        for ctx, arr in self._data.items():
+            g = zeros(self._shape, ctx=ctx, dtype=str(arr.dtype))
+            self._grad[ctx] = g
+            arr._grad = g
+            arr._grad_req = self._grad_req
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        if not _shape_known(self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape}")
+        init, ctx, default_init, data = self._deferred_init
+        self._init_impl(init, ctx, default_init, data)
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet because "
+                    "initialization was deferred (unknown shape)")
+            raise MXNetError(
+                f"Parameter {self.name} has not been initialized. Call initialize()")
+        if ctx is not None and ctx not in self._data:
+            raise MXNetError(f"Parameter {self.name} was not initialized on {ctx}; "
+                             f"it lives on {list(self._data)}")
+
+    def data(self, ctx=None) -> NDArray:
+        from .. import tracing
+        tctx = tracing.current()
+        if tctx is not None:
+            traced = tctx.lookup_param(self)
+            if traced is not None:
+                return traced
+        self._check_initialized()
+        if ctx is None:
+            return next(iter(self._data.values()))
+        self._check_initialized(ctx)
+        return self._data[ctx]
+
+    def list_data(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        if ctx is None:
+            return next(iter(self._grad.values()))
+        return self._grad[ctx]
+
+    def list_grad(self) -> List[NDArray]:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        return list(self._grad.values())
+
+    def list_ctx(self) -> List[Context]:
+        if self._data is None and self._deferred_init:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return list(self._data.keys())
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init:
+                init, ctx, default_init, _ = self._deferred_init
+                self._deferred_init = (init, ctx, default_init, data)
+                if _shape_known(self._shape):
+                    self._finish_deferred_init()
+                return
+            raise MXNetError(f"Parameter {self.name} not initialized")
+        for ctx, arr in self._data.items():
+            arr._set_data(data.as_in_context(ctx).data.astype(arr.data.dtype))
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        import jax.numpy as jnp
+        for g in self._grad.values():
+            g._set_data(jnp.zeros_like(g.data))
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = next(iter(self._data.values()))
+            self._init_impl(None, ctx, None, data=data)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+
+    def cast(self, dtype):
+        self.dtype = DTypes.canonical(dtype)
+        if self._data is None:
+            return
+        for arr in list(self._data.values()):
+            arr._set_data(arr.data.astype(DTypes.jnp(dtype)))
+        if self._grad is not None:
+            for g in self._grad.values():
+                g._set_data(g.data.astype(DTypes.jnp(dtype)))
+
+    def var(self):
+        """Legacy symbol-variable accessor; returns self (symbols are jax traces)."""
+        return self
+
+    # sharding hint for mxnet_tpu.parallel (subsumes reference ctx_group attrs)
+    def shard(self, spec):
+        self._sharding = spec
+        return self
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(onp.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(s, desc, arr):
+                arr._set_data(value.data.astype(arr.data.dtype))
+            _init_default = _init_weight
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=_CInit(), differentiable=False)
+
+
+class ParameterDict:
+    """Ordered dict of Parameters with prefix + shared-dict lookup
+    (gluon/parameter.py:714)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        """Get or create a parameter named prefix+name (parameter.py:805)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    param.shape = (v,) if isinstance(v, int) else v
+                elif k == "init" and v is not None and param.init is None:
+                    param.init = v
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"No constant named {name}")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"Cannot update self with other because they have "
+                                 f"different Parameters with the same name {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        # global init acts as default; a Parameter's own .init takes precedence
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init or init_mod.Uniform(),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, fname, strip_prefix=""):
+        from ..ndarray.utils import save as nd_save
+        arg = {}
+        for p in self.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p.data().as_in_context(Context("cpu", 0))
+        nd_save(fname, arg)
+
+    def load(self, fname, ctx=None, allow_missing=False, ignore_extra=False,
+             restore_prefix=""):
+        from ..ndarray.utils import load as nd_load
+        loaded = nd_load(fname)
+        if isinstance(loaded, list):
+            raise MXNetError("expected dict-style parameter file")
+        loaded = {restore_prefix + k.replace("arg:", "").replace("aux:", ""): v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise MXNetError(f"Parameter {name} missing in file {fname}")
+        for name, data in loaded.items():
+            if name not in self._params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(f"Parameter {name} in file but not in ParameterDict")
+            p = self._params[name]
+            if p._data is None and p._deferred_init:
+                p.set_data(data)
+            else:
+                if p._data is None:
+                    p.shape = data.shape
+                    p._init_impl(None, [ctx or current_context()] if not
+                                 isinstance(ctx, list) else ctx, None, data=data)
+                else:
+                    p.set_data(data)
+
+    def __repr__(self):
+        s = "\n".join(repr(p) for p in self.values())
+        return f"ParameterDict (\n{s}\n)"

@@ -1,0 +1,341 @@
+"""Recurrent cells (parity: python/mxnet/gluon/rnn/rnn_cell.py — RecurrentCell,
+RNNCell, LSTMCell, GRUCell, SequentialRNNCell, DropoutCell, ZoneoutCell,
+ResidualCell, BidirectionalCell, unroll)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .rnn_layer import _RNNLayer  # noqa: F401 (re-export convenience)
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd_mod
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(nd_mod.zeros(shape, ctx=ctx))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell over `length` steps (rnn_cell.py unroll)."""
+        from ... import ndarray as nd_mod
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch_size = seq[0].shape[batch_axis]
+        else:
+            seq = [inputs.take(nd_mod.array([i], dtype="int32"), axis=axis)
+                   .squeeze(axis=axis) for i in range(length)]
+            batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=seq[0].context)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            out, states = self(seq[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = nd_mod.stack(*outputs, axis=axis)
+            stacked = nd_mod.SequenceMask(stacked, valid_length,
+                                          use_sequence_length=True, axis=axis)
+            outputs = stacked
+            if merge_outputs is False:
+                outputs = [o.squeeze(axis=axis) for o in
+                           nd_mod.split(outputs, length, axis=axis)]
+        elif merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        self._counter += 1
+        return super().__call__(inputs, states, **kwargs)
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *a):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None,
+                 activation="tanh", recurrent_activation="sigmoid"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(4 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *a):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * F.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight",
+                                              shape=(3 * hidden_size, input_size),
+                                              init=i2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight",
+                                              shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer,
+                                              allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                            allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *a):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        prev_h = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(prev_h, h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset_gate = F.sigmoid(i2h_r + h2h_r)
+        update_gate = F.sigmoid(i2h_z + h2h_z)
+        next_h_tmp = F.tanh(i2h_n + reset_gate * h2h_n)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            state = states[pos:pos + n]
+            pos += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func, ctx=ctx, **kwargs)
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def hybrid_forward(self, F, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        mask = lambda p, like: F.Dropout(like.ones_like(), p=p)
+        prev_output = self._prev_output if self._prev_output is not None \
+            else next_output.zeros_like()
+        if self.zoneout_outputs > 0.0:
+            m = mask(self.zoneout_outputs, next_output)
+            output = F.where(m, next_output, prev_output)
+        else:
+            output = next_output
+        if self.zoneout_states > 0.0:
+            states = [F.where(mask(self.zoneout_states, ns), ns, s)
+                      for ns, s in zip(next_states, states)]
+        else:
+            states = next_states
+        self._prev_output = output
+        return output, states
+
+
+class ResidualCell(ModifierCell):
+    def hybrid_forward(self, F, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(prefix="", params=None)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd_mod
+        self.reset()
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if not isinstance(inputs, (list, tuple)):
+            seq = [inputs.take(nd_mod.array([i], dtype="int32"), axis=axis)
+                   .squeeze(axis=axis) for i in range(length)]
+            batch_size = inputs.shape[batch_axis]
+        else:
+            seq = list(inputs)
+            batch_size = seq[0].shape[batch_axis]
+        l_cell, r_cell = self._children.values()
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size, ctx=seq[0].context)
+        n_l = len(l_cell.state_info())
+        l_outputs, l_states = l_cell.unroll(length, seq, begin_state[:n_l],
+                                            layout="NTC" if axis == 1 else layout,
+                                            merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(length, list(reversed(seq)),
+                                            begin_state[n_l:],
+                                            layout="NTC" if axis == 1 else layout,
+                                            merge_outputs=False)
+        outputs = [nd_mod.concat(lo, ro, dim=1)
+                   for lo, ro in zip(l_outputs, reversed(r_outputs))]
+        if merge_outputs:
+            outputs = nd_mod.stack(*outputs, axis=axis)
+        return outputs, l_states + r_states

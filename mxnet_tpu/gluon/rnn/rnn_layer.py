@@ -1,0 +1,117 @@
+"""Fused recurrent layers (parity: python/mxnet/gluon/rnn/rnn_layer.py wrapping the
+monolithic RNN op, src/operator/rnn-inl.h). The whole multi-layer bidirectional
+net runs as one lax.scan computation — the cuDNN-fused-path analog on TPU."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops.nn import rnn_param_size
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}; must be TNC or NTC")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        with self.name_scope():
+            # single flat parameter vector, reference layout (rnn-inl.h)
+            size = rnn_param_size(mode, num_layers, input_size, hidden_size,
+                                  bidirectional) if input_size else 0
+            self.parameters = self.params.get(
+                "parameters", shape=(size,) if size else (0,),
+                init=i2h_weight_initializer, dtype=dtype,
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *states):
+        input_size = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        self._input_size = input_size
+        self.parameters.shape = (rnn_param_size(
+            self._mode, self._num_layers, input_size, self._hidden_size,
+            self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd_mod
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(nd_mod.zeros(info["shape"], ctx=ctx, dtype=self._dtype))
+        return states
+
+    def hybrid_forward(self, F, x, *states, **params):
+        parameters = params["parameters"]
+        if len(states) == 1 and isinstance(states[0], (list, tuple)):
+            states = tuple(states[0])
+        skip_states = not states
+        if skip_states:
+            batch = x.shape[0] if self._layout == "NTC" else x.shape[1]
+            states = self.begin_state(batch, ctx=None if not hasattr(x, "context")
+                                      else x.context)
+        if self._layout == "NTC":
+            x = x.swapaxes(0, 1)
+        args = [x, parameters, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        out = F.RNN(*args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, bidirectional=self._dir == 2,
+                    mode=self._mode, p=self._dropout, state_outputs=True)
+        if self._mode == "lstm":
+            output, hT, cT = out
+            new_states = [hT, cT]
+        else:
+            output, hT = out
+            new_states = [hT]
+        if self._layout == "NTC":
+            output = output.swapaxes(0, 1)
+        if skip_states:
+            return output
+        return output, new_states
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._input_size} -> " \
+               f"{self._hidden_size}, {self._layout}, layers={self._num_layers}" \
+               f"{', bidirectional' if self._dir == 2 else ''})"
+
+
+class RNN(_RNNLayer):
+    """Vanilla RNN layer (rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, f"rnn_{activation}", **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)

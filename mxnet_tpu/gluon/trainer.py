@@ -1,0 +1,161 @@
+"""gluon.Trainer (parity: python/mxnet/gluon/trainer.py:29; _init_kvstore:183,
+step:329, _allreduce_grads:380-404).
+
+TPU-native: single-device updates run the jitted optimizer rules directly;
+multi-device gradients reduce through the KVStore (on-device sum / ICI allreduce);
+the fully-fused multi-chip path (grad allreduce + update inside one pjit
+computation) lives in mxnet_tpu.parallel.train_step and is what benchmarks use.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kvstore_mod
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict or list of Parameters")
+        self._params: List[Parameter] = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError("invalid parameter in Trainer")
+            self._param2idx[p.name] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        self._contains_sparse_grad = any(
+            p._grad_stype != "default" for p in self._params)
+        optimizer_params = optimizer_params or {}
+        self._scale = optimizer_params.get("rescale_grad", 1.0)
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = []
+        self._updaters = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and list(optimizer_params) != ["rescale_grad"]:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+
+    # ------------------------------------------------------------------
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kv = config["kvstore"]
+        if kv is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            self._kvstore = kv if isinstance(kv, kvstore_mod.KVStoreBase) \
+                else kvstore_mod.create(kv)
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            update_on_kvstore = config["update_on_kvstore"]
+            if update_on_kvstore is None:
+                # local update is the fast path on TPU (fused jit update)
+                update_on_kvstore = False
+            self._update_on_kvstore = update_on_kvstore
+            if update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+                for i, p in enumerate(self._params):
+                    if p._data is not None:
+                        self._kvstore.init(i, p.data())
+        if not self._update_on_kvstore:
+            self._updaters = opt_mod.get_updater(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce gradients then apply optimizer (trainer.py:329)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            grads = param.list_grad()
+            if len(grads) > 1 or self._kvstore.num_workers > 1:
+                # priority = -i: first-needed parameters communicate first
+                # (trainer.py:390,402)
+                self._kvstore.pushpull(i, grads, out=grads, priority=-i)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null" or param._data is None:
+                    continue
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                self._kvstore.pull(i, out=param.list_data(), priority=-i)
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            for w, g in zip(param.list_data(), param.list_grad()):
+                self._updaters(i, g, w)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updaters.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updaters.set_states(f.read())

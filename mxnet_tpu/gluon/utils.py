@@ -1,0 +1,102 @@
+"""gluon.utils (parity: python/mxnet/gluon/utils.py — split_data, split_and_load,
+clip_global_norm, check_sha1, download)."""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List
+
+from ..base import Context, MXNetError
+from ..ndarray.ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into {num_slice} "
+            f"slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch along batch_axis and load one slice per context
+    (gluon/utils.py split_and_load)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the sum of their 2-norms is <= max_norm."""
+    import math
+    import jax.numpy as jnp
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = 0.0
+    for a in arrays:
+        total += float(jnp.sum(jnp.square(a.data.astype(jnp.float32))))
+    total_norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be undefined.")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a.data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (gluon/utils.py download). Zero-egress environments raise
+    a clear error instead of hanging."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    import urllib.request
+    os.makedirs(os.path.dirname(os.path.abspath(fname)), exist_ok=True)
+    try:
+        urllib.request.urlretrieve(url, fname)
+    except Exception as e:
+        raise MXNetError(f"failed to download {url}: {e}") from e
+    return fname
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+def _indent(s_, num_spaces):
+    s = s_.split("\n")
+    first = s.pop(0)
+    s = [num_spaces * " " + line for line in s]
+    return "\n".join([first] + s)

@@ -1,0 +1,261 @@
+"""Image utilities (parity: python/mxnet/image/ — imdecode, imresize, fixed/random
+crop, color normalize, augmenters, ImageIter). Decoding uses PIL or cv2 when
+available; resize/crop run through jax.image on device."""
+from __future__ import annotations
+
+import io as _io
+import numbers
+import os
+import random as pyrandom
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["imdecode", "imresize", "imread", "fixed_crop", "center_crop",
+           "random_crop", "resize_short", "color_normalize", "ImageIter",
+           "CreateAugmenter"]
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode an encoded image buffer to HWC NDArray (mx.image.imdecode)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    arr = None
+    try:
+        from PIL import Image
+        img = Image.open(_io.BytesIO(bytes(buf)))
+        if flag == 0:
+            img = img.convert("L")
+            arr = onp.asarray(img)[:, :, None]
+        else:
+            img = img.convert("RGB")
+            arr = onp.asarray(img)
+            if not to_rgb:
+                arr = arr[:, :, ::-1]
+    except ImportError:
+        try:
+            import cv2
+            raw = onp.frombuffer(bytes(buf), dtype=onp.uint8)
+            arr = cv2.imdecode(raw, cv2.IMREAD_GRAYSCALE if flag == 0
+                               else cv2.IMREAD_COLOR)
+            if flag == 0:
+                arr = arr[:, :, None]
+            elif to_rgb:
+                arr = arr[:, :, ::-1]
+        except ImportError as e:
+            raise MXNetError("imdecode requires PIL or cv2") from e
+    return NDArray(onp.ascontiguousarray(arr))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    import jax.numpy as jnp
+    arr = src.data if isinstance(src, NDArray) else jnp.asarray(src)
+    method = "nearest" if interp == 0 else "bilinear"
+    out = jax.image.resize(arr.astype(jnp.float32), (h, w, arr.shape[2]), method)
+    return NDArray(out.astype(arr.dtype))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = NDArray(src.data[y0:y0 + h, x0:x0 + w] if isinstance(src, NDArray)
+                  else src[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = pyrandom.randint(0, max(w - new_w, 0))
+    y0 = pyrandom.randint(0, max(h - new_h, 0))
+    return fixed_crop(src, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - (mean.data if isinstance(mean, NDArray) else mean)
+    if std is not None:
+        src = src / (std.data if isinstance(std, NDArray) else std)
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return NDArray(src.asnumpy()[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmentation pipeline (mx.image.CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None or std is not None:
+        if isinstance(mean, bool) and mean:
+            mean = onp.array([123.68, 116.28, 103.53])
+        if isinstance(std, bool) and std:
+            std = onp.array([58.395, 57.12, 57.375])
+
+        class _NormAug(Augmenter):
+            def __call__(self, src):
+                return color_normalize(src, mean, std)
+        auglist.append(_NormAug())
+    return auglist
+
+
+class ImageIter:
+    """Image data iterator with augmenters (mx.image.ImageIter parity), reading
+    from a RecordIO file or an image list."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False, aug_list=None,
+                 **kwargs):
+        from .io import DataBatch, DataDesc
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else []
+        self._records = []
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO, unpack
+            rec = MXIndexedRecordIO(os.path.splitext(path_imgrec)[0] + ".idx",
+                                    path_imgrec, "r")
+            self._rec = rec
+            self._keys = list(rec.keys)
+        elif path_imglist:
+            self._rec = None
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    self._records.append((float(parts[1]),
+                                          os.path.join(path_root or "", parts[-1])))
+            self._keys = list(range(len(self._records)))
+        else:
+            raise MXNetError("either path_imgrec or path_imglist is required")
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            pyrandom.shuffle(self._keys)
+
+    def _next_sample(self):
+        if self._cursor >= len(self._keys):
+            raise StopIteration
+        key = self._keys[self._cursor]
+        self._cursor += 1
+        if self._rec is not None:
+            from .recordio import unpack
+            header, img = unpack(self._rec.read_idx(key))
+            return header.label, imdecode(img)
+        label, path = self._records[key]
+        return label, imread(path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .io import DataBatch
+        batch_data = []
+        batch_label = []
+        for _ in range(self.batch_size):
+            label, img = self._next_sample()
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy()
+            if arr.ndim == 3:
+                arr = arr.transpose(2, 0, 1)
+            batch_data.append(arr)
+            batch_label.append(label)
+        data = NDArray(onp.asarray(batch_data, dtype=onp.float32))
+        label = NDArray(onp.asarray(batch_label, dtype=onp.float32))
+        return DataBatch(data=[data], label=[label])
+
+    next = __next__

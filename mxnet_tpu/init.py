@@ -1,0 +1,3 @@
+"""Alias module: mx.init (the reference exposes initializer as mx.init too)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import Initializer, Xavier, Uniform, Normal, Constant, Zero, One  # noqa: F401

@@ -1,0 +1,278 @@
+"""Weight initializers (parity: python/mxnet/initializer.py — Xavier, MSRAPrelu,
+Uniform, Normal, Orthogonal, Constant, One, Zero, Bilinear, LSTMBias + registry)."""
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+import numpy as onp
+
+from .base import Registry, MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Constant", "Zero", "One", "Bilinear", "LSTMBias", "Load", "Mixed",
+           "register", "InitDesc"]
+
+_REG = Registry("initializer")
+register = _REG.register
+
+
+class InitDesc(str):
+    """Parameter name + attrs descriptor handed to initializers."""
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        """Initialize `arr` (NDArray) described by `desc` (InitDesc or str)."""
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init = desc.attrs.get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            _REG.get(klass)(**kwargs)._init_impl(desc, arr)
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    def _init_impl(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def init_array(self, shape, dtype, name="weight"):
+        from .ndarray import zeros
+        arr = zeros(shape, dtype=dtype)
+        self(InitDesc(name), arr)
+        return arr
+
+    # -- primitives ---------------------------------------------------------
+    def _set(self, arr, np_value):
+        import jax.numpy as jnp
+        arr._set_data(jnp.asarray(np_value, dtype=arr.data.dtype))
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, onp.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, onp.ones(arr.shape))
+
+    def _init_bias(self, desc, arr):
+        self._set(arr, onp.zeros(arr.shape))
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({self._kwargs})"
+
+
+def _rng():
+    # numpy RNG seeded from the framework seed chain for reproducibility
+    from . import random as _r
+    import jax
+    key = _r.take_key()
+    seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+    return onp.random.RandomState(seed)
+
+
+@register("uniform")
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _rng().uniform(-self.scale, self.scale, arr.shape))
+
+
+@register("normal")
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, _rng().normal(0, self.sigma, arr.shape))
+
+
+@register("constant")
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        self._set(arr, onp.full(arr.shape, self.value))
+
+    _init_default = _init_weight
+
+
+@register("zeros")
+class Zero(Constant):
+    def __init__(self):
+        Initializer.__init__(self)
+        self.value = 0.0
+
+
+@register("ones")
+class One(Constant):
+    def __init__(self):
+        Initializer.__init__(self)
+        self.value = 1.0
+
+
+def _fans(shape, factor_type="avg"):
+    hw = 1
+    for s in shape[2:]:
+        hw *= s
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register("xavier")
+class Xavier(Initializer):
+    """Xavier/Glorot (initializer.py Xavier parity): rnd_type uniform|gaussian,
+    factor_type avg|in|out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        fan_in, fan_out = _fans(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError("invalid factor_type")
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        r = _rng()
+        if self.rnd_type == "uniform":
+            self._set(arr, r.uniform(-scale, scale, arr.shape))
+        elif self.rnd_type == "gaussian":
+            self._set(arr, r.normal(0, scale, arr.shape))
+        else:
+            raise MXNetError("invalid rnd_type")
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register("orthogonal")
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        r = _rng()
+        if self.rand_type == "uniform":
+            tmp = r.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = r.normal(0.0, 1.0, (nout, nin))
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        self._set(arr, self.scale * q.reshape(arr.shape))
+
+
+@register("bilinear")
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = onp.zeros(arr.shape).reshape(-1)
+        shape = arr.shape
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(onp.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        self._set(arr, weight.reshape(shape))
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = onp.zeros(arr.shape)
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        self._set(arr, b)
+
+    _init_bias = _init_weight
+
+
+class Load:
+    """Initialize from a dict of loaded arrays, falling back to default_init."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            arr._set_data(self.param[name].data.astype(arr.data.dtype))
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise MXNetError(f"Cannot init {name}: not found and no default_init")
+
+
+class Mixed:
+    """Pattern-dispatch initializer (initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")

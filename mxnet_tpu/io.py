@@ -1,0 +1,291 @@
+"""Data iterators (parity: python/mxnet/io/ + src/io/ C++ iterators — DataIter,
+DataBatch, DataDesc, NDArrayIter, MNISTIter, CSVIter, ImageRecordIter,
+PrefetchingIter, ResizeIter).
+
+TPU-native: the reference's threaded decode→augment→batch→prefetch pipeline
+(iter_prefetcher.h) maps to a background-thread prefetcher that overlaps host
+batching with async device transfer (PJRT DMA).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from collections import namedtuple
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
+           "CSVIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None, bucket_key=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{'_' + str(i) if i else ''}": d
+                for i, d in enumerate(data)} if len(data) > 1 \
+            else ({default_name: data[0]} if data else {})
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = NDArray(onp.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self._shuffle = shuffle
+        self._last_batch_handle = last_batch_handle
+        self._order = onp.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], str(v.dtype))
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], str(v.dtype))
+                for k, v in self.label]
+
+    def reset(self):
+        if self._shuffle:
+            onp.random.shuffle(self._order)
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self._last_batch_handle == "roll_over":
+            return self.cursor + self.batch_size <= self.num_data
+        if self._last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        pad = self.getpad()
+        if pad:
+            idx = onp.concatenate([idx, self._order[:pad]])
+        for _, v in arrays:
+            out.append(NDArray(v.data[idx]))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self._last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST iterator (src/io/iter_mnist.cc parity): reads idx files or synthesizes
+    deterministic data in zero-egress environments."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=None, input_shape=None, **kwargs):
+        from .gluon.data.vision.datasets import MNIST
+        train = image is None or "train" in str(image)
+        root = os.path.dirname(os.path.expanduser(image)) if image \
+            else os.path.join("~", ".mxnet", "datasets", "mnist")
+        ds = MNIST(root=root, train=train)
+        data = ds._data.asnumpy().astype(onp.float32) / 255.0
+        labels = ds._label
+        if flat:
+            data = data.reshape(len(data), -1)
+        else:
+            data = data.transpose(0, 3, 1, 2)
+        super().__init__(data, labels.astype(onp.float32), batch_size, shuffle)
+
+
+class CSVIter(DataIter):
+    """CSV iterator (src/io/iter_csv.cc parity)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32) \
+            if label_csv else onp.zeros(len(data), onp.float32)
+        self._inner = NDArrayIter(data, label, batch_size)
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
+                    shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0,
+                    mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                    preprocess_threads=4, prefetch_buffer=4, **kwargs):
+    """ImageRecordIter (src/io/iter_image_recordio_2.cc:887 parity): RecordIO
+    decode→augment→batch with thread prefetch."""
+    from .image import ImageIter, CreateAugmenter
+    mean = onp.array([mean_r, mean_g, mean_b]) if (mean_r or mean_g or mean_b) \
+        else None
+    std = onp.array([std_r, std_g, std_b]) if (std_r != 1 or std_g != 1
+                                               or std_b != 1) else None
+    aug = CreateAugmenter(data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror,
+                          mean=mean, std=std)
+    inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                      shuffle=shuffle, aug_list=aug, **kwargs)
+    return PrefetchingIter(inner, prefetch=prefetch_buffer)
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (io.py PrefetchingIter / iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, prefetch=2):
+        super().__init__()
+        self._iter = iters if not isinstance(iters, list) else iters[0]
+        self._prefetch = prefetch
+        self._queue = None
+        self._thread = None
+        self.reset()
+
+    def _work(self):
+        try:
+            for batch in self._iter:
+                self._queue.put(("data", batch))
+        except StopIteration:
+            pass
+        except Exception as e:
+            self._queue.put(("error", e))
+        self._queue.put(("end", None))
+
+    def reset(self):
+        self._iter.reset()
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        kind, item = self._queue.get()
+        if kind == "data":
+            return item
+        if kind == "error":
+            raise item
+        raise StopIteration
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration

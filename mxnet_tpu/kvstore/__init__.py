@@ -1,0 +1,226 @@
+"""KVStore: parameter synchronisation (parity surface: include/mxnet/kvstore.h:74
+KVStore::Create + Init/Push/Pull/PushPull/Broadcast; src/kvstore/kvstore.cc:41-84
+type dispatch).
+
+TPU-native mapping (SURVEY.md §2.3):
+  - 'local'/'device'/'nccl' (single-process multi-device reduce, CommDevice/
+    KVStoreNCCL) → on-device sum+broadcast; when values live on multiple chips of a
+    jax.sharding.Mesh the reduction lowers to an ICI AllReduce inside one jitted
+    computation (see mxnet_tpu.parallel for the in-program pjit path, which is how
+    multi-chip training actually runs).
+  - 'dist_sync'/'dist_device_sync'/'dist_async'/'p3' (ps-lite parameter server) →
+    multi-host collectives over jax.distributed (ICI within slice, DCN across
+    hosts); there is no parameter-server process because sync SGD on TPU is
+    allreduce-native. dist_async degrades to sync (documented gap).
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+from .gradient_compression import GradientCompression
+
+__all__ = ["create", "KVStore", "KVStoreBase"]
+
+
+def _listify(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+class KVStore(KVStoreBase):
+    """Single-controller KVStore covering local/device/nccl/dist types."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression: Optional[GradientCompression] = None
+        self._multi_host = False
+        if kv_type.startswith("dist"):
+            import jax
+            self._multi_host = jax.process_count() > 1
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index() if self._multi_host else 0
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count() if self._multi_host else 1
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in ("optimizer",)
+
+    # -- config -------------------------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        params = dict(compression_params)
+        self._compression = GradientCompression(
+            type=params.get("type", "2bit"),
+            threshold=float(params.get("threshold", 0.5)))
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    # -- core ops -----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _listify(key), _listify(value)
+        if len(keys) != len(values):
+            keys = [key] * len(values)
+        for k, v in zip(keys, values):
+            self._store[k] = NDArray(v.data, ctx=v.context)
+
+    def _reduce(self, values: List[NDArray]) -> NDArray:
+        """Sum a list of per-device gradients (CommDevice::Reduce analog)."""
+        import jax
+        import jax.numpy as jnp
+        if len(values) == 1:
+            out = values[0].data
+        else:
+            target = values[0].data
+            total = target
+            for v in values[1:]:
+                buf = v.data
+                if buf.devices() != target.devices():
+                    buf = jax.device_put(buf, next(iter(target.devices())))
+                total = total + buf
+            out = total
+        if self._multi_host:
+            from jax.experimental import multihost_utils
+            out = multihost_utils.process_allgather(out)
+            out = jnp.sum(out, axis=0)
+        return NDArray(out, ctx=values[0].context)
+
+
+    def push(self, key, value, priority=0):
+        keys, values = _listify(key), _listify(value)
+        if len(keys) == 1 and len(values) > 1:
+            values = [values]
+        for k, vlist in zip(keys, values):
+            vlist = _listify(vlist)
+            agg = self._reduce(vlist)
+            if self._compression is not None:
+                agg = NDArray(self._compression.compress(k, agg), ctx=agg.context)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(_key_int(k), agg, self._store[k])
+            else:
+                if k in self._store and getattr(self, "_accumulate", False):
+                    self._store[k] += agg
+                else:
+                    self._store[k] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _listify(key), _listify(out)
+        if len(keys) == 1 and len(outs) > 1:
+            outs = [outs]
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for o in _listify(olist):
+                src.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce (kvstore.h:246): reduce `value`, broadcast into `out`
+        (or back into `value` when out is None)."""
+        keys = _listify(key)
+        values = _listify(value)
+        if len(keys) == 1 and len(values) > 1 and not isinstance(value[0], (list, tuple)):
+            values = [values]
+        targets = out if out is not None else value
+        outs = _listify(targets)
+        if len(keys) == 1 and len(outs) > 1 and not isinstance(outs[0], (list, tuple)):
+            outs = [outs]
+        for k, vlist, olist in zip(keys, values, outs):
+            agg = self._reduce(_listify(vlist))
+            if self._compression is not None:
+                agg = NDArray(self._compression.compress(k, agg), ctx=agg.context)
+            if self._updater is not None and k in self._store:
+                self._updater(_key_int(k), agg, self._store[k])
+                agg = self._store[k]
+            for o in _listify(olist):
+                agg.copyto(o)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull: gathers only requested rows (kvstore.h:178). Dense-backed."""
+        keys = _listify(key)
+        outs = _listify(out)
+        rids = _listify(row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            src = self._store[k]
+            rows = src.take(r.astype("int32") if hasattr(r, "astype") else r, axis=0)
+            full = src.zeros_like()
+            import jax.numpy as jnp
+            idx = (r.data if isinstance(r, NDArray) else jnp.asarray(r)).astype(jnp.int32)
+            full._set_data(full.data.at[idx].set(rows.data))
+            full.copyto(o)
+
+    # -- lifecycle / dist control plane (ps-lite scheduler analog) -----------
+    def barrier(self, priority=0):
+        if self._multi_host:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def num_dead_node(self, node_id=0, timeout_sec=60):
+        return 0
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def __repr__(self):
+        return f"<KVStore type={self._type} rank={self.rank}/{self.num_workers}>"
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+_TYPES = ("local", "device", "nccl", "tpu", "dist", "dist_sync", "dist_async",
+          "dist_device_sync", "dist_sync_device", "p3", "horovod")
+
+
+def create(name="local") -> KVStore:
+    """KVStore factory (kvstore.cc:41-84). All single-process types share the
+    on-device implementation; dist types add multi-host collectives."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    base = name.lower()
+    if base not in _TYPES and base.lower() not in KVStoreBase._kv_registry:
+        raise MXNetError(f"unknown KVStore type {name!r}")
+    if base in KVStoreBase._kv_registry:
+        return KVStoreBase._kv_registry[base]()
+    return KVStore(base)

@@ -1,0 +1,48 @@
+"""Gradient compression (parity: src/kvstore/gradient_compression.{h,cc,cu} —
+2-bit quantization with error-feedback residual on the push path, wired into
+Trainer(compression_params=...)).
+
+TPU-native: the quantize/dequantize kernels are pure JAX (XLA fuses them); the
+residual is carried per key. 1-bit signSGD-style compression is also provided.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+
+
+class GradientCompression:
+    def __init__(self, type: str = "2bit", threshold: float = 0.5):
+        if type not in ("2bit", "1bit"):
+            raise MXNetError("gradient compression supports '2bit' and '1bit'")
+        self.type = type
+        self.threshold = threshold
+        self._residuals: Dict = {}
+
+    def get_params(self):
+        return {"type": self.type, "threshold": str(self.threshold)}
+
+    def compress(self, key, grad):
+        """Quantize + error feedback. Returns the dequantized (lossy) gradient that
+        the transport would deliver; residual accumulates the quantization error
+        (gradient_compression.cc quantize_2bit kernel semantics)."""
+        import jax.numpy as jnp
+        g = grad.data if hasattr(grad, "data") else grad
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros_like(g)
+        acc = g + res
+        th = self.threshold
+        if self.type == "2bit":
+            q = jnp.where(acc >= th, th, jnp.where(acc <= -th, -th, 0.0)).astype(g.dtype)
+        else:
+            scale = jnp.mean(jnp.abs(acc))
+            q = (jnp.sign(acc) * scale).astype(g.dtype)
+        self._residuals[key] = acc - q
+        return q
+
+    def reset(self):
+        self._residuals.clear()

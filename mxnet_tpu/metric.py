@@ -1,0 +1,284 @@
+"""Evaluation metrics (parity: python/mxnet/metric.py — registry, Accuracy, TopK,
+F1, MAE/MSE/RMSE, CrossEntropy, Perplexity, PearsonCorrelation, CustomMetric,
+CompositeEvalMetric)."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as onp
+
+from .base import Registry, MXNetError
+
+_REG = Registry("metric")
+register = _REG.register
+
+
+def _as_numpy(x):
+    from .ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if len(labels) != len(preds):
+        raise MXNetError(f"Shape mismatch: {len(labels)} labels vs {len(preds)} preds")
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+def create(metric, *args, **kwargs):
+    """Create a metric by name or callable (metric.py create parity)."""
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    return _REG.get(metric)(*args, **kwargs)
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+def _listify(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register("acc")
+@register("accuracy")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label)
+            if pred.ndim > label.ndim:
+                pred = onp.argmax(pred, axis=self.axis)
+            pred = pred.astype(onp.int64).ravel()
+            label = label.astype(onp.int64).ravel()
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register("top_k_accuracy")
+@register("top_k_acc")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(onp.int64).ravel()
+            idx = onp.argsort(-pred, axis=-1)[:, :self.top_k]
+            self.sum_metric += float((idx == label[:, None]).any(axis=1).sum())
+            self.num_inst += len(label)
+
+
+@register("f1")
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).ravel()
+            if pred.ndim > 1:
+                pred = onp.argmax(pred, axis=-1)
+            pred = pred.ravel()
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        precision = self.tp / max(self.tp + self.fp, 1e-12)
+        recall = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        return self.name, f1
+
+
+@register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label, pred = _as_numpy(label), _as_numpy(pred)
+            self.sum_metric += float(onp.abs(label.reshape(pred.shape) - pred).mean())
+            self.num_inst += 1
+
+
+@register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label, pred = _as_numpy(label), _as_numpy(pred)
+            self.sum_metric += float(((label.reshape(pred.shape) - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        EvalMetric.__init__(self, name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.sqrt(self.sum_metric / self.num_inst)
+
+
+@register("ce")
+@register("cross-entropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_numpy(label).ravel().astype(onp.int64)
+            pred = _as_numpy(pred)
+            prob = pred[onp.arange(label.shape[0]), label]
+            self.sum_metric += float((-onp.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register("perplexity")
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        EvalMetric.__init__(self, name, **kwargs)
+        self.ignore_label = ignore_label
+        self.eps = 1e-12
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label = _as_numpy(label).ravel().astype(onp.int64)
+            pred = _as_numpy(pred).reshape(-1, _as_numpy(pred).shape[-1])
+            prob = pred[onp.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                prob = prob[~ignore]
+            self.sum_metric += float(-onp.log(prob + self.eps).sum())
+            self.num_inst += prob.shape[0]
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            label, pred = _as_numpy(label).ravel(), _as_numpy(pred).ravel()
+            self.sum_metric += float(onp.corrcoef(pred, label)[0, 1])
+            self.num_inst += 1
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            val = self._feval(_as_numpy(label), _as_numpy(pred))
+            if isinstance(val, tuple):
+                s, n = val
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += val
+                self.num_inst += 1
+
+
+def np_metric(name=None, allow_extra_outputs=False):
+    def deco(f):
+        return CustomMetric(f, name or f.__name__, allow_extra_outputs)
+    return deco
+
+
+Loss = type("Loss", (EvalMetric,), {
+    "__init__": lambda self, name="loss", **kw: EvalMetric.__init__(self, name, **kw),
+    "update": lambda self, _, preds: [
+        (setattr(self, "sum_metric", self.sum_metric + float(_as_numpy(p).sum())),
+         setattr(self, "num_inst", self.num_inst + _as_numpy(p).size))
+        for p in _listify(preds)] and None})
+register("loss")(Loss)

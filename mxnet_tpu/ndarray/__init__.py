@@ -1,0 +1,243 @@
+"""The ``nd`` imperative frontend (parity: python/mxnet/ndarray/, 20.5k LoC of
+generated + hand-written wrappers). Op functions are generated from the registry
+exactly like the reference generates them from the C op registry
+(python/mxnet/_ctypes/ndarray.py:64 _imperative_invoke).
+"""
+from __future__ import annotations
+
+import sys as _sys
+from typing import Optional
+
+import numpy as _onp
+
+from ..base import Context, DTypes, current_context
+from ..ops import registry as _registry
+from ..ops.registry import apply_op as _apply_op
+from .ndarray import NDArray, array, _wrap_output
+
+_this = _sys.modules[__name__]
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+def _device_array(np_maker, ctx, dtype):
+    import jax
+    import jax.numpy as jnp
+    dev = (ctx or current_context()).jax_device()
+    with jax.default_device(dev):
+        arr = np_maker(jnp)
+    return NDArray(jax.device_put(arr, dev), ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _device_array(lambda jnp: jnp.zeros(shape, DTypes.jnp(dtype)), ctx, dtype)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _device_array(lambda jnp: jnp.ones(shape, DTypes.jnp(dtype)), ctx, dtype)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _device_array(lambda jnp: jnp.full(shape, val, DTypes.jnp(dtype)), ctx, dtype)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    def mk(jnp):
+        a = jnp.arange(start, stop, step, DTypes.jnp(dtype))
+        if repeat > 1:
+            a = jnp.repeat(a, repeat)
+        return a
+    return _device_array(mk, ctx, dtype)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return _device_array(
+        lambda jnp: jnp.linspace(start, stop, num, endpoint=endpoint,
+                                 dtype=DTypes.jnp(dtype)), ctx, dtype)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return _device_array(
+        lambda jnp: jnp.eye(N, M if M else None, k, dtype=DTypes.jnp(dtype)), ctx, dtype)
+
+
+def zeros_like(a):
+    return _apply_op("zeros_like", a)
+
+
+def ones_like(a):
+    return _apply_op("ones_like", a)
+
+
+def full_like(a, fill_value):
+    return zeros_like(a) + fill_value
+
+
+# ---------------------------------------------------------------------------
+# hand-written wrappers (stateful / variadic / writeback semantics)
+# ---------------------------------------------------------------------------
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+              fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
+              **kwargs):
+    from .. import autograd, tracing
+    training = autograd.is_training() and not use_global_stats
+    out, new_mean, new_var = _apply_op(
+        "BatchNorm", data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma, use_global_stats=use_global_stats,
+        axis=axis, training=training)
+    if training:
+        tracing.write_aux(moving_mean, new_mean.data)
+        tracing.write_aux(moving_var, new_var.data)
+    return out
+
+
+def Dropout(data, p=0.5, mode="training", axes=(), **kwargs):
+    from .. import autograd
+    from .. import random as _random
+    training = autograd.is_training() or mode == "always"
+    if not training or p <= 0:
+        return _apply_op("Dropout", data, None, p=p, training=False)
+    key = _random.take_key()
+    return _apply_op("Dropout", data, key, p=p, axes=tuple(axes), training=True)
+
+
+def concat(*args, dim=1, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _apply_op("concat", *args, dim=dim)
+
+
+def stack(*args, axis=0, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _apply_op("stack", *args, axis=axis)
+
+
+def add_n(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return _apply_op("add_n", *args)
+
+
+ElementWiseSum = add_n
+
+
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    out = _apply_op("split", data, num_outputs=num_outputs, axis=axis,
+                    squeeze_axis=squeeze_axis)
+    return list(out) if isinstance(out, tuple) else out
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                 axis=0):
+    args = (data,) if sequence_length is None else (data, sequence_length)
+    return _apply_op("sequence_mask", *args, use_sequence_length=use_sequence_length,
+                     value=value, axis=axis)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False, axis=0):
+    args = (data,) if sequence_length is None else (data, sequence_length)
+    return _apply_op("sequence_last", *args, use_sequence_length=use_sequence_length,
+                     axis=axis)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    args = (data,) if sequence_length is None else (data, sequence_length)
+    return _apply_op("sequence_reverse", *args, use_sequence_length=use_sequence_length,
+                     axis=axis)
+
+
+def RNN(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
+        bidirectional=False, mode="lstm", p=0.0, state_outputs=True, **kwargs):
+    args = (data, parameters, state) if state_cell is None \
+        else (data, parameters, state, state_cell)
+    return _apply_op("RNN", *args, state_size=state_size, num_layers=num_layers,
+                     bidirectional=bidirectional, mode=mode, p=p,
+                     state_outputs=state_outputs)
+
+
+def cast(data, dtype):
+    return _apply_op("cast", data, dtype=DTypes.canonical(dtype))
+
+
+def Cast(data, dtype):
+    return cast(data, dtype)
+
+
+def where(condition, x, y):
+    return _apply_op("where", condition, x, y)
+
+
+def multi_sum_sq(*arrays, num_arrays=0):
+    """Sum of squares per array (contrib, AMP/LAMB helper)."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    return tuple(_apply_op("sum", _apply_op("square", a)) for a in arrays)
+
+
+def all_finite(*arrays, init_output=True):
+    """1.0 if all entries of all arrays are finite (contrib/all_finite.cc; AMP)."""
+    import jax.numpy as jnp
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = tuple(arrays[0])
+    ok = None
+    for a in arrays:
+        f = _apply_op("isfinite", a)
+        s = _apply_op("min", f)
+        ok = s if ok is None else ok * s
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# generated wrappers for every registered op not manually defined above
+# ---------------------------------------------------------------------------
+_MANUAL = set(dir(_this))
+
+
+def _install_wrappers():
+    for name in _registry.list_ops():
+        if name in _MANUAL or name.startswith("_random") or name == "_shuffle":
+            continue
+        op = _registry.get_op(name)
+        if not hasattr(_this, name):
+            setattr(_this, name, _registry.make_nd_wrapper(op))
+    # CamelCase aliases used by the legacy API
+    for legacy, new in [("FullyConnected", "FullyConnected"),
+                        ("Flatten", "flatten"), ("Concat", "concat"),
+                        ("Reshape", "reshape"), ("Embedding", "Embedding"),
+                        ("SoftmaxOutput", "SoftmaxOutput"), ("Pooling", "Pooling"),
+                        ("Activation", "Activation"), ("Convolution", "Convolution"),
+                        ("Deconvolution", "Deconvolution"), ("LayerNorm", "LayerNorm"),
+                        ("InstanceNorm", "InstanceNorm"), ("GroupNorm", "GroupNorm"),
+                        ("L2Normalization", "L2Normalization"), ("LeakyReLU", "leaky_relu"),
+                        ("UpSampling", "UpSampling"), ("CTCLoss", "CTCLoss")]:
+        if not hasattr(_this, legacy) and hasattr(_this, new):
+            setattr(_this, legacy, getattr(_this, new))
+
+
+_install_wrappers()
+
+from . import random  # noqa: E402  (nd.random namespace)
+from .utils import save, load  # noqa: E402
+
+waitall = None
+
+
+def waitall_impl():
+    """Block until all async work completes (MXNDArrayWaitAll analog)."""
+    import jax
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+waitall = waitall_impl

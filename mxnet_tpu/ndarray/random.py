@@ -1,0 +1,87 @@
+"""``nd.random`` namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from ..base import DTypes, current_context
+from ..ops.registry import apply_op as _apply_op
+from .. import random as _rng
+from .ndarray import NDArray
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _finish(out, ctx, out_arr):
+    if ctx is not None and out.context != ctx:
+        out = out.as_in_context(ctx)
+    if out_arr is not None:
+        out_arr._set_data(out.data)
+        return out_arr
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_uniform", _rng.take_key(), low=float(low), high=float(high),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_normal", _rng.take_key(), loc=float(loc), scale=float(scale),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
+    return normal(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_gamma", _rng.take_key(), alpha=float(alpha),
+                    beta=float(beta), shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_exponential", _rng.take_key(), lam=1.0 / float(scale),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_poisson", _rng.take_key(), lam=float(lam),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_negative_binomial", _rng.take_key(), k=k, p=float(p),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_randint", _rng.take_key(), low=int(low), high=int(high),
+                    shape=_shape(shape) or (1,), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kwargs):
+    return _apply_op("_sample_multinomial", data, _rng.take_key(),
+                     shape=_shape(shape) if shape else (), get_prob=get_prob,
+                     dtype=DTypes.canonical(dtype))
+
+
+def shuffle(data, **kwargs):
+    return _apply_op("_shuffle", data, _rng.take_key())
+
+
+def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
+    res = _apply_op("_random_bernoulli", _rng.take_key(), p=float(prob),
+                    shape=_shape(shape), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, None)
+
+
+seed = _rng.seed

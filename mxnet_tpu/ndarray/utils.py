@@ -1,0 +1,69 @@
+"""NDArray save/load (parity surface: python/mxnet/ndarray/utils.py:149/:222 over
+src/ndarray/ndarray.cc:1679 Save / :1802 Load).
+
+Format: a single-file container holding named (or indexed) arrays. The reference
+uses a custom binary layout with magic 0x112; here an NPZ container with a
+framework magic entry — same API (save/load of list or dict of NDArrays), portable
+across hosts, and streaming-friendly for checkpoints.
+"""
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+_MAGIC = "MXTPU0112"
+_BF16_SUFFIX = "::bf16"
+
+
+def _to_numpy(arr: NDArray):
+    np_arr = arr.asnumpy()
+    if str(arr.dtype) == "bfloat16":
+        return np_arr.view(onp.uint16) if np_arr.dtype.itemsize == 2 \
+            else np_arr.astype(onp.float32), True
+    return np_arr, False
+
+
+def save(fname: str, data) -> None:
+    """Save a list or str-keyed dict of NDArrays (ndarray/utils.py:222 parity)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        items = {f"__idx__{i}": a for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        items = dict(data)
+    else:
+        raise MXNetError("save expects NDArray, list, or dict of NDArrays")
+    payload = {}
+    for k, v in items.items():
+        if not isinstance(v, NDArray):
+            raise MXNetError(f"save: value for {k!r} is not an NDArray")
+        np_arr, is_bf16 = _to_numpy(v)
+        payload[k + (_BF16_SUFFIX if is_bf16 else "")] = np_arr
+    payload["__magic__"] = onp.asarray([_MAGIC])
+    with open(fname, "wb") as f:
+        onp.savez(f, **payload)
+
+
+def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    """Load NDArrays saved by ``save`` (ndarray/utils.py:149 parity)."""
+    import ml_dtypes
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != "__magic__"]
+        out = {}
+        for k in keys:
+            arr = z[k]
+            name = k
+            if k.endswith(_BF16_SUFFIX):
+                name = k[: -len(_BF16_SUFFIX)]
+                arr = arr.view(ml_dtypes.bfloat16)
+            out[name] = NDArray(arr)
+    if out and all(k.startswith("__idx__") for k in out):
+        return [out[f"__idx__{i}"] for i in range(len(out))]
+    return out

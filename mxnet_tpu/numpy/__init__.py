@@ -1,0 +1,218 @@
+"""NumPy-compatible frontend ``mx.np`` (parity: python/mxnet/numpy/, 13.8k LoC +
+numpy_dispatch_protocol.py).
+
+Functions dispatch through the same op registry as ``nd`` (so autograd records
+them); numpy-only names are registered lazily as thin jnp-backed ops — the
+analog of the reference's _npi generated wrappers over the new FFI (src/api/).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as _onp
+
+from ..base import Context, DTypes, MXNetError, current_context
+from ..ndarray.ndarray import NDArray as ndarray  # np.ndarray is the same tensor
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _reg
+from ..ops.registry import apply_op as _apply_op
+
+_this = _sys.modules[__name__]
+
+# numpy dtype singletons
+float32 = "float32"
+float64 = "float64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+int8 = "int8"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool_ = "bool_"
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+
+def array(object, dtype=None, ctx=None, device=None):
+    return NDArray(object, ctx=ctx or device, dtype=dtype)
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.zeros(shape, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.ones(shape, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.full(shape, fill_value, ctx=ctx or device, dtype=dtype)
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=ctx or device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.arange(start, stop, step, ctx=ctx or device,
+                         dtype=dtype or "float32")
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.linspace(start, stop, num, endpoint, ctx=ctx or device,
+                           dtype=dtype or "float32")
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    from .. import ndarray as nd_mod
+    return nd_mod.eye(N, M or 0, k, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def zeros_like(a, dtype=None):
+    out = _apply_op("zeros_like", a)
+    return out.astype(dtype) if dtype else out
+
+
+def ones_like(a, dtype=None):
+    out = _apply_op("ones_like", a)
+    return out.astype(dtype) if dtype else out
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, NDArray):
+        return a.astype(dtype) if dtype else a
+    return NDArray(a, dtype=dtype)
+
+
+def asnumpy(a):
+    return a.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# lazily-registered jnp-backed ops for numpy API names
+# ---------------------------------------------------------------------------
+_NP_FUNCS = [
+    "add", "subtract", "multiply", "divide", "true_divide", "mod", "power",
+    "maximum", "minimum", "fmax", "fmin", "hypot", "remainder", "floor_divide",
+    "negative", "positive", "absolute", "fabs", "sign", "exp", "expm1", "log",
+    "log2", "log10", "log1p", "sqrt", "cbrt", "square", "reciprocal", "sin",
+    "cos", "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh", "cosh",
+    "tanh", "arcsinh", "arccosh", "arctanh", "degrees", "radians", "floor",
+    "ceil", "rint", "trunc", "fix", "around", "round", "clip", "abs",
+    "sum", "prod", "mean", "std", "var", "amax", "amin", "max", "min", "argmax",
+    "argmin", "cumsum", "cumprod", "nansum", "nanprod", "nanmax", "nanmin",
+    "dot", "vdot", "inner", "outer", "tensordot", "matmul", "trace", "einsum",
+    "transpose", "swapaxes", "moveaxis", "rollaxis", "reshape", "ravel",
+    "squeeze", "expand_dims", "broadcast_to", "broadcast_arrays", "atleast_1d",
+    "atleast_2d", "atleast_3d", "concatenate", "stack", "vstack", "hstack",
+    "dstack", "column_stack", "split", "array_split", "hsplit", "vsplit",
+    "dsplit", "tile", "repeat", "flip", "fliplr", "flipud", "roll", "rot90",
+    "where", "take", "take_along_axis", "choose", "diag", "diagonal", "diagflat",
+    "tril", "triu", "sort", "argsort", "partition", "argpartition", "searchsorted",
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isnan", "isinf",
+    "isfinite", "isposinf", "isneginf", "signbit", "copysign", "nextafter",
+    "all", "any", "allclose", "isclose", "array_equal", "unique", "bincount",
+    "histogram", "digitize", "interp", "cross", "kron", "gcd", "lcm",
+    "percentile", "quantile", "median", "average", "cov", "corrcoef", "ptp",
+    "pad", "meshgrid", "indices", "unravel_index", "ravel_multi_index",
+    "nonzero", "flatnonzero", "count_nonzero", "argwhere", "ediff1d", "diff",
+    "gradient", "trapz", "exp2", "i0", "sinc", "nan_to_num", "real", "imag",
+    "convolve", "correlate", "heaviside", "float_power", "ldexp", "frexp",
+    "deg2rad", "rad2deg", "insert", "delete", "append", "resize", "trim_zeros",
+    "tri", "vander", "polyval",
+]
+
+_DIFFERENTIABLE_EXCEPTIONS = {
+    "argmax", "argmin", "argsort", "argpartition", "searchsorted", "nonzero",
+    "flatnonzero", "count_nonzero", "argwhere", "equal", "not_equal", "greater",
+    "greater_equal", "less", "less_equal", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "isnan", "isinf", "isfinite", "isposinf",
+    "isneginf", "signbit", "all", "any", "allclose", "isclose", "array_equal",
+    "unique", "bincount", "digitize", "unravel_index", "ravel_multi_index",
+}
+
+
+def _ensure_np_op(name):
+    opname = f"_np_{name}"
+    try:
+        return _reg.get_op(opname)
+    except MXNetError:
+        pass
+    import jax.numpy as jnp
+    base = getattr(jnp, name)
+
+    def fn(*arrays, **attrs):
+        return base(*arrays, **attrs)
+    fn.__name__ = opname
+    fn.__doc__ = f"numpy-compatible {name} (jnp-backed)"
+    _reg.register(opname, differentiable=name not in _DIFFERENTIABLE_EXCEPTIONS)(fn)
+    return _reg.get_op(opname)
+
+
+def _make_np_wrapper(name):
+    def wrapper(*args, **kwargs):
+        op = _ensure_np_op(name)
+        arrays = []
+        rest = list(args)
+        # leading array-likes are inputs; handle list-of-arrays first arg
+        if rest and isinstance(rest[0], (list, tuple)) and rest[0] and \
+                isinstance(rest[0][0], NDArray):
+            arrays = list(rest.pop(0))
+        else:
+            while rest and isinstance(rest[0], (NDArray, _onp.ndarray)):
+                a = rest.pop(0)
+                arrays.append(a if isinstance(a, NDArray) else NDArray(a))
+        # remaining positionals map onto keyword attrs by jnp signature
+        if rest:
+            import inspect
+            import jax.numpy as jnp
+            try:
+                sig = inspect.signature(getattr(jnp, name))
+                names = [p.name for p in sig.parameters.values()]
+                for i, val in enumerate(rest):
+                    kwargs[names[len(arrays) + i]] = val
+            except (ValueError, TypeError, IndexError):
+                raise MXNetError(f"np.{name}: unsupported positional arguments")
+        return _reg.invoke(op, arrays, kwargs)
+    wrapper.__name__ = name
+    return wrapper
+
+
+import warnings as _warnings
+
+for _name in _NP_FUNCS:
+    import jax.numpy as _jnp
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", DeprecationWarning)
+        _present = hasattr(_jnp, _name)
+    if not hasattr(_this, _name) and _present:
+        setattr(_this, _name, _make_np_wrapper(_name))
+
+from . import linalg    # noqa: E402,F401
+from . import random    # noqa: E402,F401
+
+
+def may_share_memory(a, b):
+    return False
+
+
+def shape(a):
+    return a.shape
+
+
+def ndim(a):
+    return a.ndim
+
+
+def size(a):
+    return a.size

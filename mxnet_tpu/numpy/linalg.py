@@ -1,0 +1,107 @@
+"""np.linalg (parity: python/mxnet/numpy/linalg.py over src/operator/numpy/linalg/)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _reg
+
+
+def _lazy(name, jfn_name, differentiable=True):
+    opname = f"_npl_{name}"
+    try:
+        return _reg.get_op(opname)
+    except MXNetError:
+        import jax.numpy as jnp
+        base = getattr(jnp.linalg, jfn_name)
+
+        def fn(*arrays, **attrs):
+            return base(*arrays, **attrs)
+        fn.__name__ = opname
+        _reg.register(opname, differentiable=differentiable)(fn)
+        return _reg.get_op(opname)
+
+
+def _call(name, jfn, *args, **kwargs):
+    op = _lazy(name, jfn)
+    arrays = [a for a in args if isinstance(a, NDArray)]
+    return _reg.invoke(op, arrays, kwargs)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _call("norm", "norm", x, ord=ord, axis=axis, keepdims=keepdims)
+
+
+def svd(a, full_matrices=True, compute_uv=True):
+    return _call("svd", "svd", a, full_matrices=full_matrices,
+                 compute_uv=compute_uv)
+
+
+def cholesky(a):
+    return _call("cholesky", "cholesky", a)
+
+
+def qr(a, mode="reduced"):
+    return _call("qr", "qr", a, mode=mode)
+
+
+def inv(a):
+    return _call("inv", "inv", a)
+
+
+def pinv(a, rcond=1e-15):
+    return _call("pinv", "pinv", a, rcond=rcond)
+
+
+def det(a):
+    return _call("det", "det", a)
+
+
+def slogdet(a):
+    return _call("slogdet", "slogdet", a)
+
+
+def solve(a, b):
+    return _call("solve", "solve", a, b)
+
+
+def lstsq(a, b, rcond="warn"):
+    return _call("lstsq", "lstsq", a, b, rcond=None if rcond == "warn" else rcond)
+
+
+def eig(a):
+    return _call("eig", "eig", a)
+
+
+def eigh(a, UPLO="L"):
+    return _call("eigh", "eigh", a, UPLO=UPLO)
+
+
+def eigvals(a):
+    return _call("eigvals", "eigvals", a)
+
+
+def eigvalsh(a, UPLO="L"):
+    return _call("eigvalsh", "eigvalsh", a, UPLO=UPLO)
+
+
+def matrix_rank(M, tol=None):
+    return _call("matrix_rank", "matrix_rank", M, tol=tol)
+
+
+def matrix_power(a, n):
+    return _call("matrix_power", "matrix_power", a, n=n)
+
+
+def multi_dot(arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out.dot(a)
+    return out
+
+
+def tensorinv(a, ind=2):
+    return _call("tensorinv", "tensorinv", a, ind=ind)
+
+
+def tensorsolve(a, b, axes=None):
+    return _call("tensorsolve", "tensorsolve", a, b, axes=axes)

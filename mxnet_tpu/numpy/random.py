@@ -1,0 +1,85 @@
+"""np.random (parity: python/mxnet/numpy/random.py)."""
+from __future__ import annotations
+
+from .. import random as _rng
+from ..ndarray import random as _nd_random
+
+seed = _rng.seed
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    return _nd_random.uniform(low, high, size, dtype, ctx or device, out)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    return _nd_random.normal(loc, scale, size, dtype, ctx or device, out)
+
+
+def randn(*size, **kwargs):
+    return _nd_random.randn(*size, **kwargs)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None, device=None,
+            out=None):
+    if high is None:
+        low, high = 0, low
+    return _nd_random.randint(low, high, size, dtype, ctx or device, out)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    key = _rng.take_key()
+    arr = a.data if isinstance(a, NDArray) else jnp.arange(a)
+    shape = () if size is None else ((size,) if isinstance(size, int) else size)
+    pdata = p.data if isinstance(p, NDArray) else p
+    return NDArray(jax.random.choice(key, arr, shape, replace, pdata))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return _nd_random.gamma(shape, scale, size, dtype, ctx, out)
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return _nd_random.exponential(scale, size, dtype, ctx, out)
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, out=None):
+    return _nd_random.poisson(lam, size, dtype, ctx, out)
+
+
+def shuffle(x):
+    out = _nd_random.shuffle(x)
+    x._set_data(out.data)
+    return None
+
+
+def permutation(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, int):
+        import jax
+        key = _rng.take_key()
+        return NDArray(jax.random.permutation(key, x))
+    return _nd_random.shuffle(x)
+
+
+def multinomial(n, pvals, size=None):
+    from ..ndarray.ndarray import NDArray
+    import jax
+    key = _rng.take_key()
+    pdata = pvals.data if isinstance(pvals, NDArray) else pvals
+    shape = () if size is None else ((size,) if isinstance(size, int) else size)
+    import jax.numpy as jnp
+    draws = jax.random.categorical(key, jnp.log(jnp.asarray(pdata)),
+                                   shape=shape + (n,))
+    counts = jax.vmap(lambda d: jnp.bincount(d, length=len(pdata)))(
+        draws.reshape(-1, n)) if draws.ndim > 1 else jnp.bincount(
+        draws, length=len(pdata))
+    return NDArray(counts.reshape(shape + (len(pdata),)))

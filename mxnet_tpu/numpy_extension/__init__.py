@@ -1,0 +1,144 @@
+"""``mx.npx`` — numpy-extension namespace (parity: python/mxnet/numpy_extension/):
+neural-net ops that are not part of the numpy standard, plus mode switches."""
+from __future__ import annotations
+
+from ..base import Context, cpu, gpu, tpu, num_gpus, current_context
+from ..ops.registry import apply_op as _apply_op
+from ..util import is_np_array, is_np_shape, set_np, reset_np, use_np
+from ..ndarray import (BatchNorm as batch_norm_wrapper, Dropout as _dropout)
+from ..ndarray.ndarray import NDArray
+
+
+def set_np_shape(active=True):
+    return set_np(shape=active, array=is_np_array())
+
+
+def relu(data):
+    return _apply_op("relu", data)
+
+
+def sigmoid(data):
+    return _apply_op("sigmoid", data)
+
+
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False,
+            dtype=None):
+    args = (data,) if length is None else (data, length)
+    return _apply_op("softmax", *args, axis=axis, temperature=temperature,
+                     use_length=use_length)
+
+
+def log_softmax(data, axis=-1, **kwargs):
+    return _apply_op("log_softmax", data, axis=axis)
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    return _apply_op("masked_softmax", data, mask, axis=axis,
+                     temperature=temperature)
+
+
+def activation(data, act_type="relu"):
+    return _apply_op("Activation", data, act_type=act_type)
+
+
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    return _apply_op("FullyConnected", x, weight, bias,
+                     num_hidden=num_hidden or weight.shape[0],
+                     no_bias=no_bias or bias is None, flatten=flatten)
+
+
+def convolution(data=None, weight=None, bias=None, **kwargs):
+    return _apply_op("Convolution", data, weight, bias, **kwargs)
+
+
+def pooling(data=None, **kwargs):
+    return _apply_op("Pooling", data, **kwargs)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5, momentum=0.9,
+               fix_gamma=False, use_global_stats=False, output_mean_var=False,
+               axis=1, **kwargs):
+    return batch_norm_wrapper(x, gamma, beta, running_mean, running_var, eps=eps,
+                              momentum=momentum, fix_gamma=fix_gamma,
+                              use_global_stats=use_global_stats, axis=axis)
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _apply_op("LayerNorm", data, gamma, beta, axis=axis, eps=eps)
+
+
+def dropout(data, p=0.5, axes=(), mode="training", **kwargs):
+    return _dropout(data, p=p, mode=mode, axes=axes)
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    return _apply_op("Embedding", data, weight,
+                     input_dim=input_dim or weight.shape[0],
+                     output_dim=output_dim or weight.shape[1])
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _apply_op("one_hot", data, depth=depth, on_value=on_value,
+                     off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _apply_op("pick", data, index, axis=axis, keepdims=keepdims, mode=mode)
+
+
+def reshape_like(lhs, rhs):
+    return lhs.reshape(rhs.shape)
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    return _apply_op("topk", data, axis=axis, k=k, ret_typ=ret_typ,
+                     is_ascend=is_ascend, dtype=dtype)
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    return _apply_op("arange_like", data, start=start, step=step, repeat=repeat,
+                     axis=axis)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    from ..ndarray import SequenceMask
+    return SequenceMask(data, sequence_length, use_sequence_length, value, axis)
+
+
+def rnn(data=None, parameters=None, state=None, state_cell=None, **kwargs):
+    from ..ndarray import RNN
+    return RNN(data, parameters, state, state_cell, **kwargs)
+
+
+def gamma(data):
+    return _apply_op("gamma", data)
+
+
+def gammaln(data):
+    return _apply_op("gammaln", data)
+
+
+def erf(data):
+    return _apply_op("erf", data)
+
+
+def erfinv(data):
+    return _apply_op("erfinv", data)
+
+
+def waitall():
+    from .. import ndarray as nd_mod
+    nd_mod.waitall()
+
+
+def load(fname):
+    from ..ndarray.utils import load as _load
+    return _load(fname)
+
+
+def save(fname, data):
+    from ..ndarray.utils import save as _save
+    return _save(fname, data)

@@ -1,0 +1,8 @@
+"""Operator library (the src/operator/ analog). Importing this package registers
+all built-in ops; additional families (pallas kernels, contrib) register lazily."""
+from . import registry
+from .registry import apply_op, get_op, list_ops, register
+from . import elemwise  # noqa: F401
+from . import tensor    # noqa: F401
+from . import nn        # noqa: F401
+from . import random_ops  # noqa: F401

@@ -1,0 +1,313 @@
+"""Elementwise, scalar, broadcast and reduction operators.
+
+Parity surface: src/operator/tensor/ (elemwise_unary_op*, elemwise_binary_op*,
+elemwise_binary_broadcast_op*, broadcast_reduce-inl.h) and src/operator/mshadow_op.h
+unary/binary maps. On TPU each op is a pure jnp/lax function; XLA fuses chains of
+these into single VPU kernels, replacing the reference's NVRTC pointwise fusion
+(src/operator/fusion/fused_op.cu:176).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_F32_EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# unary
+# ---------------------------------------------------------------------------
+def _unary(name, f, differentiable=True):
+    def fn(x):
+        return f(x)
+    fn.__name__ = name
+    fn.__doc__ = f"Elementwise {name} (src/operator/mshadow_op.h)."
+    register(name, differentiable=differentiable)(fn)
+    return fn
+
+
+_unary("identity", lambda x: x)
+_unary("negative", lambda x: -x)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("square", jnp.square)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("digamma", jax.scipy.special.digamma)
+_unary("floor", jnp.floor, differentiable=False)
+_unary("ceil", jnp.ceil, differentiable=False)
+_unary("round", jnp.round, differentiable=False)
+_unary("rint", jnp.rint, differentiable=False)
+_unary("trunc", jnp.trunc, differentiable=False)
+_unary("fix", jnp.trunc, differentiable=False)
+_unary("logical_not", jnp.logical_not, differentiable=False)
+_unary("isnan", jnp.isnan, differentiable=False)
+_unary("isinf", jnp.isinf, differentiable=False)
+_unary("isfinite", jnp.isfinite, differentiable=False)
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", lambda x: x / (1 + jnp.abs(x)))
+_unary("softrelu", jax.nn.softplus)  # log(1+exp(x)), reference name for softplus
+_unary("gelu", lambda x: jax.nn.gelu(x, approximate=False))
+_unary("gelu_tanh", lambda x: jax.nn.gelu(x, approximate=True))
+_unary("silu", jax.nn.silu)
+_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+_unary("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
+_unary("erf_inv", jax.scipy.special.erfinv)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("clip")
+def clip(x, *, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register("cast")
+def cast(x, *, dtype):
+    from ..base import DTypes
+    return x.astype(DTypes.jnp(dtype))
+
+
+@register("amp_cast")
+def amp_cast(x, *, dtype):
+    """AMP dtype cast (src/operator/tensor/amp_cast.cc); identity for int arrays."""
+    from ..base import DTypes
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(DTypes.jnp(dtype))
+
+
+@register("leaky_relu")
+def leaky_relu(x, *, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334):
+    """LeakyReLU family (src/operator/leaky_relu.cc): leaky/elu/selu/gelu supported;
+    rrelu falls back to leaky with mean slope (deterministic, matching inference)."""
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x >= 0, x, alpha * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        return jnp.where(x >= 0, x, 0.5 * (lower_bound + upper_bound) * x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("prelu")
+def prelu(x, gamma):
+    g = gamma.reshape((1, -1) + (1,) * (x.ndim - 2)) if gamma.ndim == 1 and x.ndim > 1 else gamma
+    return jnp.where(x >= 0, x, g * x)
+
+
+# ---------------------------------------------------------------------------
+# scalar ops (reference: _plus_scalar etc. in elemwise_binary_scalar_op*)
+# ---------------------------------------------------------------------------
+def _scalar_op(name, f):
+    def fn(x, *, scalar=1.0, reverse=False):
+        s = jnp.asarray(scalar, dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                        else jnp.result_type(x.dtype, type(scalar)))
+        return f(s, x) if reverse else f(x, s)
+    fn.__name__ = name
+    register(name)(fn)
+
+
+_scalar_op("_plus_scalar", lambda a, b: a + b)
+_scalar_op("_minus_scalar", lambda a, b: a - b)
+_scalar_op("_mul_scalar", lambda a, b: a * b)
+_scalar_op("_div_scalar", lambda a, b: a / b)
+_scalar_op("_mod_scalar", lambda a, b: a % b)
+_scalar_op("_power_scalar", lambda a, b: a ** b)
+_scalar_op("_maximum_scalar", jnp.maximum)
+_scalar_op("_minimum_scalar", jnp.minimum)
+_scalar_op("_hypot_scalar", jnp.hypot)
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast (reference: elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+def _binary(name, f, differentiable=True):
+    def fn(a, b):
+        return f(a, b)
+    fn.__name__ = name
+    register(name, differentiable=differentiable)(fn)
+
+
+_binary("broadcast_add", jnp.add)
+_binary("broadcast_sub", jnp.subtract)
+_binary("broadcast_mul", jnp.multiply)
+_binary("broadcast_div", jnp.divide)
+_binary("broadcast_mod", jnp.mod)
+_binary("broadcast_power", jnp.power)
+_binary("broadcast_maximum", jnp.maximum)
+_binary("broadcast_minimum", jnp.minimum)
+_binary("broadcast_hypot", jnp.hypot)
+_binary("broadcast_equal", lambda a, b: (a == b).astype(a.dtype), differentiable=False)
+_binary("broadcast_not_equal", lambda a, b: (a != b).astype(a.dtype), differentiable=False)
+_binary("broadcast_greater", lambda a, b: (a > b).astype(a.dtype), differentiable=False)
+_binary("broadcast_greater_equal", lambda a, b: (a >= b).astype(a.dtype), differentiable=False)
+_binary("broadcast_lesser", lambda a, b: (a < b).astype(a.dtype), differentiable=False)
+_binary("broadcast_lesser_equal", lambda a, b: (a <= b).astype(a.dtype), differentiable=False)
+_binary("broadcast_logical_and", lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+        differentiable=False)
+_binary("broadcast_logical_or", lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+        differentiable=False)
+_binary("broadcast_logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+        differentiable=False)
+# element-wise aliases (no broadcasting in reference; jnp broadcasts — superset)
+_binary("elemwise_add", jnp.add)
+_binary("elemwise_sub", jnp.subtract)
+_binary("elemwise_mul", jnp.multiply)
+_binary("elemwise_div", jnp.divide)
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("arctan2", jnp.arctan2)
+_binary("ldexp", lambda a, b: a * (2.0 ** b))
+
+
+@register("add_n")
+def add_n(*arrays):
+    """Sum of N arrays (src/operator/tensor/elemwise_sum.cc)."""
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+@register("smooth_l1")
+def smooth_l1(x, *, scalar=1.0):
+    s2 = scalar * scalar
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+def _acc_dtype(x):
+    """fp32 accumulation for reduced-precision inputs (MXNET_SAFE_ACCUMULATION)."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return x.dtype
+
+
+def _reduce(name, f, differentiable=True):
+    def fn(x, *, axis=None, keepdims=False, exclude=False):
+        ax = axis
+        if exclude and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else tuple(ax)
+            ax = tuple(i for i in range(x.ndim) if i not in
+                       tuple(a % x.ndim for a in axes))
+        acc = _acc_dtype(x)
+        out = f(x.astype(acc), axis=ax, keepdims=keepdims)
+        return out.astype(x.dtype) if acc != x.dtype and name not in ("argmax", "argmin") else out
+    fn.__name__ = name
+    register(name, differentiable=differentiable)(fn)
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("sum_axis", jnp.sum)
+
+
+@register("argmax", differentiable=False)
+def argmax(x, *, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register("argmin", differentiable=False)
+def argmin(x, *, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register("norm")
+def norm(x, *, ord=2, axis=None, keepdims=False):
+    acc = _acc_dtype(x)
+    xa = x.astype(acc)
+    if ord == 1:
+        out = jnp.sum(jnp.abs(xa), axis=axis, keepdims=keepdims)
+    elif ord == 2:
+        out = jnp.sqrt(jnp.sum(xa * xa, axis=axis, keepdims=keepdims))
+    else:
+        out = jnp.sum(jnp.abs(xa) ** ord, axis=axis, keepdims=keepdims) ** (1.0 / ord)
+    return out.astype(x.dtype)
+
+
+@register("moments")
+def moments(x, *, axes=None, keepdims=False):
+    mean = jnp.mean(x, axis=axes, keepdims=keepdims)
+    mk = mean if keepdims or axes is None else jnp.expand_dims(
+        mean, axes if isinstance(axes, int) else tuple(axes))
+    var = jnp.mean(jnp.square(x - mk), axis=axes, keepdims=keepdims)
+    return mean, var
+
+
+@register("cumsum")
+def cumsum(x, *, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def cumprod(x, *, axis=None, dtype=None):
+    return jnp.cumprod(x, axis=axis, dtype=dtype)
+
+
+@register("logsumexp")
+def logsumexp(x, *, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)

@@ -1,0 +1,703 @@
+"""Neural-network operators.
+
+Parity surface: src/operator/nn/ (convolution, fully_connected, pooling, batch_norm,
+layer_norm, group_norm, dropout, softmax-inl.h w/ fp32-accum dtype override:629-733,
+activation), src/operator/rnn-inl.h (monolithic RNN op), and the fork's fused
+attention ops src/operator/contrib/transformer.cc:650-828.
+
+TPU-native design: convolution/matmul map straight onto the MXU via
+lax.conv_general_dilated / dot_general; normalisations are fused by XLA; the RNN op
+is a lax.scan (compiled once, no per-step dispatch — the cuDNN-fused-RNN analog).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    return t if len(t) == n else t * n
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (nn/fully_connected.cc:254-344)
+# ---------------------------------------------------------------------------
+@register("FullyConnected", jit=True)
+def fully_connected(x, weight, bias=None, *, num_hidden=0, no_bias=False, flatten=True):
+    """y = x W^T + b. weight is (num_hidden, in_units) like the reference."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (nn/convolution.cc) — NCHW/OIHW like the reference
+# ---------------------------------------------------------------------------
+_CONV_DN = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", jit=True)
+def convolution(x, weight, bias=None, *, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=0, num_group=1, no_bias=False, layout=None):
+    nd = x.ndim - 2
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _CONV_DN[nd])
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    y = y.astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register("Deconvolution", jit=True)
+def deconvolution(x, weight, bias=None, *, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=0, num_group=1, no_bias=False,
+                  target_shape=None, layout=None):
+    """Transposed convolution. weight layout (in_c, out_c/groups, *k) as reference."""
+    nd = x.ndim - 2
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    adj = _tup(adj if adj is not None else 0, nd)
+    k = weight.shape[2:]
+    # conv_transpose via gradient-of-conv: use lax.conv_transpose with IOHW spec
+    dn = _CONV_DN[nd]
+    pads = []
+    for i in range(nd):
+        eff_k = (k[i] - 1) * dilate[i] + 1
+        pads.append((eff_k - 1 - pad[i], eff_k - 1 - pad[i] + adj[i]))
+    if num_group == 1:
+        y = lax.conv_transpose(
+            x, weight, strides=stride, padding=pads, rhs_dilation=dilate,
+            dimension_numbers=(dn[0], dn[1].replace("O", "X").replace("I", "O")
+                               .replace("X", "I"), dn[2]),
+            transpose_kernel=True)
+    else:
+        xs = jnp.split(x, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        y = jnp.concatenate([
+            lax.conv_transpose(xi, wi, strides=stride, padding=pads,
+                               rhs_dilation=dilate,
+                               dimension_numbers=(dn[0],
+                                                  dn[1].replace("O", "X").replace("I", "O").replace("X", "I"),
+                                                  dn[2]),
+                               transpose_kernel=True)
+            for xi, wi in zip(xs, ws)], axis=1)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Pooling (nn/pooling.cc)
+# ---------------------------------------------------------------------------
+@register("Pooling", jit=True)
+def pooling(x, *, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True, cudnn_off=False,
+            layout=None):
+    nd = x.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(x, axis=axes, keepdims=True)
+            if pool_type == "avg":
+                r = r / math.prod(x.shape[2:])
+            return r
+        raise ValueError(pool_type)
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride if stride is not None else kernel, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode output: pad on the high side so ceil-division sizes result
+        pads = [(0, 0), (0, 0)]
+        for i in range(nd):
+            in_sz = x.shape[2 + i]
+            out_sz = int(math.ceil((in_sz + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            needed = (out_sz - 1) * stride[i] + kernel[i] - in_sz - pad[i]
+            pads.append((pad[i], max(needed, pad[i])))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    # NB: init must be a weak-typed Python scalar — an array init stops XLA/JAX
+    # from matching the differentiable reduce_window_max/add primitives
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                              else 0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / math.prod(kernel)
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(x) ** 2, 0.0, lax.add, window, strides, pads)
+        return jnp.sqrt(s)
+    raise ValueError(pool_type)
+
+
+@register("UpSampling", jit=True)
+def upsampling(x, *, scale=2, sample_type="nearest", num_args=1):
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+
+
+@register("BilinearResize2D", jit=True)
+def bilinear_resize_2d(x, *, height=0, width=0, scale_height=None, scale_width=None,
+                       mode="size"):
+    n, c, h, w = x.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(x, (n, c, height, width), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# Activation (nn/activation.cc)
+# ---------------------------------------------------------------------------
+@register("Activation")
+def activation(x, *, act_type="relu"):
+    acts = {"relu": lambda v: jnp.maximum(v, 0), "sigmoid": jax.nn.sigmoid,
+            "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+            "softsign": lambda v: v / (1 + jnp.abs(v)), "log_sigmoid": jax.nn.log_sigmoid,
+            "mish": lambda v: v * jnp.tanh(jax.nn.softplus(v)),
+            "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+            "silu": jax.nn.silu}
+    return acts[act_type](x)
+
+
+# ---------------------------------------------------------------------------
+# softmax family (nn/softmax-inl.h; fp32 accumulation for bf16 inputs, :629-733)
+# ---------------------------------------------------------------------------
+def _softmax_core(x, axis, temperature, length, log: bool):
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    xa = x.astype(acc)
+    if temperature is not None and temperature != 1.0:
+        xa = xa / temperature
+    if length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        bshape = [1] * x.ndim
+        bshape[0] = x.shape[0]
+        mask = pos.reshape(shape) < length.astype(jnp.int32).reshape(bshape)
+        xa = jnp.where(mask, xa, -jnp.inf)
+        out = jax.nn.log_softmax(xa, axis=axis) if log else jax.nn.softmax(xa, axis=axis)
+        out = jnp.where(mask, out, 0.0)
+    else:
+        out = jax.nn.log_softmax(xa, axis=axis) if log else jax.nn.softmax(xa, axis=axis)
+    return out.astype(x.dtype)
+
+
+@register("softmax")
+def softmax(x, length=None, *, axis=-1, temperature=None, use_length=False, dtype=None):
+    return _softmax_core(x, axis, temperature, length if use_length else None, log=False)
+
+
+@register("log_softmax")
+def log_softmax(x, length=None, *, axis=-1, temperature=None, use_length=False, dtype=None):
+    return _softmax_core(x, axis, temperature, length if use_length else None, log=True)
+
+
+@register("masked_softmax")
+def masked_softmax(x, mask, *, axis=-1, temperature=1.0, normalize=True):
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    xa = x.astype(acc) / temperature
+    xa = jnp.where(mask.astype(bool), xa, -jnp.inf)
+    out = jax.nn.softmax(xa, axis=axis)
+    out = jnp.where(mask.astype(bool), out, 0.0)
+    return out.astype(x.dtype)
+
+
+@register("softmin")
+def softmin(x, *, axis=-1, temperature=None, dtype=None):
+    return _softmax_core(-x, axis, temperature, None, log=False)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(x, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@register("SoftmaxOutput")
+def softmax_output(x, label, *, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                   use_ignore=False, preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    """Legacy softmax+CE-gradient op (src/operator/softmax_output.cc). Forward is
+    softmax; gradient w.r.t. x is (p - onehot(label)) * grad_scale."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def f(xx, ll):
+        return jax.nn.softmax(xx.astype(jnp.float32), axis=axis).astype(xx.dtype)
+
+    def f_fwd(xx, ll):
+        p = jax.nn.softmax(xx.astype(jnp.float32), axis=axis)
+        return p.astype(xx.dtype), (p, ll)
+
+    def f_bwd(res, g):
+        p, ll = res
+        depth = p.shape[axis]
+        oh = jax.nn.one_hot(ll.astype(jnp.int32), depth, axis=axis, dtype=p.dtype)
+        if smooth_alpha:
+            oh = oh * (1 - smooth_alpha) + smooth_alpha / depth
+        dx = (p - oh)
+        if use_ignore:
+            keep = (ll != ignore_label).astype(p.dtype)
+            keep = jnp.expand_dims(keep, axis) if keep.ndim < p.ndim else keep
+            dx = dx * keep
+        scale = grad_scale
+        if normalization == "valid" and use_ignore:
+            valid = jnp.maximum(jnp.sum(ll != ignore_label).astype(p.dtype), 1.0)
+            scale = scale / valid
+        elif normalization == "batch":
+            scale = scale / p.shape[0]
+        return (dx * scale).astype(p.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(x, label)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=logp.dtype)
+    return -jnp.sum(oh * logp)
+
+
+# ---------------------------------------------------------------------------
+# normalisation (nn/batch_norm.cc, layer_norm.cc, group_norm.cc, instance_norm.cc,
+# l2_normalization.cc, lrn.cc)
+# ---------------------------------------------------------------------------
+@register("BatchNorm", jit=True)
+def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
+               cudnn_off=False, training=False):
+    """BatchNorm (nn/batch_norm.cc). Returns (out, new_moving_mean, new_moving_var);
+    stat write-back is handled by the caller (gluon layer / nd wrapper) — the
+    functional formulation of the reference's in-op aux-state mutation."""
+    acc = jnp.float32
+    xa = x.astype(acc)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if training and not use_global_stats:
+        mean = jnp.mean(xa, axis=red)
+        var = jnp.mean(jnp.square(xa - mean.reshape(bshape)), axis=red)
+        new_mean = momentum * moving_mean.astype(acc) + (1 - momentum) * mean
+        new_var = momentum * moving_var.astype(acc) + (1 - momentum) * var
+    else:
+        mean = moving_mean.astype(acc)
+        var = moving_var.astype(acc)
+        new_mean, new_var = mean, var
+    inv = lax.rsqrt(var + eps)
+    out = (xa - mean.reshape(bshape)) * (inv * gamma.astype(acc)).reshape(bshape) \
+        + beta.astype(acc).reshape(bshape)
+    return (out.astype(x.dtype), new_mean.astype(moving_mean.dtype),
+            new_var.astype(moving_var.dtype))
+
+
+@register("LayerNorm", jit=True)
+def layer_norm(x, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    acc = jnp.float32
+    xa = x.astype(acc)
+    mean = jnp.mean(xa, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(xa - mean), axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    out = (xa - mean) * inv * gamma.astype(acc).reshape(shape) \
+        + beta.astype(acc).reshape(shape)
+    out = out.astype(x.dtype)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("RMSNorm", jit=True)
+def rms_norm(x, gamma, *, axis=-1, eps=1e-6):
+    acc = jnp.float32
+    xa = x.astype(acc)
+    ms = jnp.mean(jnp.square(xa), axis=axis, keepdims=True)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return (xa * lax.rsqrt(ms + eps) * gamma.astype(acc).reshape(shape)).astype(x.dtype)
+
+
+@register("GroupNorm", jit=True)
+def group_norm(x, gamma, beta, *, num_groups=1, eps=1e-5, output_mean_var=False):
+    n, c = x.shape[:2]
+    g = num_groups
+    acc = jnp.float32
+    xa = x.astype(acc).reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xa.ndim))
+    mean = jnp.mean(xa, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xa - mean), axis=red, keepdims=True)
+    out = (xa - mean) * lax.rsqrt(var + eps)
+    out = out.reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    out = out * gamma.astype(acc).reshape(shape) + beta.astype(acc).reshape(shape)
+    return out.astype(x.dtype)
+
+
+@register("InstanceNorm", jit=True)
+def instance_norm(x, gamma, beta, *, eps=1e-3):
+    acc = jnp.float32
+    xa = x.astype(acc)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(xa, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xa - mean), axis=red, keepdims=True)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    out = (xa - mean) * lax.rsqrt(var + eps) * gamma.astype(acc).reshape(shape) \
+        + beta.astype(acc).reshape(shape)
+    return out.astype(x.dtype)
+
+
+@register("L2Normalization", jit=True)
+def l2_normalization(x, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x).reshape(x.shape[0], -1), axis=1) + eps)
+        return x / norm.reshape((-1,) + (1,) * (x.ndim - 1))
+    if mode == "channel":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+        return x / norm
+    if mode == "spatial":
+        norm = jnp.sqrt(jnp.sum(jnp.square(x).reshape(x.shape[0], x.shape[1], -1),
+                                axis=2) + eps)
+        return x / norm.reshape(x.shape[:2] + (1,) * (x.ndim - 2))
+    raise ValueError(mode)
+
+
+@register("LRN", jit=True)
+def lrn(x, *, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    sq = jnp.square(x)
+    half = nsize // 2
+    padded = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2))
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(nsize))
+    return x / jnp.power(knorm + alpha / nsize * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (nn/dropout.cc) — key passed explicitly; wrappers thread the global RNG
+# ---------------------------------------------------------------------------
+@register("Dropout")
+def dropout(x, key=None, *, p=0.5, mode="training", axes=(), training=False,
+            cudnn_off=False):
+    if not training or p <= 0 or key is None:
+        return x
+    shape = list(x.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(x.dtype) / keep
+    return x * mask
+
+
+# ---------------------------------------------------------------------------
+# Embedding (tensor/indexing_op.cc Embedding)
+# ---------------------------------------------------------------------------
+@register("Embedding", jit=True)
+def embedding(indices, weight, *, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# RNN — monolithic fused op (rnn-inl.h:419-1528). lax.scan == the cuDNN fused path.
+# ---------------------------------------------------------------------------
+def _gru_step(gates_x, gates_h, h_prev):
+    rx, zx, nx = jnp.split(gates_x, 3, axis=-1)
+    rh, zh, nh = jnp.split(gates_h, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    n = jnp.tanh(nx + r * nh)
+    return (1 - z) * n + z * h_prev
+
+
+def _single_layer_rnn(mode, x, h0, c0, wx, wh, bx, bh, reverse=False):
+    """x: (T, N, I); returns (T, N, H), hT, cT."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    gx_all = jnp.einsum("tni,gi->tng", x, wx) + bx  # (T, N, G*H)
+
+    def step(carry, gx):
+        h_prev, c_prev = carry
+        gh = jnp.matmul(h_prev, wh.T) + bh
+        if mode == "lstm":
+            i, f, g, o = jnp.split(gx + gh, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            c = f * c_prev + i * jnp.tanh(g)
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        if mode == "gru":
+            h = _gru_step(gx, gh, h_prev)
+            return (h, c_prev), h
+        h = jnp.tanh(gx + gh) if mode == "rnn_tanh" else jnp.maximum(gx + gh, 0)
+        return (h, c_prev), h
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), gx_all)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
+def _num_gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn_unpack_params(params, mode, num_layers, input_size, hidden, bidirectional):
+    """Unpack the reference's flat param vector layout (rnn-inl.h: all wx/wh then
+    all bx/bh, layer-major, direction-minor)."""
+    g = _num_gates(mode)
+    d = 2 if bidirectional else 1
+    offset = 0
+    weights = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else hidden * d
+        for _ in range(d):
+            wx = lax.dynamic_slice(params, (offset,), (g * hidden * in_sz,)).reshape(
+                g * hidden, in_sz)
+            offset += g * hidden * in_sz
+            wh = lax.dynamic_slice(params, (offset,), (g * hidden * hidden,)).reshape(
+                g * hidden, hidden)
+            offset += g * hidden * hidden
+            weights.append((wx, wh))
+    biases = []
+    for layer in range(num_layers):
+        for _ in range(d):
+            bx = lax.dynamic_slice(params, (offset,), (g * hidden,))
+            offset += g * hidden
+            bh = lax.dynamic_slice(params, (offset,), (g * hidden,))
+            offset += g * hidden
+            biases.append((bx, bh))
+    return [(wx, wh, bx, bh) for (wx, wh), (bx, bh) in zip(weights, biases)]
+
+
+def rnn_param_size(mode, num_layers, input_size, hidden, bidirectional):
+    g = _num_gates(mode)
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else hidden * d
+        size += d * (g * hidden * in_sz + g * hidden * hidden + 2 * g * hidden)
+    return size
+
+
+@register("RNN", jit=True)
+def rnn(x, params, state, state_cell=None, *, state_size=0, num_layers=1,
+        bidirectional=False, mode="lstm", p=0.0, state_outputs=True,
+        projection_size=None, use_sequence_length=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None):
+    """Monolithic RNN op (rnn-inl.h:419): x (T,N,I), flat params, state (L*D,N,H).
+    Entire multilayer bidirectional net compiles to nested lax.scans — the TPU
+    analog of the cuDNN fused RNN path (rnn.cu:47)."""
+    T, N, I = x.shape
+    H = state_size
+    d = 2 if bidirectional else 1
+    layers = rnn_unpack_params(params, mode, num_layers, I, H, bidirectional)
+    hs, cs = [], []
+    inp = x
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(d):
+            li = layer * d + direction
+            wx, wh, bx, bh = layers[li]
+            h0 = state[li]
+            c0 = state_cell[li] if (mode == "lstm" and state_cell is not None) \
+                else jnp.zeros_like(h0)
+            ys, hT, cT = _single_layer_rnn(mode, inp, h0, c0, wx, wh, bx, bh,
+                                           reverse=(direction == 1))
+            outs.append(ys)
+            hs.append(hT)
+            cs.append(cT)
+        inp = jnp.concatenate(outs, axis=-1) if d == 2 else outs[0]
+    out = inp
+    hT = jnp.stack(hs, axis=0)
+    if mode == "lstm":
+        cT = jnp.stack(cs, axis=0)
+        return out, hT, cT
+    return out, hT
+
+
+# ---------------------------------------------------------------------------
+# fused attention (contrib/transformer.cc:650-828 — the fork's headline ops)
+# ---------------------------------------------------------------------------
+@register("_contrib_interleaved_matmul_selfatt_qk", jit=True)
+def interleaved_matmul_selfatt_qk(qkv, *, heads):
+    """qkv: (L, N, 3*H*D) interleaved per head. Returns (N*heads, L, L) scaled QK^T
+    (transformer.cc:650)."""
+    L, N, _ = qkv.shape
+    D = qkv.shape[2] // (3 * heads)
+    q, k, _v = _deinterleave_qkv(qkv, heads, D)
+    scale = 1.0 / math.sqrt(D)
+    att = jnp.einsum("nhld,nhmd->nhlm", q * scale, k,
+                     preferred_element_type=jnp.float32).astype(qkv.dtype)
+    return att.reshape(N * heads, L, L)
+
+
+def _deinterleave_qkv(qkv, heads, D):
+    L, N, _ = qkv.shape
+    x = qkv.reshape(L, N, heads, 3, D)
+    q = x[:, :, :, 0].transpose(1, 2, 0, 3)  # (N, h, L, D)
+    k = x[:, :, :, 1].transpose(1, 2, 0, 3)
+    v = x[:, :, :, 2].transpose(1, 2, 0, 3)
+    return q, k, v
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt", jit=True)
+def interleaved_matmul_selfatt_valatt(qkv, att, *, heads):
+    """att: (N*heads, L, L) softmaxed; returns (L, N, H*D) (transformer.cc:691)."""
+    L, N, _ = qkv.shape
+    D = qkv.shape[2] // (3 * heads)
+    _q, _k, v = _deinterleave_qkv(qkv, heads, D)
+    a = att.reshape(N, heads, L, L)
+    out = jnp.einsum("nhlm,nhmd->nhld", a, v,
+                     preferred_element_type=jnp.float32).astype(qkv.dtype)
+    return out.transpose(2, 0, 1, 3).reshape(L, N, heads * D)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk", jit=True)
+def interleaved_matmul_encdec_qk(q, kv, *, heads):
+    Lq, N, HD = q.shape
+    D = HD // heads
+    qh = q.reshape(Lq, N, heads, D).transpose(1, 2, 0, 3)
+    Lk = kv.shape[0]
+    x = kv.reshape(Lk, N, heads, 2, D)
+    kh = x[:, :, :, 0].transpose(1, 2, 0, 3)
+    scale = 1.0 / math.sqrt(D)
+    att = jnp.einsum("nhld,nhmd->nhlm", qh * scale, kh,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return att.reshape(N * heads, Lq, Lk)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt", jit=True)
+def interleaved_matmul_encdec_valatt(kv, att, *, heads):
+    Lk, N, HD2 = kv.shape
+    D = HD2 // (2 * heads)
+    x = kv.reshape(Lk, N, heads, 2, D)
+    vh = x[:, :, :, 1].transpose(1, 2, 0, 3)
+    Lq = att.shape[1]
+    a = att.reshape(N, heads, Lq, Lk)
+    out = jnp.einsum("nhlm,nhmd->nhld", a, vh,
+                     preferred_element_type=jnp.float32).astype(kv.dtype)
+    return out.transpose(2, 0, 1, 3).reshape(Lq, N, heads * D)
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(x):
+    """x / sqrt(last_dim) (transformer.cc:828)."""
+    return x / math.sqrt(x.shape[-1])
+
+
+@register("multi_head_attention", jit=True)
+def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=False):
+    """Batched SDPA: q/k/v (N, L, H*D). Composite op; the flash-attention Pallas
+    kernel (ops/pallas/flash_attention.py) is used by models for long sequences."""
+    N, Lq, HD = q.shape
+    D = HD // heads
+    qh = q.reshape(N, Lq, heads, D).transpose(0, 2, 1, 3)
+    kh = k.reshape(N, -1, heads, D).transpose(0, 2, 1, 3)
+    vh = v.reshape(N, -1, heads, D).transpose(0, 2, 1, 3)
+    att = jnp.einsum("nhld,nhmd->nhlm", qh, kh,
+                     preferred_element_type=jnp.float32) / math.sqrt(D)
+    if causal:
+        Lk = kh.shape[2]
+        cm = jnp.tril(jnp.ones((Lq, Lk), bool))
+        att = jnp.where(cm, att, -jnp.inf)
+    if mask is not None:
+        att = jnp.where(mask.astype(bool), att, -jnp.inf)
+    p = jax.nn.softmax(att, axis=-1).astype(q.dtype)
+    out = jnp.einsum("nhlm,nhmd->nhld", p, vh,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out.transpose(0, 2, 1, 3).reshape(N, Lq, heads * D)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (nn/ctc_loss.cc)
+# ---------------------------------------------------------------------------
+@register("CTCLoss", jit=True)
+def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC forward loss via the standard log-alpha recursion under lax.scan.
+    data: (T, N, C) unnormalised; label: (N, L) classes (0 reserved for blank when
+    blank_label='first', matching the reference default)."""
+    T, N, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    lab = label.astype(jnp.int32)
+    if blank_label == "last":
+        lab = lab  # labels already 0-based
+    else:
+        pass
+    # extended label seq: blank, l1, blank, l2, ... blank  (length 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    lab_len = (label_lengths.astype(jnp.int32) if use_label_lengths and
+               label_lengths is not None else jnp.sum(
+                   (lab != blank) & (lab >= 0), axis=1).astype(jnp.int32))
+    dat_len = (data_lengths.astype(jnp.int32) if use_data_lengths and
+               data_lengths is not None else jnp.full((N,), T, jnp.int32))
+    ext_len = 2 * lab_len + 1
+    neg_inf = -1e30
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+    same = jnp.concatenate([jnp.zeros((N, 2), bool),
+                            ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        a1 = alpha
+        a2 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a3 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a3 = jnp.where(same, neg_inf, a3)
+        m = jnp.maximum(jnp.maximum(a1, a2), a3)
+        new = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m) + jnp.exp(a3 - m) + 1e-37)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new = new + emit
+        new = jnp.where((t < dat_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    idx_last = ext_len - 1
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a_last, a_prev)
+    ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+    return (-ll).astype(data.dtype)

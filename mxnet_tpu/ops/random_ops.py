@@ -1,0 +1,94 @@
+"""Random sampling operators (src/operator/random/sample_op.cc family).
+
+TPU-native: threefry counter-based PRNG (the hardware-friendly generator) with the
+key threaded explicitly — the functional analog of the reference's per-device
+generator states (include/mxnet/random_generator.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import DTypes
+from .registry import register
+
+
+def _dt(dtype):
+    return DTypes.jnp(dtype or "float32")
+
+
+@register("_random_uniform", differentiable=False)
+def random_uniform(key, *, low=0.0, high=1.0, shape=(), dtype=None):
+    return jax.random.uniform(key, shape, _dt(dtype), minval=low, maxval=high)
+
+
+@register("_random_normal", differentiable=False)
+def random_normal(key, *, loc=0.0, scale=1.0, shape=(), dtype=None):
+    return loc + scale * jax.random.normal(key, shape, _dt(dtype))
+
+
+@register("_random_gamma", differentiable=False)
+def random_gamma(key, *, alpha=1.0, beta=1.0, shape=(), dtype=None):
+    return jax.random.gamma(key, alpha, shape, _dt(dtype)) * beta
+
+
+@register("_random_exponential", differentiable=False)
+def random_exponential(key, *, lam=1.0, shape=(), dtype=None):
+    return jax.random.exponential(key, shape, _dt(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False)
+def random_poisson(key, *, lam=1.0, shape=(), dtype=None):
+    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False)
+def random_negative_binomial(key, *, k=1, p=1.0, shape=(), dtype=None):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * (1 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+
+
+@register("_random_randint", differentiable=False)
+def random_randint(key, *, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, shape, low, high, DTypes.jnp(dtype))
+
+
+@register("_random_bernoulli", differentiable=False)
+def random_bernoulli(key, *, p=0.5, shape=(), dtype=None):
+    return jax.random.bernoulli(key, p, shape).astype(_dt(dtype))
+
+
+@register("_sample_multinomial", differentiable=False)
+def sample_multinomial(data, key, *, shape=(), get_prob=False, dtype="int32"):
+    """Sample from categorical distributions given probabilities (rows)."""
+    n = shape if isinstance(shape, int) else (shape[0] if shape else 1)
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+    if isinstance(shape, tuple) and not shape:
+        out = out.squeeze(-1) if data.ndim > 1 else out[0]
+    out = out.astype(DTypes.jnp(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.astype(jnp.int32).reshape(data.shape[0], -1) if data.ndim > 1
+            else out.astype(jnp.int32).reshape(1, -1), axis=-1)
+        return out, lp.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", differentiable=False)
+def shuffle(data, key):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", differentiable=False)
+def sample_unique_zipfian(key, *, range_max=1, shape=()):
+    n = shape[1] if isinstance(shape, tuple) and len(shape) > 1 else shape
+    u = jax.random.uniform(key, shape)
+    out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int32)
+    return jnp.minimum(out, range_max - 1)

@@ -1,0 +1,184 @@
+"""Operator registry + imperative dispatch.
+
+TPU-native re-design of the reference op machinery:
+  - reference: 1319 ``NNVM_REGISTER_OP`` sites with FCompute/FInferShape/FGradient attrs
+    (include/mxnet/op_attr_types.h:218-340) dispatched by ``Imperative::Invoke``
+    (src/imperative/imperative.cc:98) onto the threaded engine.
+  - here: each op is a pure JAX function (shape/dtype inference and fusion delegated to
+    XLA tracing — the FInferShape/FInferType passes are subsumed by jax abstract eval;
+    FGradient is subsumed by jax.vjp). ``invoke`` is the ``MXImperativeInvokeEx``
+    analog: unwrap → execute (async on the PJRT stream) → wrap → tape-record.
+
+Ops declare arrays as positional parameters and attributes as keyword-only parameters;
+the public ``nd``/``np`` wrappers are generated from the signature, mirroring how the
+reference generates Python wrappers from the C op registry (python/mxnet/_ctypes/ndarray.py:64).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]
+
+_OPS: Dict[str, "Op"] = {}
+_JIT_CACHE: Dict[Tuple, Callable] = {}
+_JIT_LOCK = threading.Lock()
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    fn : callable(*jax_arrays, **attrs) -> jax array | tuple of arrays
+        Pure function; must be traceable by JAX.
+    differentiable : bool
+        False for ops with no meaningful gradient (random samplers, int ops);
+        such ops are not recorded on the autograd tape.
+    jit : bool
+        If True the eager path compiles+caches the op per (attrs, avals) signature —
+        the analog of the reference's CachedOp per-signature executable cache.
+    """
+
+    __slots__ = ("name", "fn", "differentiable", "jit", "num_inputs", "attr_names",
+                 "accepts_var_inputs")
+
+    def __init__(self, name: str, fn: Callable, differentiable: bool = True,
+                 jit: bool = False):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.jit = jit
+        sig = inspect.signature(fn)
+        self.attr_names = tuple(p.name for p in sig.parameters.values()
+                                if p.kind == inspect.Parameter.KEYWORD_ONLY)
+        pos = [p for p in sig.parameters.values()
+               if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                             inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+        self.accepts_var_inputs = any(
+            p.kind == inspect.Parameter.VAR_POSITIONAL for p in sig.parameters.values())
+        self.num_inputs = len(pos)
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+def register(name: Optional[str] = None, differentiable: bool = True, jit: bool = False):
+    """Register an operator implementation (NNVM_REGISTER_OP analog)."""
+    def deco(fn):
+        opname = name or fn.__name__
+        if opname in _OPS:
+            raise MXNetError(f"op {opname!r} already registered")
+        _OPS[opname] = Op(opname, fn, differentiable=differentiable, jit=jit)
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Op:
+    if name not in _OPS:
+        raise MXNetError(f"unknown op {name!r}")
+    return _OPS[name]
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _freeze(v):
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
+    """Return callable(*jax_arrays) for this (op, attrs); jitted+cached if op.jit."""
+    if not op.jit:
+        return functools.partial(op.fn, **attrs) if attrs else op.fn
+    key = (op.name, _freeze(attrs))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        import jax
+        with _JIT_LOCK:
+            fn = _JIT_CACHE.get(key)
+            if fn is None:
+                base = functools.partial(op.fn, **attrs) if attrs else op.fn
+                fn = jax.jit(base)
+                _JIT_CACHE[key] = fn
+    return fn
+
+
+def _colocate(jax_inputs, ctx):
+    """Move raw auxiliary arrays (e.g. PRNG keys) onto the op's device so mixed
+    placements never reach the compiler (eager only; tracers pass through)."""
+    import jax
+    out = []
+    target = None
+    for a in jax_inputs:
+        if isinstance(a, jax.Array) and not isinstance(
+                a, jax.core.Tracer):
+            try:
+                devs = a.devices()
+            except Exception:
+                out.append(a)
+                continue
+            if target is None:
+                target = ctx.jax_device()
+            if devs != {target}:
+                a = jax.device_put(a, target)
+        out.append(a)
+    return out
+
+
+def invoke(op: Op, inputs: Sequence, attrs: Dict[str, Any]):
+    """Imperative::Invoke analog. `inputs` are NDArrays; returns NDArray or tuple."""
+    from ..ndarray.ndarray import NDArray, _wrap_output
+    from .. import autograd
+
+    jax_inputs = [x.data if isinstance(x, NDArray) else x for x in inputs]
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            ctx = x.context
+            break
+    if ctx is not None:
+        jax_inputs = _colocate(jax_inputs, ctx)
+    if ctx is None:
+        # no array input pins a device (e.g. samplers): honor the default context
+        from ..base import current_context
+        from .. import tracing
+        ctx = current_context()
+        if tracing.current() is None:
+            import jax
+            with jax.default_device(ctx.jax_device()):
+                out = _executor(op, attrs)(*jax_inputs)
+        else:
+            out = _executor(op, attrs)(*jax_inputs)
+    else:
+        out = _executor(op, attrs)(*jax_inputs)
+    outputs = _wrap_output(out, ctx)
+
+    if op.differentiable and autograd.is_recording():
+        autograd._record_op(op, attrs, list(inputs), outputs)
+    return outputs
+
+
+def apply_op(name: str, *inputs, **attrs):
+    """Call a registered op by name on NDArrays."""
+    return invoke(get_op(name), inputs, attrs)
+
+
+def make_nd_wrapper(op: Op) -> Callable:
+    """Generate the public frontend wrapper for an op (generated-wrapper analog)."""
+    def wrapper(*args, **kwargs):
+        # split leading array args from attrs; allow arrays passed by keyword too
+        return invoke(op, args, kwargs)
+    wrapper.__name__ = op.name
+    wrapper.__qualname__ = op.name
+    wrapper.__doc__ = op.fn.__doc__
+    return wrapper

@@ -1,0 +1,525 @@
+"""Shape-manipulation, indexing, ordering and linalg operators.
+
+Parity surface: src/operator/tensor/ (matrix_op.cc reshape/transpose/slice family,
+indexing_op.cc take/gather_nd/scatter_nd/one_hot, ordering_op.cc topk/sort/argsort,
+init_op.cc, dot-inl.h, la_op.cc) — all lowered to single XLA HLO ops on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+@register("reshape")
+def reshape(x, *, shape, reverse=False):
+    """Reshape with the reference's special codes 0 (copy dim), -1 (infer),
+    -2 (copy rest), -3 (merge two), -4 (split) — matrix_op.cc Reshape."""
+    shape = tuple(shape)
+    if not any(s in (0, -2, -3, -4) for s in shape):
+        return jnp.reshape(x, shape)
+    src = list(x.shape)
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    return jnp.reshape(x, tuple(out))
+
+
+@register("transpose")
+def transpose(x, *, axes=None):
+    return jnp.transpose(x, axes)
+
+
+@register("swapaxes")
+def swapaxes(x, *, dim1=0, dim2=1):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("flatten")
+def flatten(x):
+    """Collapse all but the first axis (matrix_op.cc Flatten)."""
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("expand_dims")
+def expand_dims(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(x, *, shape):
+    shape = tuple(d if s == 0 else s for s, d in zip(shape, x.shape)) \
+        if len(shape) == x.ndim else tuple(shape)
+    return jnp.broadcast_to(x, shape)
+
+
+@register("broadcast_axis")
+def broadcast_axis(x, *, axis, size):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("concat")
+def concat(*arrays, dim=1):
+    return jnp.concatenate(arrays, axis=dim)
+
+
+@register("stack")
+def stack(*arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("split")
+def split(x, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("split_v2")
+def split_v2(x, *, indices_or_sections, axis=0, squeeze_axis=False):
+    if isinstance(indices_or_sections, (list, tuple)):
+        parts = jnp.split(x, list(indices_or_sections), axis=axis)
+    else:
+        parts = jnp.split(x, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice")
+def slice_op(x, *, begin, end, step=None):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis")
+def slice_axis(x, *, axis, begin, end):
+    if end is None or end == 0 and begin > 0:
+        end = x.shape[axis]
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(x, shape_like, *, axes=None):
+    axes = range(x.ndim) if not axes else axes
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return x[tuple(idx)]
+
+
+@register("_getitem")
+def _getitem(x, *, key):
+    return x[key]
+
+
+@register("reverse")
+def reverse(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register("tile")
+def tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+@register("repeat")
+def repeat(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("pad")
+def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
+    """Pad (src/operator/pad.cc): pad_width is the flat 2*ndim tuple as in the
+    reference; mode constant/edge/reflect."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register("depth_to_space")
+def depth_to_space(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("diag")
+def diag(x, *, k=0):
+    return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def size_array(x):
+    import numpy as onp
+    return jnp.asarray([int(onp.prod(x.shape))], dtype=jnp.int32)
+
+
+@register("where")
+def where(cond, a, b):
+    return jnp.where(cond.astype(bool) if cond.dtype != jnp.bool_ else cond, a, b)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+@register("take")
+def take(x, indices, *, axis=0, mode="clip"):
+    """Gather along axis (indexing_op.cc Take); modes clip/wrap like the reference."""
+    idx = indices.astype(jnp.int32)
+    return jnp.take(x, idx, axis=axis, mode=mode)
+
+
+@register("batch_take")
+def batch_take(x, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
+
+
+@register("pick")
+def pick(x, indices, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(indices.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd")
+def gather_nd(x, indices):
+    """gather_nd (indexing_op.cc): indices shape (M, ...) indexes first M dims."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return x[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, data, indices, *, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("index_add")
+def index_add(lhs, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("index_copy")
+def index_copy(old, idx, new):
+    return old.at[idx.astype(jnp.int32)].set(new)
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import DTypes
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=DTypes.jnp(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("boolean_mask_dense")
+def boolean_mask_dense(data, mask, *, axis=0):
+    """Dense analog of boolean_mask (contrib): zero out unmasked rows. The
+    shape-dynamic true boolean_mask lives in the numpy frontend (host fallback)."""
+    m = mask.astype(data.dtype)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return data * m.reshape(shape)
+
+
+@register("sequence_mask")
+def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0,
+                  axis=0):
+    """SequenceMask (src/operator/sequence_mask.cc): data is (seq, batch, ...) when
+    axis=0 or (batch, seq, ...) when axis=1."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_axis, batch_axis = (0, 1) if axis == 0 else (1, 0)
+    seq_len = data.shape[seq_axis]
+    pos = jnp.arange(seq_len)
+    shape = [1] * data.ndim
+    shape[seq_axis] = seq_len
+    pos = pos.reshape(shape)
+    sl_shape = [1] * data.ndim
+    sl_shape[batch_axis] = data.shape[batch_axis]
+    sl = sequence_length.astype(jnp.int32).reshape(sl_shape)
+    return jnp.where(pos < sl, data, jnp.asarray(value, data.dtype))
+
+
+@register("sequence_last")
+def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    seq_axis = axis
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[seq_axis] - 1, axis=seq_axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    dmoved = jnp.moveaxis(data, seq_axis, 0)  # (seq, batch, ...)
+    return jnp.take_along_axis(
+        dmoved, idx.reshape((1, -1) + (1,) * (dmoved.ndim - 2)), axis=0)[0]
+
+
+@register("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    d = jnp.moveaxis(data, axis, 0)
+    seq_len = d.shape[0]
+    pos = jnp.arange(seq_len)[:, None]
+    sl = sequence_length.astype(jnp.int32)[None, :]
+    rev_idx = jnp.where(pos < sl, sl - 1 - pos, pos)
+    out = jnp.take_along_axis(d, rev_idx.reshape(rev_idx.shape + (1,) * (d.ndim - 2)),
+                              axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc via CUB; here XLA sort)
+# ---------------------------------------------------------------------------
+@register("sort")
+def sort(x, *, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import DTypes
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(DTypes.jnp(dtype))
+
+
+@register("topk", differentiable=False)
+def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import DTypes
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(DTypes.jnp(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        mask = jnp.zeros(xm.shape, x.dtype)
+        mask = mask.at[..., :].set(0)
+        oh = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+                            xm.shape[-1], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(oh, -1, axis)
+    raise ValueError(ret_typ)
+
+
+@register("unique", differentiable=False)
+def unique(x):
+    return jnp.unique(x, size=x.size, fill_value=x.reshape(-1)[-1])
+
+
+# ---------------------------------------------------------------------------
+# init / ranges
+# ---------------------------------------------------------------------------
+@register("arange_like", differentiable=False)
+def arange_like(x, *, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = int(jnp.size(x)) if not hasattr(x, "shape") else int(
+            jnp.prod(jnp.asarray(x.shape)))
+        import numpy as onp
+        n = int(onp.prod(x.shape))
+        out = start + step * jnp.arange(n, dtype=x.dtype)
+        return out.reshape(x.shape)
+    n = x.shape[axis]
+    return start + step * jnp.arange(n, dtype=x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# linalg (reference: tensor/dot-inl.h, la_op.cc via LAPACK → XLA linalg)
+# ---------------------------------------------------------------------------
+@register("dot", jit=True)
+def dot(a, b, *, transpose_a=False, transpose_b=False):
+    """dot (tensor/dot-inl.h): 2-D matmul contract last/first axes; MXU-native."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", jit=True)
+def batch_dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register("matmul", jit=True)
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = (out[:, None, :] * a[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("linalg_gemm2", jit=True)
+def linalg_gemm2(a, b, *, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_gemm", jit=True)
+def linalg_gemm(a, b, c, *, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register("linalg_potrf")
+def linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_trsm")
+def linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+    if rightside:
+        # solve X A = alpha B  =>  A^T X^T = alpha B^T
+        xt = jsl.solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+                                  lower=not lower if transpose else not lower,
+                                  trans=0)
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(a, alpha * b, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm")
+def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+
+
+@register("linalg_syrk")
+def linalg_syrk(a, *, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag")
+def linalg_extractdiag(a, *, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag")
+def linalg_makediag(a, *, offset=0):
+    return jax.vmap(jnp.diag, in_axes=0)(a.reshape(-1, a.shape[-1])).reshape(
+        a.shape[:-1] + (a.shape[-1] + abs(offset),) * 2) if a.ndim > 1 else jnp.diag(a, k=offset)
+
+
+@register("linalg_svd")
+def linalg_svd(a):
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return u, s, vt
+
+
+@register("linalg_inverse")
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det")
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet")
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet

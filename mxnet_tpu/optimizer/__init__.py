@@ -1,0 +1,11 @@
+"""Optimizer package (parity: python/mxnet/optimizer/)."""
+from .optimizer import (Optimizer, SGD, Adam, AdamW, NAG, RMSProp, AdaGrad,
+                        AdaDelta, Ftrl, FTML, LAMB, LARS, Signum, SGLD, DCASGD,
+                        create, register, Updater, get_updater)
+from . import lr_scheduler
+from .lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
+                           PolyScheduler, CosineScheduler)
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
+           "AdaDelta", "Ftrl", "FTML", "LAMB", "LARS", "Signum", "SGLD", "DCASGD",
+           "create", "register", "Updater", "get_updater", "lr_scheduler"]

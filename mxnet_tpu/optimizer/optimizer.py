@@ -1,0 +1,565 @@
+"""Optimizers (parity: python/mxnet/optimizer/optimizer.py:29 Optimizer base +
+registry, multi-precision, and the per-algorithm files sgd.py/adam.py/lamb.py/...;
+reference kernels: src/operator/optimizer_op.cc).
+
+TPU-native: each optimizer's update rule is a pure JAX function jitted once per
+(shapes, dtypes, hyper-set) signature with donated weight/state buffers — the
+analog of the reference's fused optimizer ops, with XLA doing the fusion. The
+multi-tensor fused paths (multi_sgd/multi_lamb, contrib) are expressed by updating
+all parameters inside one jit (see Trainer.allreduce+step and parallel.train_step).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as onp
+
+from ..base import Registry, MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
+           "AdaDelta", "Ftrl", "FTML", "LAMB", "LARS", "Signum", "SGLD", "DCASGD",
+           "create", "register", "Updater", "get_updater"]
+
+_REG = Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass.__name__)(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer. update() operates per-parameter like the reference; the
+    jitted rule is shared across parameters of the same shape/dtype."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # -- hyper-parameter plumbing (optimizer.py parity) ---------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= getattr(self.param_dict[index], "lr_mult", 1.0)
+        else:
+            lr *= self.lr_mult.get(index, self.lr_mult.get(self.idx2name.get(index, ""), 1.0))
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        else:
+            wd *= self.wd_mult.get(index, self.wd_mult.get(self.idx2name.get(index, ""), 1.0))
+        return wd
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        import jax.numpy as jnp
+        if self.multi_precision and weight.dtype in (jnp.bfloat16, jnp.float16):
+            master = NDArray(weight.data.astype(jnp.float32), ctx=weight.context)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -------------------------------------------------------------
+    def _rule(self, w, g, state, lr, wd, t):
+        """Pure update rule: returns (new_w, new_state). Subclasses implement."""
+        raise NotImplementedError
+
+    def _jitted_rule(self):
+        key = self.__class__.__name__
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            import jax
+            fn = jax.jit(self._rule, donate_argnums=(0, 2))
+            self._jit_cache[key] = fn
+        return fn
+
+    def _preprocess_grad(self, g):
+        import jax.numpy as jnp
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def update(self, index, weight, grad, state):
+        self._update_multi_precision(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._update_multi_precision(index, weight, grad, state)
+
+    def _update_multi_precision(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        if isinstance(index, (list, tuple)):  # multi-tensor form
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_multi_precision(i, w, g, s)
+            return
+        self._update_count(index)
+        # lr/wd/t passed as traced scalars so stepping never recompiles
+        lr = jnp.float32(self._get_lr(index))
+        wd = jnp.float32(self._get_wd(index))
+        t = jnp.float32(self._index_update_count[index])
+        use_master = (isinstance(state, tuple) and len(state) == 2
+                      and isinstance(state[0], NDArray)
+                      and state[0].dtype != weight.dtype)
+        if use_master:
+            master, inner = state
+            g = self._preprocess_grad(grad.data.astype(jnp.float32))
+            new_w, new_state = self._jitted_rule()(
+                master.data, g, _unwrap_state(inner), lr, wd, t)
+            master._set_data(new_w)
+            weight._set_data(new_w.astype(weight.dtype))
+            _rewrap_state(inner, new_state)
+        else:
+            g = self._preprocess_grad(grad.data.astype(weight.data.dtype))
+            new_w, new_state = self._jitted_rule()(
+                weight.data, g, _unwrap_state(state), lr, wd, t)
+            weight._set_data(new_w)
+            _rewrap_state(state, new_state)
+
+
+def _unwrap_state(state):
+    if state is None:
+        return None
+    if isinstance(state, NDArray):
+        return state.data
+    if isinstance(state, (list, tuple)):
+        return tuple(_unwrap_state(s) for s in state)
+    return state
+
+
+def _rewrap_state(state, new_state):
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._set_data(new_state)
+        return
+    if isinstance(state, (list, tuple)):
+        for s, ns in zip(state, new_state):
+            _rewrap_state(s, ns)
+
+
+def _zeros_like_nd(weight, dtype=None):
+    import jax.numpy as jnp
+    return NDArray(jnp.zeros(weight.shape, dtype or weight.data.dtype),
+                   ctx=weight.context)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (optimizer_op.cc sgd_update/sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like_nd(weight)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum == 0.0:
+            return w - lr * g, None
+        mom = self.momentum * state - lr * g
+        return w + mom, mom
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (optimizer_op.cc nag_mom_update)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return _zeros_like_nd(weight)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        mom = self.momentum * state + g
+        return w - lr * (g + self.momentum * mom), mom
+
+
+@register
+class Adam(Optimizer):
+    """Adam (optimizer_op.cc adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+        dt = jnp.float32 if weight.data.dtype in (jnp.bfloat16, jnp.float16) \
+            else weight.data.dtype
+        return (_zeros_like_nd(weight, dt), _zeros_like_nd(weight, dt))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        m, v = state
+        g32 = g.astype(m.dtype) + wd * w.astype(m.dtype)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        corrected_lr = lr * math.sqrt(coef2) / coef1 if isinstance(t, int) \
+            else lr * jnp.sqrt(coef2) / coef1
+        upd = corrected_lr * m / (jnp.sqrt(v) + self.epsilon)
+        return (w.astype(m.dtype) - upd).astype(w.dtype), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay Adam (contrib adamw.cc)."""
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        m, v = state
+        g32 = g.astype(m.dtype)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        corrected_lr = lr * jnp.sqrt(coef2) / coef1
+        upd = corrected_lr * m / (jnp.sqrt(v) + self.epsilon) + lr * wd * w.astype(m.dtype)
+        return (w.astype(m.dtype) - upd).astype(w.dtype), (m, v)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (optimizer_op.cc rmsprop_update; centered variant rmspropalex)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like_nd(weight), _zeros_like_nd(weight), _zeros_like_nd(weight))
+        return (_zeros_like_nd(weight),)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        g = g + wd * w
+        if self.centered:
+            n, mean_g, mom = state
+            n = self.rho * n + (1 - self.rho) * jnp.square(g)
+            mean_g = self.rho * mean_g + (1 - self.rho) * g
+            mom = self.momentum * mom - lr * g / jnp.sqrt(n - jnp.square(mean_g) + self.epsilon)
+            return w + mom, (n, mean_g, mom)
+        (n,) = state
+        n = self.rho * n + (1 - self.rho) * jnp.square(g)
+        return w - lr * g / (jnp.sqrt(n) + self.epsilon), (n,)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like_nd(weight)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        g = g + wd * w
+        hist = state + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps), hist
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        acc_g, acc_delta = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_delta = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        return w - delta, (acc_g, acc_delta)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        z, n = state
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0).astype(w.dtype)
+        return new_w, (z, n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), _zeros_like_nd(weight), _zeros_like_nd(weight))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        d, v, z = state
+        g = g + wd * w
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * w
+        return -z / d_t, (d_t, v, z)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (contrib multi_lamb.cc / lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        import jax.numpy as jnp
+        dt = jnp.float32 if weight.data.dtype in (jnp.bfloat16, jnp.float16) \
+            else weight.data.dtype
+        return (_zeros_like_nd(weight, dt), _zeros_like_nd(weight, dt))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        m, v = state
+        w32 = w.astype(m.dtype)
+        g32 = g.astype(m.dtype)
+        m = self.beta1 * m + (1 - self.beta1) * g32
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        if self.bias_correction:
+            mhat = m / (1 - self.beta1 ** t)
+            vhat = v / (1 - self.beta2 ** t)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w32
+        w_norm = jnp.linalg.norm(w32)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where(jnp.logical_and(w_norm > 0, r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        return (w32 - lr * ratio * r).astype(w.dtype), (m, v)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (contrib multi_lars.cc)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like_nd(weight)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+        g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+        local_lr = jnp.where(
+            jnp.logical_and(w_norm > 0, g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * w
+        mom = self.momentum * state + (lr * local_lr).astype(w.dtype) * g
+        return w - mom, mom
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like_nd(weight)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        if self.momentum == 0.0:
+            return w * (1 - lr * (wd + self.wd_lh)) - lr * jnp.sign(g), None
+        mom = self.momentum * state - (1 - self.momentum) * g
+        return w * (1 - lr * self.wd_lh) + lr * jnp.sign(mom) - lr * wd * w, mom
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        from .. import random as _rng
+        import jax
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad.data) + wd * weight.data
+        noise = jax.random.normal(_rng.take_key(), weight.shape,
+                                  weight.data.dtype) * math.sqrt(lr)
+        weight._set_data(weight.data - lr / 2 * g + noise)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        return (_zeros_like_nd(weight), NDArray(weight.data, ctx=weight.context))
+
+    def _rule(self, w, g, state, lr, wd, t):
+        import jax.numpy as jnp
+        mom, prev_w = state
+        g = g + wd * w
+        mom = self.momentum * mom - lr * (
+            g + self.lamda * jnp.square(g) * (w - prev_w))
+        return w + mom, (mom, w + mom)
+
+
+Test = SGD  # legacy alias used by some reference tests
+
+
+class Updater:
+    """State-carrying closure over an optimizer (python/mxnet/optimizer/updater.py)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        tree = {}
+        for k, v in self.states.items():
+            tree[k] = _state_to_numpy(v)
+        return pickle.dumps((tree, self.optimizer.__class__.__name__)
+                            if dump_optimizer else tree)
+
+    def set_states(self, states):
+        import pickle
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            data = data[0]
+        self.states = {k: _state_from_numpy(v) for k, v in data.items()}
+
+
+def _state_to_numpy(v):
+    if v is None:
+        return None
+    if isinstance(v, NDArray):
+        return v.asnumpy()
+    if isinstance(v, (list, tuple)):
+        return tuple(_state_to_numpy(x) for x in v)
+    return v
+
+
+def _state_from_numpy(v):
+    if v is None:
+        return None
+    if isinstance(v, onp.ndarray):
+        return NDArray(v)
+    if isinstance(v, (list, tuple)):
+        return tuple(_state_from_numpy(x) for x in v)
+    return v
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

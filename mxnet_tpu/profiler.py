@@ -1,0 +1,176 @@
+"""Profiler frontend (parity: python/mxnet/profiler.py over src/profiler/profiler.h:251).
+
+TPU-native: wraps jax.profiler (XPlane traces viewable in TensorBoard/Perfetto) and
+keeps the reference's chrome://tracing JSON dump (profiler.cc:166-239 emits
+"traceEvents") plus the per-op aggregate stats table (aggregate_stats.cc) for
+framework-level scopes recorded via profiler.scope()/Task/Frame markers.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_STATE = {
+    "config": {"profile_all": False, "filename": "profile.json", "aggregate_stats": False},
+    "running": False,
+    "events": [],          # chrome trace events from framework scopes
+    "agg": {},             # name -> [count, total_us, min_us, max_us]
+    "jax_dir": None,
+    "lock": threading.Lock(),
+}
+
+
+def set_config(profile_all=False, filename="profile.json", aggregate_stats=False,
+               profile_symbolic=True, profile_imperative=True, profile_memory=True,
+               profile_api=True, continuous_dump=False, **kwargs):
+    _STATE["config"].update(profile_all=profile_all, filename=filename,
+                            aggregate_stats=aggregate_stats)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    _STATE["running"] = True
+    cfg = _STATE["config"]
+    if cfg.get("profile_all"):
+        import jax
+        import tempfile
+        _STATE["jax_dir"] = tempfile.mkdtemp(prefix="mxtpu_xplane_")
+        try:
+            jax.profiler.start_trace(_STATE["jax_dir"])
+        except Exception:
+            _STATE["jax_dir"] = None
+
+
+def stop(profile_process="worker"):
+    if _STATE.get("jax_dir"):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    _STATE["running"] = False
+
+
+def pause(profile_process="worker"):
+    _STATE["running"] = False
+
+
+def resume(profile_process="worker"):
+    _STATE["running"] = True
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (profiler.cc:184 'traceEvents' format)."""
+    with _STATE["lock"]:
+        trace = {"traceEvents": list(_STATE["events"]),
+                 "displayTimeUnit": "ms"}
+    with open(_STATE["config"]["filename"], "w") as f:
+        json.dump(trace, f)
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False) -> str:
+    """Aggregate per-scope stats table (aggregate_stats.cc analog)."""
+    with _STATE["lock"]:
+        rows = [(name, c, tot, mn, mx, tot / max(c, 1))
+                for name, (c, tot, mn, mx) in _STATE["agg"].items()]
+        if reset:
+            _STATE["agg"].clear()
+    rows.sort(key=lambda r: r[2], reverse=not ascending)
+    lines = [f"{'Name':<48}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
+             f"{'Max(us)':>12}{'Avg(us)':>12}"]
+    for name, c, tot, mn, mx, avg in rows:
+        lines.append(f"{name:<48}{c:>8}{tot:>14.1f}{mn:>12.1f}{mx:>12.1f}{avg:>12.1f}")
+    return "\n".join(lines)
+
+
+def _record(name, cat, t0_us, dur_us):
+    with _STATE["lock"]:
+        _STATE["events"].append({"name": name, "cat": cat, "ph": "X",
+                                 "ts": t0_us, "dur": dur_us, "pid": 0, "tid":
+                                 threading.get_ident() % 100000})
+        agg = _STATE["agg"].setdefault(name, [0, 0.0, float("inf"), 0.0])
+        agg[0] += 1
+        agg[1] += dur_us
+        agg[2] = min(agg[2], dur_us)
+        agg[3] = max(agg[3], dur_us)
+
+
+@contextmanager
+def scope(name: str, cat: str = "operator"):
+    """Profile a code region; also emits a jax named-scope annotation so the region
+    shows up inside XPlane device traces."""
+    import jax.profiler
+    t0 = time.perf_counter_ns() // 1000
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    if _STATE["running"]:
+        _record(name, cat, t0, time.perf_counter_ns() // 1000 - t0)
+
+
+class Task:
+    """Named task marker (profiler.py Task parity)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns() // 1000
+
+    def stop(self):
+        if self._t0 is not None and _STATE["running"]:
+            _record(self.name, "task", self._t0,
+                    time.perf_counter_ns() // 1000 - self._t0)
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):
+        self.name = name
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        if _STATE["running"]:
+            with _STATE["lock"]:
+                _STATE["events"].append({"name": self.name, "ph": "C",
+                                         "ts": time.perf_counter_ns() // 1000,
+                                         "pid": 0, "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _STATE["running"]:
+            with _STATE["lock"]:
+                _STATE["events"].append({"name": self.name, "ph": "i",
+                                         "ts": time.perf_counter_ns() // 1000,
+                                         "pid": 0, "s": "p"})
+
+
+def profiler_set_config(**kwargs):
+    set_config(**kwargs)
+
+
+def profiler_set_state(state):
+    set_state(state)

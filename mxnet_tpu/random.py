@@ -1,0 +1,47 @@
+"""Global RNG state + seeding (parity: python/mxnet/random.py, mx.random.seed).
+
+The reference keeps per-device sampler states (include/mxnet/random_generator.h);
+here a threefry key chain per thread. During HybridBlock tracing the key source is
+overridden by the trace context so dropout/samplers become pure functions of a key
+argument threaded through the compiled computation.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+__all__ = ["seed", "take_key", "push_key_source", "pop_key_source"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.key = None
+        self.sources = []  # stack of callables returning keys (trace contexts)
+
+
+_STATE = _State()
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state: int, ctx="all"):
+    import jax
+    _STATE.key = jax.random.PRNGKey(seed_state)
+
+
+def take_key():
+    """Return a fresh PRNG key (splitting the global chain)."""
+    if _STATE.sources:
+        return _STATE.sources[-1]()
+    import jax
+    if _STATE.key is None:
+        _STATE.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def push_key_source(fn: Callable):
+    _STATE.sources.append(fn)
+
+
+def pop_key_source():
+    _STATE.sources.pop()

@@ -1,0 +1,67 @@
+"""Runtime feature detection (parity: python/mxnet/runtime.py over the
+include/mxnet/libinfo.h:145-197 feature enum). Features reflect the TPU stack."""
+from __future__ import annotations
+
+from collections import namedtuple
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+_FEATURES = None
+
+
+def _detect():
+    global _FEATURES
+    if _FEATURES is not None:
+        return _FEATURES
+    import jax
+    feats = {}
+    platforms = {d.platform for d in jax.devices()}
+    feats["TPU"] = any(p not in ("cpu",) for p in platforms)
+    feats["CUDA"] = False
+    feats["CUDNN"] = False
+    feats["NCCL"] = False
+    feats["XLA"] = True
+    feats["PALLAS"] = True
+    feats["MKLDNN"] = False
+    feats["OPENCV"] = _has_module("cv2")
+    feats["BLAS_OPEN"] = True
+    feats["DIST_KVSTORE"] = True            # jax.distributed multi-host
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["SIGNAL_HANDLER"] = True
+    feats["F16C"] = True
+    feats["BF16"] = True
+    feats["PROFILER"] = True
+    feats["NATIVE_ENGINE"] = _has_native_engine()
+    _FEATURES = {k: Feature(k, v) for k, v in feats.items()}
+    return _FEATURES
+
+
+def _has_module(name):
+    import importlib.util
+    return importlib.util.find_spec(name) is not None
+
+
+def _has_native_engine():
+    try:
+        from ._native import lib  # noqa: F401
+        return lib is not None
+    except Exception:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+    def __repr__(self):
+        return f"[{', '.join(f'✔ {k}' if v.enabled else f'✖ {k}' for k, v in self.items())}]"
+
+
+def feature_list():
+    return list(_detect().values())
+
+
+libinfo_features = feature_list

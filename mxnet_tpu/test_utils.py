@@ -1,0 +1,172 @@
+"""Testing utilities (parity: python/mxnet/test_utils.py — assert_almost_equal:561,
+check_numeric_gradient:987, check_consistency:1428, rand_ndarray:388,
+default_context, same)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from .base import Context, MXNetError, current_context
+from .ndarray.ndarray import NDArray
+
+_DEFAULT_RTOL = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
+                 onp.dtype(onp.float64): 1e-5}
+_DEFAULT_ATOL = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-5,
+                 onp.dtype(onp.float64): 1e-8}
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context):
+    Context._default_ctx.stack = [ctx]
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def same(a, b):
+    return onp.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    rtol = rtol or _DEFAULT_RTOL.get(a.dtype, 1e-5)
+    atol = atol or _DEFAULT_ATOL.get(a.dtype, 1e-7)
+    return onp.allclose(a.astype(onp.float64), b.astype(onp.float64), rtol, atol,
+                        equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    rtol = rtol if rtol is not None else _DEFAULT_RTOL.get(a_np.dtype, 1e-5)
+    atol = atol if atol is not None else _DEFAULT_ATOL.get(a_np.dtype, 1e-7)
+    if not onp.allclose(a_np.astype(onp.float64), b_np.astype(onp.float64),
+                        rtol, atol, equal_nan=equal_nan):
+        index = onp.unravel_index(
+            onp.argmax(onp.abs(a_np.astype(onp.float64) - b_np)), a_np.shape) \
+            if a_np.shape else ()
+        diff = onp.abs(a_np.astype(onp.float64) - b_np).max()
+        raise AssertionError(
+            f"Items are not equal (rtol={rtol}, atol={atol}):\n max abs diff "
+            f"{diff} at {index}\n {names[0]}: {a_np.ravel()[:8]}\n "
+            f"{names[1]}: {b_np.ravel()[:8]}")
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32", ctx=None,
+                 scale=1.0):
+    from . import ndarray as nd
+    arr = nd.random.uniform(-scale, scale, shape=shape, ctx=ctx)
+    return arr.astype(dtype)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (onp.random.randint(1, dim0 + 1), onp.random.randint(1, dim1 + 1),
+            onp.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=num_dim))
+
+
+def check_numeric_gradient(fn, inputs: List[NDArray], grads=None, eps=1e-4,
+                           rtol=1e-2, atol=1e-4):
+    """Finite-difference gradient check (test_utils.py:987 pattern): `fn` maps
+    NDArrays to a scalar NDArray; autograd gradients are compared to central
+    differences."""
+    from . import autograd
+
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        y = fn(*inputs)
+    y.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for k, x in enumerate(inputs):
+        base = x.asnumpy().astype(onp.float64)
+        num_grad = onp.zeros_like(base)
+        flat = base.ravel()
+        ng_flat = num_grad.ravel()
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            x._set_data(_to_jax(base.reshape(x.shape), x))
+            f_pos = float(fn(*inputs).asscalar())
+            flat[i] = orig - eps
+            x._set_data(_to_jax(base.reshape(x.shape), x))
+            f_neg = float(fn(*inputs).asscalar())
+            flat[i] = orig
+            x._set_data(_to_jax(base.reshape(x.shape), x))
+            ng_flat[i] = (f_pos - f_neg) / (2 * eps)
+        assert_almost_equal(analytic[k], num_grad, rtol=rtol, atol=atol,
+                            names=(f"analytic[{k}]", f"numeric[{k}]"))
+
+
+def _to_jax(np_arr, like):
+    import jax
+    import jax.numpy as jnp
+    return jax.device_put(jnp.asarray(np_arr, like.data.dtype),
+                          like.context.jax_device())
+
+
+def check_consistency(fn, inputs_np: List[onp.ndarray], ctx_list: List[Context],
+                      dtypes=("float32",), rtol=None, atol=None):
+    """Cross-context/dtype oracle (test_utils.py:1428 pattern): run `fn` on every
+    (ctx, dtype) pair and compare results against the first."""
+    results = []
+    for ctx in ctx_list:
+        for dtype in dtypes:
+            args = [NDArray(a, ctx=ctx, dtype=dtype) for a in inputs_np]
+            out = fn(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            results.append([o.asnumpy().astype(onp.float64) for o in outs])
+    ref = results[0]
+    for got in results[1:]:
+        for r, g in zip(ref, got):
+            assert_almost_equal(r, g, rtol=rtol or 1e-3, atol=atol or 1e-4)
+    return results
+
+
+def list_gpus():
+    from .base import num_gpus
+    return list(range(num_gpus()))
+
+
+def gpu_device(device_id=0):
+    from .base import gpu, num_gpus
+    if num_gpus() > device_id:
+        return gpu(device_id)
+    return None
+
+
+def environment(name, value):
+    """Scoped env var override (test_utils.py environment)."""
+    import os
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _scope():
+        old = os.environ.get(name)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+    return _scope()

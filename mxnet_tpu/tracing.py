@@ -1,0 +1,41 @@
+"""Active trace context for HybridBlock tracing.
+
+When a HybridBlock is being traced into a single XLA computation (the CachedOp
+analog, src/imperative/cached_op.cc), stateful frontend behaviors — RNG draws and
+aux-state write-back (BatchNorm moving stats) — must become pure dataflow. The
+trace context provides the hooks: ops ask it for PRNG keys and register aux
+updates, which the tracer turns into extra computation inputs/outputs.
+"""
+from __future__ import annotations
+
+import threading
+
+_LOCAL = threading.local()
+
+
+def current():
+    return getattr(_LOCAL, "ctx", None)
+
+
+class activate:
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev = getattr(_LOCAL, "ctx", None)
+        _LOCAL.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _LOCAL.ctx = self.prev
+        return False
+
+
+def write_aux(param_nd, new_value):
+    """Write back an aux state (e.g. BatchNorm moving stats): immediate in eager
+    mode, recorded as an extra traced output when inside a trace."""
+    ctx = current()
+    if ctx is not None:
+        ctx.record_aux_update(param_nd, new_value)
+    else:
+        param_nd._set_data(new_value)

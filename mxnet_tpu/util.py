@@ -1,0 +1,90 @@
+"""Misc utilities + numpy-mode switches (parity: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import threading
+
+_NP_STATE = threading.local()
+
+
+def _get(flag, default=False):
+    return getattr(_NP_STATE, flag, default)
+
+
+class _FlagScope:
+    def __init__(self, flag, active):
+        self.flag, self.active = flag, active
+
+    def __enter__(self):
+        self.prev = _get(self.flag)
+        setattr(_NP_STATE, self.flag, self.active)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_NP_STATE, self.flag, self.prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _FlagScope(self.flag, self.active):
+                return fn(*a, **kw)
+        return wrapper
+
+
+def np_shape(active=True):
+    """Zero-size/unknown-shape numpy semantics scope (util.py np_shape parity).
+    Shapes are always numpy-semantic here; kept for API compatibility."""
+    return _FlagScope("np_shape", active)
+
+
+def np_array(active=True):
+    return _FlagScope("np_array", active)
+
+
+def is_np_shape():
+    return _get("np_shape", True)
+
+
+def is_np_array():
+    return _get("np_array", False)
+
+
+def set_np(shape=True, array=True):
+    _NP_STATE.np_shape = shape
+    _NP_STATE.np_array = array
+
+
+def reset_np():
+    _NP_STATE.np_shape = True
+    _NP_STATE.np_array = False
+
+
+def use_np(fn):
+    """Decorator: enable numpy semantics for a function/class (util.py use_np)."""
+    if isinstance(fn, type):
+        return fn
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        with _FlagScope("np_array", True), _FlagScope("np_shape", True):
+            return fn(*a, **kw)
+    return wrapper
+
+
+def get_gpu_count():
+    from .base import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        stats = jax.devices()[dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:
+        return 0, 0
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)

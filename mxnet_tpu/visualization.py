@@ -1,0 +1,46 @@
+"""Network visualization (parity: python/mxnet/visualization.py print_summary /
+plot_network). Works over gluon Blocks; plot_network emits graphviz dot source."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def print_summary(block, input_shape=None, line_length=98):
+    """Print a per-layer summary table of a gluon Block (visualization.py:25)."""
+    rows = []
+    total_params = 0
+    for name, param in block.collect_params().items():
+        n = 1
+        for s in param.shape or ():
+            n *= s
+        total_params += n
+        rows.append((name, param.shape, n))
+    print("=" * line_length)
+    print(f"{'Parameter':<60}{'Shape':<25}{'Count':>12}")
+    print("=" * line_length)
+    for name, shape, n in rows:
+        print(f"{name:<60}{str(shape):<25}{n:>12}")
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    return total_params
+
+
+def plot_network(block, title="plot", shape=None, save_format="pdf", hide_weights=True):
+    """Return graphviz dot source for the block hierarchy (visualization.py:214).
+    Rendering requires the optional graphviz package; the dot text is always built."""
+    lines = ["digraph plot {", '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+    def walk(b, prefix):
+        node = prefix or b.__class__.__name__
+        lines.append(f'  "{node}" [label="{b.__class__.__name__}"];')
+        for name, child in getattr(b, "_children", {}).items():
+            child_id = f"{node}/{name}"
+            walk(child, child_id)
+            lines.append(f'  "{child_id}" -> "{node}";')
+    walk(block, "")
+    lines.append("}")
+    src = "\n".join(lines)
+    try:
+        import graphviz
+        return graphviz.Source(src)
+    except ImportError:
+        return src

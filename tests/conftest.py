@@ -1,0 +1,28 @@
+"""Test config: run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (SURVEY.md test strategy; the reference's
+CPU-default + context-parametrized pattern, tests/python/gpu/test_operator_gpu.py)."""
+import os
+import sys
+
+# must be set before jax import: force the 8-device virtual CPU mesh and keep the
+# axon TPU plugin out of the test process (its tunnel is single-tenant; tests must
+# not hold the chip the benchmark uses)
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p)
+
+import warnings
+
+warnings.filterwarnings("ignore", message=".*donated buffers.*")
+warnings.filterwarnings("ignore", message=".*Some donated buffers were not usable.*")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ctx():
+    import mxnet_tpu as mx
+    return mx.cpu()

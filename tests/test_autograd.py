@@ -1,0 +1,164 @@
+"""Autograd (mirrors tests/python/unittest/test_autograd.py core cases)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 4 * x.asnumpy())
+
+
+def test_chain_rule():
+    x = nd.array([[0.5, -1.0], [2.0, 3.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = (y * y).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * onp.exp(2 * x.asnumpy()), rtol=1e-4)
+
+
+def test_multi_input_grad():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_add_req():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 2 * 2 * x.asnumpy())
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 60.0]))
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g = autograd.grad(y, x, retain_graph=False)
+    assert_almost_equal(g, onp.array([12.0]), rtol=1e-5)
+
+
+def test_is_training_recording():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_pause_no_grad():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = x * 100  # not recorded
+        w = (y + z).sum()
+    w.backward()
+    assert_almost_equal(x.grad, onp.array([2.0]))
+
+
+def test_detach():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([9.0]))
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+        s = y.sum()
+    s.backward()
+    sig = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-4)
+
+
+def test_numeric_gradient_ops():
+    onp.random.seed(0)
+    x = nd.array(onp.random.rand(3, 4).astype("f") + 0.5)
+    check_numeric_gradient(lambda a: (a * a).sum(), [x])
+    x2 = nd.array(onp.random.rand(2, 3).astype("f") + 0.5)
+    check_numeric_gradient(lambda a: nd.log(a).sum(), [x2], eps=1e-3, rtol=3e-2)
+    x3 = nd.array(onp.random.rand(4,).astype("f") - 0.5)
+    check_numeric_gradient(lambda a: nd.tanh(a).sum(), [x3], eps=1e-3, rtol=3e-2)
+
+
+def test_softmax_output_grad():
+    x = nd.array(onp.random.rand(4, 5).astype("f"))
+    label = nd.array([0, 1, 2, 3])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = onp.exp(x.asnumpy()) / onp.exp(x.asnumpy()).sum(1, keepdims=True)
+    oh = onp.eye(5, dtype="f")[[0, 1, 2, 3]]
+    assert_almost_equal(x.grad, p - oh, rtol=1e-4)
+
+
+def test_rnn_op_grad_flows():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H = 3, 2, 4, 5
+    size = rnn_param_size("lstm", 1, I, H, False)
+    x = nd.random.normal(shape=(T, N, I))
+    params = nd.random.normal(shape=(size,), scale=0.1)
+    h0 = nd.zeros((1, N, H))
+    c0 = nd.zeros((1, N, H))
+    params.attach_grad()
+    with autograd.record():
+        out, hT, cT = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1,
+                             mode="lstm")
+        loss = out.sum()
+    loss.backward()
+    assert params.grad.shape == (size,)
+    assert float(nd.abs(params.grad).sum().asscalar()) > 0

@@ -1,0 +1,283 @@
+"""Gluon blocks/layers/trainer (mirrors tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init=mx.init.Xavier(), ctx=[mx.cpu(0)])
+    assert len(p.list_data()) == 1
+    assert len(p.list_grad()) == 1
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var() is p
+    p.zero_grad()
+    assert (p.grad().asnumpy() == 0).all()
+
+
+def test_constant():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = onp.asarray([[1, 2], [3, 4.0]])
+            self.const = self.params.get_constant("const", self.value)
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with autograd.record():
+        x = nd.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_dense():
+    model = nn.Dense(128, activation="tanh", in_units=10, flatten=False)
+    inputs = nd.zeros((2, 3, 10))
+    model.initialize()
+    out = model(inputs)
+    assert out.shape == (2, 3, 128)
+    model2 = nn.Dense(64, in_units=30)
+    model2.initialize()
+    out2 = model2(nd.zeros((17, 2, 15)))
+    assert out2.shape == (17, 64)
+
+
+def test_deferred_init():
+    model = nn.Dense(10)
+    model.initialize()
+    out = model(nd.zeros((4, 7)))
+    assert model.weight.shape == (10, 7)
+    assert out.shape == (4, 10)
+
+
+def test_sequential_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(5), nn.Dense(3))
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    net.initialize()
+    out = net(nd.ones((2, 4)))
+    assert out.shape == (2, 3)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_hybrid_vs_eager_parity():
+    onp.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.LayerNorm(), nn.Dense(4))
+    net.initialize()
+    x = nd.array(onp.random.rand(5, 8).astype("f"))
+    eager_out = net(x)
+    net.hybridize()
+    hybrid_out = net(x)
+    assert_almost_equal(eager_out, hybrid_out, rtol=1e-5, atol=1e-5)
+    # second call hits the cache
+    hybrid_out2 = net(x)
+    assert_almost_equal(hybrid_out, hybrid_out2)
+
+
+def test_conv_layers():
+    x = nd.random.uniform(shape=(2, 3, 16, 16))
+    conv = nn.Conv2D(8, kernel_size=3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 16, 16)
+    convs = nn.Conv2D(8, kernel_size=3, strides=2, padding=1)
+    convs.initialize()
+    assert convs(x).shape == (2, 8, 8, 8)
+    groups = nn.Conv2D(6, kernel_size=1, groups=3)
+    groups.initialize()
+    assert groups(x).shape == (2, 6, 16, 16)
+    tconv = nn.Conv2DTranspose(3, kernel_size=2, strides=2, in_channels=3)
+    tconv.initialize()
+    assert tconv(x).shape == (2, 3, 32, 32)
+    c1 = nn.Conv1D(4, kernel_size=3)
+    c1.initialize()
+    assert c1(nd.zeros((2, 3, 10))).shape == (2, 4, 8)
+
+
+def test_pool_layers():
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.MaxPool2D(3, 2, padding=1)(x).shape == (2, 3, 4, 4)
+    # ceil mode
+    y = nd.zeros((1, 1, 5, 5))
+    assert nn.MaxPool2D(2, 2, ceil_mode=True)(y).shape == (1, 1, 3, 3)
+    assert nn.MaxPool2D(2, 2, ceil_mode=False)(y).shape == (1, 1, 2, 2)
+
+
+def test_batchnorm_stats():
+    bn = nn.BatchNorm(in_channels=3, momentum=0.5)
+    bn.initialize()
+    x = nd.array(onp.random.rand(4, 3, 5, 5).astype("f") * 2 + 1)
+    with autograd.record():
+        out = bn(x)
+    # running stats moved toward batch stats
+    rm = bn.running_mean.data().asnumpy()
+    assert (onp.abs(rm) > 1e-4).any()
+    # inference uses running stats
+    out_inf = bn(x)
+    assert out_inf.shape == x.shape
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array([0, 5, 9], dtype="int32")
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    assert_almost_equal(out, emb.weight.data().asnumpy()[[0, 5, 9]])
+
+
+def test_block_save_load(tmp_path):
+    fname = str(tmp_path / "model.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    x = nd.ones((1, 4))
+    ref = net(x).asnumpy()
+    net.save_parameters(fname)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_trainer_sgd_momentum():
+    p = gluon.Parameter("w", shape=(3,))
+    p.initialize(init=mx.init.One())
+    trainer = gluon.Trainer({"w": p}, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    p.grad()._set_data(nd.ones((3,)).data)
+    trainer.step(1)
+    assert_almost_equal(p.data(), onp.full(3, 0.9, dtype="f"))
+    p.grad()._set_data(nd.ones((3,)).data)
+    trainer.step(1)
+    # v = 0.9*(-0.1) - 0.1 = -0.19; w = 0.9 - 0.19 = 0.71
+    assert_almost_equal(p.data(), onp.full(3, 0.71, dtype="f"), rtol=1e-5)
+
+
+def test_trainer_save_load_states(tmp_path):
+    fname = str(tmp_path / "opt.states")
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(init=mx.init.One())
+    tr = gluon.Trainer({"w": p}, "adam", {"learning_rate": 0.1})
+    p.grad()._set_data(nd.ones((2,)).data)
+    tr.step(1)
+    tr.save_states(fname)
+    tr2 = gluon.Trainer({"w": p}, "adam", {"learning_rate": 0.1})
+    tr2.load_states(fname)
+    assert tr2._updaters.states
+
+
+def test_losses():
+    pred = nd.array(onp.random.rand(4, 5).astype("f"))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    logp = onp.log(onp.exp(pred.asnumpy())
+                   / onp.exp(pred.asnumpy()).sum(1, keepdims=True))
+    expected = -logp[onp.arange(4), [0, 1, 2, 3]]
+    assert_almost_equal(l, expected, rtol=1e-4)
+    l2 = gluon.loss.L2Loss()(pred, pred)
+    assert float(l2.sum().asscalar()) == 0
+    l1 = gluon.loss.L1Loss()(pred, pred * 0)
+    assert_almost_equal(l1, onp.abs(pred.asnumpy()).mean(1), rtol=1e-4)
+    h = gluon.loss.HuberLoss()(pred, pred)
+    assert float(h.sum().asscalar()) == 0
+
+
+def test_rnn_layers():
+    lstm = gluon.rnn.LSTM(10, num_layers=2, bidirectional=True)
+    lstm.initialize()
+    x = nd.random.normal(shape=(5, 3, 6))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 3, 20)
+    states = lstm.begin_state(3)
+    out2, new_states = lstm(x, *([states] if False else [states[0], states[1]])) \
+        if False else lstm(x, states)
+    assert out2.shape == (5, 3, 20)
+    assert new_states[0].shape == (4, 3, 10)
+
+    gru = gluon.rnn.GRU(7, layout="NTC")
+    gru.initialize()
+    y = gru(nd.zeros((2, 4, 3)))
+    assert y.shape == (2, 4, 7)
+
+
+def test_rnn_cells():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.zeros((2, 5))
+    states = cell.begin_state(2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 8)
+    outputs, states = cell.unroll(3, nd.zeros((2, 3, 5)), layout="NTC",
+                                  merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(4))
+    stack.add(gluon.rnn.GRUCell(6))
+    stack.initialize()
+    outputs, _ = stack.unroll(2, nd.zeros((1, 2, 3)), layout="NTC",
+                              merge_outputs=True)
+    assert outputs.shape == (1, 2, 6)
+
+
+def test_dataset_dataloader():
+    X = onp.random.rand(20, 3).astype("f")
+    y = onp.arange(20).astype("f")
+    dataset = gluon.data.ArrayDataset(X, y)
+    assert len(dataset) == 20
+    loader = gluon.data.DataLoader(dataset, batch_size=6, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)
+    loader2 = gluon.data.DataLoader(dataset, batch_size=6, last_batch="discard",
+                                    num_workers=2)
+    assert len(list(loader2)) == 3
+    # transform
+    t = dataset.transform_first(lambda x: x * 2)
+    assert_almost_equal(t[0][0], X[0] * 2)
+
+
+def test_split_and_load():
+    data = nd.array(onp.arange(8).reshape(4, 2))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert len(parts) == 1
+    parts2 = gluon.utils.split_data(data, 2)
+    assert parts2[0].shape == (2, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = onp.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-5
+
+
+def test_model_zoo_construction():
+    from mxnet_tpu.gluon.model_zoo import get_model
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    out = net(nd.zeros((1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+    net2 = get_model("mobilenet_v2_0_25", classes=7)
+    net2.initialize()
+    assert net2(nd.zeros((1, 3, 32, 32))).shape == (1, 7)

@@ -1,0 +1,237 @@
+"""NDArray basics (mirrors tests/python/unittest/test_ndarray.py core cases)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert a.size == 4
+    b = nd.zeros((3, 4))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2, 3), dtype="int32")
+    assert c.dtype == onp.int32
+    d = nd.full((2, 2), 7.0)
+    assert (d.asnumpy() == 7).all()
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e, onp.arange(0, 10, 2, dtype=onp.float32))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, onp.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, onp.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, onp.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, onp.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(a + 1, onp.array([[2, 3], [4, 5]]))
+    assert_almost_equal(1 - a, onp.array([[0, -1], [-2, -3]]))
+    assert_almost_equal(2 * a, onp.array([[2, 4], [6, 8]]))
+    assert_almost_equal(a ** 2, onp.array([[1, 4], [9, 16]]))
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(nd.array([-1.0, 2.0])), onp.array([1, 2]))
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    assert_almost_equal(a > b, onp.array([0, 0, 1]))
+    assert_almost_equal(a >= b, onp.array([0, 1, 1]))
+    assert_almost_equal(a == b, onp.array([0, 1, 0]))
+    assert_almost_equal(a != b, onp.array([1, 0, 1]))
+
+
+def test_indexing():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert a[0].shape == (3, 4)
+    assert a[0, 1].shape == (4,)
+    assert float(a[1, 2, 3].asscalar()) == 23.0
+    assert a[:, 1:3].shape == (2, 2, 4)
+    sliced = a[0, :, ::2]
+    assert sliced.shape == (3, 2)
+    b = nd.zeros((3, 3))
+    b[1, 1] = 5.0
+    assert float(b[1, 1].asscalar()) == 5.0
+    b[...] = 2.0
+    assert (b.asnumpy() == 2).all()
+    # advanced indexing
+    idx = nd.array([0, 1], dtype="int32")
+    got = a[idx]
+    assert got.shape == (2, 3, 4)
+
+
+def test_reshape_transpose():
+    a = nd.array(onp.arange(12).reshape(3, 4))
+    assert a.reshape(4, 3).shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape(-1).shape == (12,)
+    assert a.T.shape == (4, 3)
+    assert a.transpose().shape == (4, 3)
+    b = nd.zeros((2, 3, 4))
+    assert b.transpose(2, 0, 1).shape == (4, 2, 3)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+    assert b.flatten().shape == (2, 12)
+    assert b.expand_dims(0).shape == (1, 2, 3, 4)
+    # reference reshape special codes
+    c = nd.zeros((2, 3, 4))
+    assert c.reshape(0, -1).shape == (2, 12)
+    assert nd.reshape(c, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(c, shape=(-3, 4)).shape == (6, 4)
+
+
+def test_reductions():
+    a = nd.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    assert float(a.sum().asscalar()) == 66
+    assert_almost_equal(a.sum(axis=0), a.asnumpy().sum(0))
+    assert_almost_equal(a.mean(axis=1), a.asnumpy().mean(1))
+    assert_almost_equal(a.max(axis=0), a.asnumpy().max(0))
+    assert_almost_equal(a.min(axis=1), a.asnumpy().min(1))
+    assert float(a.argmax().asscalar()) == 11
+    assert_almost_equal(a.argmax(axis=1), a.asnumpy().argmax(1).astype("f"))
+    assert_almost_equal(nd.sum(a, axis=0, exclude=True), a.asnumpy().sum(1))
+    n = a.norm()
+    assert_almost_equal(n, onp.linalg.norm(a.asnumpy()), rtol=1e-4)
+
+
+def test_dot():
+    a = nd.array(onp.random.rand(3, 4).astype("f"))
+    b = nd.array(onp.random.rand(4, 5).astype("f"))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy().dot(b.asnumpy()), rtol=1e-4)
+    c = nd.array(onp.random.rand(2, 3, 4).astype("f"))
+    d = nd.array(onp.random.rand(2, 4, 5).astype("f"))
+    assert_almost_equal(nd.batch_dot(c, d),
+                        onp.matmul(c.asnumpy(), d.asnumpy()), rtol=1e-4)
+    assert_almost_equal(nd.dot(a, b, transpose_b=False),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-4)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.astype("bfloat16")
+    assert str(c.dtype) == "bfloat16"
+    d = a.copy()
+    d += 1
+    assert float(a[0].asscalar()) == 1.5
+
+
+def test_wait_and_context():
+    a = nd.ones((4, 4))
+    a.wait_to_read()
+    assert a.context == mx.cpu()
+    b = a.as_in_context(mx.cpu(0))
+    assert b is a
+    nd.waitall()
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    c2 = nd.concat([a, b], dim=1)
+    assert c2.shape == (2, 6)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.params")
+    a = nd.array([[1, 2], [3, 4]])
+    b = nd.ones((3,), dtype="int32")
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"], a.asnumpy())
+    assert loaded["b"].dtype == onp.int32
+    # list form
+    nd.save(fname, [a, b])
+    out = nd.load(fname)
+    assert isinstance(out, list) and len(out) == 2
+    # bf16 roundtrip
+    c = a.astype("bfloat16")
+    nd.save(fname, {"c": c})
+    back = nd.load(fname)["c"]
+    assert str(back.dtype) == "bfloat16"
+
+
+def test_take_pick_gather():
+    a = nd.array(onp.arange(12, dtype="f").reshape(3, 4))
+    idx = nd.array([0, 2], dtype="int32")
+    assert_almost_equal(nd.take(a, idx, axis=0), a.asnumpy()[[0, 2]])
+    p = nd.pick(a, nd.array([0, 1, 2]), axis=1)
+    assert_almost_equal(p, onp.array([0, 5, 10]))
+    g = nd.gather_nd(a, nd.array([[0, 1], [1, 2]], dtype="int32"))
+    assert_almost_equal(g, onp.array([a.asnumpy()[0, 1], a.asnumpy()[1, 2]]))
+
+
+def test_one_hot_where_clip():
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), depth=3)
+    assert_almost_equal(oh, onp.array([[1, 0, 0], [0, 0, 1]], dtype="f"))
+    w = nd.where(nd.array([1.0, 0.0]), nd.array([1.0, 2.0]), nd.array([3.0, 4.0]))
+    assert_almost_equal(w, onp.array([1, 4]))
+    c = nd.clip(nd.array([-2.0, 0.5, 9.0]), a_min=0.0, a_max=1.0)
+    assert_almost_equal(c, onp.array([0, 0.5, 1]))
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert_almost_equal(v, onp.array([[3, 2], [5, 4]]))
+    s = nd.sort(a, axis=1)
+    assert_almost_equal(s, onp.sort(a.asnumpy(), axis=1))
+    idx = nd.argsort(a, axis=1)
+    assert_almost_equal(idx, onp.argsort(a.asnumpy(), 1).astype("f"))
+
+
+def test_sequence_ops():
+    data = nd.array(onp.arange(24, dtype="f").reshape(4, 2, 3))  # (seq, batch, c)
+    length = nd.array([2, 3])
+    masked = nd.SequenceMask(data, length, use_sequence_length=True, value=-1.0)
+    np_d = data.asnumpy().copy()
+    np_d[2:, 0] = -1
+    np_d[3:, 1] = -1
+    assert_almost_equal(masked, np_d)
+    last = nd.SequenceLast(data, length, use_sequence_length=True)
+    assert_almost_equal(last, onp.stack([data.asnumpy()[1, 0],
+                                         data.asnumpy()[2, 1]]))
+
+
+def test_random_ops():
+    mx.random.seed(7)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= float(u.min().asscalar()) and float(u.max().asscalar()) <= 1
+    n1 = nd.random.normal(0, 1, shape=(50,))
+    mx.random.seed(7)
+    u2 = nd.random.uniform(0, 1, shape=(100,))
+    assert_almost_equal(u, u2)  # seeding reproducible
+    r = nd.random.randint(0, 10, shape=(20,))
+    assert r.dtype == onp.int32
+    m = nd.random.multinomial(nd.array([[0.0, 1.0], [1.0, 0.0]]))
+    assert_almost_equal(m, onp.array([1, 0]))
+
+
+def test_numpy_interop():
+    a = nd.array([[1.0, 2.0]])
+    np_view = onp.asarray(a)
+    assert np_view.shape == (1, 2)
+    b = a + onp.array([[1.0, 1.0]])
+    assert_almost_equal(b, onp.array([[2, 3]]))
