@@ -4,15 +4,24 @@ CPU-default + context-parametrized pattern, tests/python/gpu/test_operator_gpu.p
 import os
 import sys
 
-# must be set before jax import: force the 8-device virtual CPU mesh and keep the
-# axon TPU plugin out of the test process (its tunnel is single-tenant; tests must
-# not hold the chip the benchmark uses)
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
+# The tests must run on a virtual 8-device CPU mesh, not the tunneled TPU chip
+# (its per-op dispatch latency makes eager tests ~100x slower, and the tunnel is
+# single-tenant). The TPU plugin's sitecustomize (on PYTHONPATH) registers the
+# PJRT plugin at *interpreter startup* and pins jax_platforms via jax.config —
+# the env var alone is ignored. Override the config value back to cpu before the
+# first backend initialization; XLA_FLAGS is read at CPU-client init so setting
+# it here (pre-init) still takes effect.
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(_flags + ["--xla_force_host_platform_device_count=8"])
 os.environ["JAX_PLATFORMS"] = "cpu"
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = ":".join(
-    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if len(jax.devices()) < 8 or jax.devices()[0].platform != "cpu":  # pragma: no cover
+    raise RuntimeError("test process failed to get the 8-device CPU mesh: "
+                       f"{jax.devices()}")
 
 import warnings
 
