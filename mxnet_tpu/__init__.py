@@ -32,6 +32,7 @@ from . import callback
 from . import visualization
 from . import util
 from . import amp
+from . import parallel
 from .util import np_shape, np_array, is_np_array, set_np, reset_np
 from . import numpy as np
 from . import numpy_extension as npx

@@ -1,0 +1,33 @@
+"""mxnet_tpu.parallel: multi-chip execution over a jax.sharding.Mesh.
+
+This package is the TPU-native replacement for the reference's distributed stack
+(SURVEY.md §2.3): KVStoreNCCL's grouped ncclReduce (src/kvstore/kvstore_nccl.h:285),
+the ps-lite parameter server (src/kvstore/kvstore_dist.h:50), and manual ctx_group
+model parallelism (python/mxnet/symbol/symbol.py:1562-1711). Instead of pushing
+per-parameter reduce ops onto a threaded engine, the whole training step —
+forward, backward, gradient all-reduce, optimizer update — is ONE pjit'd XLA
+computation over a named device mesh; XLA emits the ICI/DCN collectives implied
+by the sharding annotations (allreduce for data parallel, all-gather/
+reduce-scatter for tensor parallel, ppermute rings for sequence parallel).
+
+Components
+----------
+- mesh:        named-axis DeviceMesh construction (dp/tp/sp/pp/ep axes).
+- collectives: the communication backend — in-program collectives (psum et al.
+  under shard_map) and host-level barrier/broadcast, replacing NCCL + ps-lite.
+- train_step:  ParallelTrainStep — the fused multi-chip training step.
+- ring_attention: ring/blockwise attention for sequence parallelism over long
+  contexts (no reference equivalent; SURVEY.md §5 "long-context" gap).
+"""
+from .mesh import DeviceMesh, make_mesh, current_mesh, replicated, shard_spec
+from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
+                          barrier, broadcast_from_root, axis_index, axis_size)
+from .train_step import ParallelTrainStep, pure_apply
+from .ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "DeviceMesh", "make_mesh", "current_mesh", "replicated", "shard_spec",
+    "all_reduce", "all_gather", "reduce_scatter", "ppermute", "barrier",
+    "broadcast_from_root", "axis_index", "axis_size",
+    "ParallelTrainStep", "pure_apply", "ring_attention", "ring_self_attention",
+]

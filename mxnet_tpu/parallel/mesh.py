@@ -1,0 +1,135 @@
+"""Named device meshes for multi-chip sharding.
+
+The reference scales by assigning whole ops to devices (kvstore device lists,
+symbol ctx_group / group2ctx at bind time, symbol.py:1562-1711). TPU-native
+scaling instead names the axes of the physical device grid — dp (data), tp
+(tensor), sp (sequence/context), pp (pipeline), ep (expert) — and annotates
+arrays with PartitionSpecs over those axes; XLA/GSPMD inserts the collectives.
+
+A DeviceMesh wraps jax.sharding.Mesh with axis bookkeeping and helpers to build
+NamedShardings. On a v5e pod slice the mesh axes should follow the physical ICI
+torus (jax's mesh_utils.create_device_mesh does this); across pod slices the
+outermost axis rides DCN.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "replicated", "shard_spec"]
+
+_AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")  # canonical ordering, outer→inner
+
+_current = threading.local()
+
+
+class DeviceMesh:
+    """A named mesh of devices (wraps jax.sharding.Mesh).
+
+    Axis names are free-form but the canonical ones are:
+      dp   data parallel (batch dim; gradients all-reduce over it)
+      fsdp fully-sharded data parallel (params sharded over it, all-gathered)
+      tp   tensor parallel (weight matrices sharded; activations all-reduce)
+      sp   sequence/context parallel (sequence dim sharded; ring collectives)
+      pp   pipeline parallel (layers sharded; ppermute between stages)
+      ep   expert parallel (MoE experts sharded; all_to_all dispatch)
+    """
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self._mesh.axis_names)
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self._mesh.shape)
+
+    @property
+    def size(self) -> int:
+        return self._mesh.size
+
+    def axis_size(self, name: str) -> int:
+        return self.shape.get(name, 1)
+
+    def sharding(self, *spec):
+        """NamedSharding from a PartitionSpec-style tuple; None entries replicate."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self._mesh, P(*spec))
+
+    def replicated(self):
+        return self.sharding()
+
+    def __enter__(self):
+        stack = getattr(_current, "stack", None)
+        if stack is None:
+            stack = _current.stack = []
+        stack.append(self)
+        self._mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        _current.stack.pop()
+        return self._mesh.__exit__(*exc)
+
+    def __repr__(self):
+        return f"DeviceMesh({self.shape})"
+
+
+def make_mesh(axes: Dict[str, int], devices=None) -> DeviceMesh:
+    """Build a DeviceMesh with the given {axis_name: size} layout.
+
+    Sizes must multiply to the device count (a size of -1 is inferred). Axes are
+    laid out in the order given; put the highest-bandwidth-demand axis (tp/sp)
+    innermost so it maps to the tightest ICI ring.
+    """
+    import jax
+    import numpy as onp
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if -1 in sizes:
+        if n % known:
+            raise MXNetError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    elif known != n:
+        raise MXNetError(f"mesh {dict(zip(names, sizes))} needs {known} devices, "
+                         f"have {n}")
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(tuple(sizes), devices=devices)
+    except Exception:
+        dev_array = onp.asarray(devices).reshape(tuple(sizes))
+    return DeviceMesh(Mesh(dev_array, tuple(names)))
+
+
+def current_mesh() -> Optional[DeviceMesh]:
+    stack = getattr(_current, "stack", None)
+    return stack[-1] if stack else None
+
+
+def replicated(mesh: DeviceMesh):
+    return mesh.replicated()
+
+
+def shard_spec(*spec):
+    """PartitionSpec shorthand."""
+    from jax.sharding import PartitionSpec as P
+    return P(*spec)

@@ -1,0 +1,274 @@
+"""ParallelTrainStep: the fused multi-chip training step.
+
+Reference mapping: one call to ParallelTrainStep.step() does what a whole
+iteration of the reference's Gluon training loop does (SURVEY.md §3.4):
+forward (cached_op.cc:765) + backward (imperative.cc:376) + gradient allreduce
+(gluon/trainer.py:380-404 → kvstore_nccl.h:285) + optimizer update
+(optimizer_op.cc) — but as ONE pjit'd XLA computation over a DeviceMesh.
+Data-parallel gradient reduction is not coded anywhere: the batch is sharded
+over 'dp' while parameters are replicated (or sharded over 'tp'/'fsdp'), so
+GSPMD materializes the implied all-reduce/all-gather on ICI. Buffer donation of
+params+optimizer state gives the reference's in-place update semantics
+(kAddTo/static_alloc, cached_op.h:318) without aliasing hazards.
+
+Parameters opt into model-parallel layouts via ``Parameter.shard(spec)`` (the
+TPU replacement for ctx_group model parallelism, symbol.py:1562-1711).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import Context, MXNetError
+from ..ndarray.ndarray import NDArray
+from .mesh import DeviceMesh
+
+__all__ = ["ParallelTrainStep", "pure_apply"]
+
+
+def _mk_nd(data) -> NDArray:
+    arr = NDArray.__new__(NDArray)
+    arr._data = data
+    arr._ctx = Context("cpu", 0)
+    arr._grad = None
+    arr._grad_req = "null"
+    arr._tape_node = None
+    arr._tape_index = 0
+    return arr
+
+
+def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
+    """Run ``block`` as a pure function of explicit parameter arrays.
+
+    Returns (out_datas, aux_values, aux_param_ids): aux_* capture in-graph state
+    writes (BatchNorm moving stats) as extra outputs instead of side effects.
+    This is the single tracing primitive shared by CachedOp (eager hybridize)
+    and ParallelTrainStep (multi-chip training).
+    """
+    from .. import autograd, tracing, random as _rng
+    from ..gluon.block import _TraceContext as TraceContext
+    param_map = {id(p): _mk_nd(d) for p, d in zip(param_list, param_datas)}
+    inputs = [_mk_nd(d) for d in input_datas]
+    tctx = TraceContext(param_map, key)
+    with tracing.activate(tctx):
+        _rng.push_key_source(tctx.take_key)
+        try:
+            with autograd._RecordingStateScope(False, training):
+                out = block._eager_forward(*inputs)
+        finally:
+            _rng.pop_key_source()
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
+    return out_datas, tuple(tctx.aux_updates.values()), tuple(tctx.aux_updates)
+
+
+class ParallelTrainStep:
+    """Fused forward+backward+allreduce+update step over a DeviceMesh.
+
+    Usage::
+
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        step = ParallelTrainStep(net, loss_fn, optimizer, mesh,
+                                 data_spec=P("dp"), label_spec=P("dp"))
+        for x, y in batches:
+            loss = step(x, y)          # ONE XLA computation on all chips
+        step.sync_to_block()           # write final weights back to net
+
+    Parameters live on-mesh as sharded jax arrays across steps (donated each
+    call); ``sync_to_block`` writes them back into the Gluon Parameters.
+    """
+
+    def __init__(self, block, loss, optimizer, mesh: DeviceMesh, *,
+                 data_spec=None, label_spec=None, extra_specs: Sequence = (),
+                 donate: bool = True, compute_dtype=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        self._block = block
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mesh = mesh
+        self._donate = donate
+        self._step_fn = None
+        self._t = 0
+
+        params = list(block.collect_params().values())
+        for p in params:
+            if p._data is None:
+                raise MXNetError(f"Parameter {p.name} is not initialized; call "
+                                 "block.initialize() before ParallelTrainStep")
+        self._plist = params
+        self._trainable_idx = [i for i, p in enumerate(params)
+                               if p.grad_req != "null"]
+        self._aux_idx = [i for i, p in enumerate(params) if p.grad_req == "null"]
+
+        # shardings: Parameter.shard(spec) opts into tp/fsdp layouts; default
+        # replicated (pure data parallel)
+        self._param_shardings = []
+        for p in params:
+            spec = getattr(p, "_sharding", None)
+            if spec is None:
+                sh = mesh.replicated()
+            else:
+                sh = mesh.sharding(*spec) if isinstance(spec, (tuple, list)) \
+                    else mesh.sharding(spec) if isinstance(spec, str) \
+                    else jax.sharding.NamedSharding(mesh.mesh, spec)
+            self._param_shardings.append(sh)
+
+        if compute_dtype is not None:
+            compute_dtype = jnp.dtype(compute_dtype)
+        self._compute_dtype = compute_dtype
+
+        # place parameter values on the mesh
+        self._params = [jax.device_put(p.data().data, sh)
+                        for p, sh in zip(params, self._param_shardings)]
+
+        # optimizer state per trainable param, sharded like its param
+        self._opt_states = []
+        self._state_shardings = []
+        from ..optimizer.optimizer import _unwrap_state
+        for i in self._trainable_idx:
+            st = _unwrap_state(optimizer.create_state_multi_precision(
+                i, params[i].data()))
+            psh = self._param_shardings[i]
+            st_sh = jax.tree_util.tree_map(
+                lambda leaf: psh if getattr(leaf, "shape", None) ==
+                tuple(params[i].shape) else mesh.replicated(), st)
+            st = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(leaf, sh), st, st_sh)
+            self._opt_states.append(st)
+            self._state_shardings.append(st_sh)
+
+        self._data_sharding = mesh.sharding(*data_spec) if data_spec is not None \
+            else mesh.sharding("dp") if "dp" in mesh.axis_names else mesh.replicated()
+        self._label_sharding = mesh.sharding(*label_spec) if label_spec is not None \
+            else self._data_sharding
+        self._extra_shardings = [mesh.sharding(*s) for s in extra_specs]
+        self._aux_ids_cell: List = []
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        opt = self._optimizer
+        plist = self._plist
+        tidx = self._trainable_idx
+        aidx = self._aux_idx
+        loss_blk = self._loss
+        block = self._block
+        aux_cell = self._aux_ids_cell
+        cdtype = self._compute_dtype
+
+        def step(train_params, aux_params, opt_states, x, y, extras, key,
+                 lrs, wds, t):
+            full = [None] * len(plist)
+            for j, i in enumerate(tidx):
+                full[i] = train_params[j]
+            for j, i in enumerate(aidx):
+                full[i] = aux_params[j]
+
+            def loss_f(tp):
+                cur = list(full)
+                for j, i in enumerate(tidx):
+                    cur[i] = tp[j].astype(cdtype) if cdtype is not None and \
+                        jnp.issubdtype(tp[j].dtype, jnp.floating) else tp[j]
+                xin = x.astype(cdtype) if cdtype is not None and \
+                    jnp.issubdtype(x.dtype, jnp.floating) else x
+                outs, aux_vals, aux_pids = pure_apply(
+                    block, plist, cur, (xin,) + tuple(extras), key, training=True)
+                aux_cell.clear()
+                aux_cell.extend(aux_pids)
+                out_nd = _mk_nd(outs[0])
+                loss_nd = loss_blk(out_nd, _mk_nd(y))
+                loss_val = jnp.mean(loss_nd.data.astype(jnp.float32))
+                return loss_val, aux_vals
+
+            (loss_val, aux_vals), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(list(train_params))
+
+            new_train, new_states = [], []
+            for j, i in enumerate(tidx):
+                w, g, s = train_params[j], grads[j], opt_states[j]
+                g = g.astype(w.dtype) * opt.rescale_grad
+                if opt.clip_gradient is not None:
+                    g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+                nw, ns = opt._rule(w, g, s, lrs[j], wds[j], t)
+                new_train.append(nw)
+                new_states.append(ns)
+
+            # aux write-back (BatchNorm moving stats) as pure outputs
+            pid_to_val = dict(zip(aux_cell, aux_vals))
+            new_aux = []
+            for j, i in enumerate(aidx):
+                upd = pid_to_val.get(id(plist[i]))
+                new_aux.append(upd if upd is not None else aux_params[j])
+            return loss_val, new_train, new_aux, new_states
+
+        t_sh = [self._param_shardings[i] for i in tidx]
+        a_sh = [self._param_shardings[i] for i in aidx]
+        rep = self._mesh.replicated()
+        in_shardings = (t_sh, a_sh, self._state_shardings,
+                        self._data_sharding, self._label_sharding,
+                        tuple(self._extra_shardings), rep, rep, rep, rep)
+        out_shardings = (rep, t_sh, a_sh, self._state_shardings)
+        donate = (0, 1, 2) if self._donate else ()
+        self._step_fn = jax.jit(step, in_shardings=in_shardings,
+                                out_shardings=out_shardings,
+                                donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def step(self, x, y, *extras):
+        """Run one fused training step; returns the (scalar) loss NDArray."""
+        import jax
+        import jax.numpy as jnp
+        if self._step_fn is None:
+            self._build()
+        x = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        y = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        extras = tuple(e.data if isinstance(e, NDArray) else jnp.asarray(e)
+                       for e in extras)
+        x = jax.device_put(x, self._data_sharding)
+        y = jax.device_put(y, self._label_sharding)
+        extras = tuple(jax.device_put(e, sh)
+                       for e, sh in zip(extras, self._extra_shardings))
+        self._t += 1
+        if self._optimizer.lr_scheduler is not None:
+            self._optimizer.num_update = self._t
+        lrs = jnp.asarray([self._optimizer._get_lr(i) for i in self._trainable_idx],
+                          dtype=jnp.float32)
+        wds = jnp.asarray([self._optimizer._get_wd(i) for i in self._trainable_idx],
+                          dtype=jnp.float32)
+        from .. import random as _rng
+        key = _rng.take_key()
+        train = [self._params[i] for i in self._trainable_idx]
+        aux = [self._params[i] for i in self._aux_idx]
+        loss, new_train, new_aux, new_states = self._step_fn(
+            train, aux, self._opt_states, x, y, extras, key, lrs, wds,
+            jnp.float32(self._t))
+        for j, i in enumerate(self._trainable_idx):
+            self._params[i] = new_train[j]
+        for j, i in enumerate(self._aux_idx):
+            self._params[i] = new_aux[j]
+        self._opt_states = new_states
+        return _mk_nd(loss)
+
+    __call__ = step
+
+    # ------------------------------------------------------------------
+    def sync_to_block(self):
+        """Write the on-mesh parameter values back into the Gluon block
+        (single-host gather; the checkpoint path)."""
+        import jax
+        for p, arr in zip(self._plist, self._params):
+            gathered = jax.device_get(arr)
+            for ctx, nd in p._data.items():
+                nd._set_data(jax.numpy.asarray(gathered, dtype=nd.data.dtype))
+
+    @property
+    def params(self):
+        return list(self._params)
+
+    @property
+    def mesh(self):
+        return self._mesh

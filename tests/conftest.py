@@ -2,7 +2,6 @@
 exercised without TPU hardware (SURVEY.md test strategy; the reference's
 CPU-default + context-parametrized pattern, tests/python/gpu/test_operator_gpu.py)."""
 import os
-import sys
 
 # The tests must run on a virtual 8-device CPU mesh, not the tunneled TPU chip
 # (its per-op dispatch latency makes eager tests ~100x slower, and the tunnel is
