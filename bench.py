@@ -1,0 +1,68 @@
+"""Benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Reference baseline: MXNet-CUDA ResNet-50 training, batch 32, 1x V100 =
+298.51 img/s (docs perf.md:244-255; BASELINE.md). The whole training step —
+forward, backward, SGD-momentum update — is one fused XLA computation
+(ParallelTrainStep on a 1-device mesh), bf16 compute / fp32 params.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as onp
+
+BASELINE_IMG_S = 298.51  # MXNet ResNet-50 training, batch 32, V100
+
+
+def main():
+    import os
+    batch = int(os.environ.get("BENCH_BATCH", 32))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    model = os.environ.get("BENCH_MODEL", "resnet50_v1")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(model, classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(onp.zeros((1, 3, 224, 224), "float32")))  # shapes
+
+    mesh = parallel.make_mesh({"dp": 1})
+    step = parallel.ParallelTrainStep(
+        net, gloss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.SGD(learning_rate=0.05, momentum=0.9), mesh,
+        compute_dtype="bfloat16")
+
+    import jax
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.float32),
+                       step._data_sharding)
+    y = jax.device_put(jnp.asarray(rng.randint(0, 1000, batch), jnp.float32),
+                       step._label_sharding)
+    from mxnet_tpu.parallel.train_step import _mk_nd
+    xn, yn = _mk_nd(x), _mk_nd(y)
+
+    for _ in range(warmup):
+        loss = step(xn, yn)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(xn, yn)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({"metric": "resnet50_train_img_s_per_chip",
+                      "value": round(img_s, 2), "unit": "img/s",
+                      "vs_baseline": round(img_s / BASELINE_IMG_S, 3)}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,6 +282,41 @@ class _TraceContext:
         self.aux_updates[pid] = value
 
 
+def _trace_nd(data) -> NDArray:
+    """Wrap a raw (possibly traced) jax array in a bare NDArray for tracing."""
+    arr = NDArray.__new__(NDArray)
+    arr._data = data
+    arr._ctx = Context("cpu", 0)
+    arr._grad = None
+    arr._grad_req = "null"
+    arr._tape_node = None
+    arr._tape_index = 0
+    return arr
+
+
+def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
+    """Run ``block`` as a pure function of explicit parameter arrays.
+
+    Returns (out_datas, aux_values, aux_param_ids): aux_* capture in-graph
+    state writes (BatchNorm moving stats) as extra outputs instead of side
+    effects. The single tracing primitive shared by CachedOp (hybridize) and
+    parallel.ParallelTrainStep (multi-chip training)."""
+    from .. import autograd, tracing, random as _rng
+    param_map = {id(p): _trace_nd(d) for p, d in zip(param_list, param_datas)}
+    inputs = [d if isinstance(d, NDArray) else _trace_nd(d) for d in input_datas]
+    tctx = _TraceContext(param_map, key)
+    with tracing.activate(tctx):
+        _rng.push_key_source(tctx.take_key)
+        try:
+            with autograd._RecordingStateScope(False, training):
+                out = block._eager_forward(*inputs)
+        finally:
+            _rng.pop_key_source()
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
+    return out_datas, tuple(tctx.aux_updates.values()), tuple(tctx.aux_updates)
+
+
 class CachedOp:
     """Compiled executor for a HybridBlock (cached_op.cc analog, XLA-backed)."""
 
@@ -298,33 +333,8 @@ class CachedOp:
         return self._param_list
 
     def _pure(self, training, param_datas, input_datas, key):
-        from .. import autograd, tracing, random as _rng
-        params = self._collect_param_list()
-        param_map = {}
-        for p, data in zip(params, param_datas):
-            arr = NDArray.__new__(NDArray)
-            arr._data = data
-            arr._ctx = Context("cpu", 0)
-            arr._grad = None
-            arr._grad_req = "null"
-            arr._tape_node = None
-            arr._tape_index = 0
-            param_map[id(p)] = arr
-        inputs = [NDArray(d) if not isinstance(d, NDArray) else d
-                  for d in input_datas]
-        tctx = _TraceContext(param_map, key)
-        with tracing.activate(tctx):
-            _rng.push_key_source(tctx.take_key)
-            try:
-                with autograd._RecordingStateScope(False, training):
-                    out = self.block._eager_forward(*inputs)
-            finally:
-                _rng.pop_key_source()
-        outs = out if isinstance(out, (list, tuple)) else (out,)
-        out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
-        aux = tuple(tctx.aux_updates.values())
-        aux_ids = tuple(tctx.aux_updates.keys())
-        return out_datas, aux, aux_ids
+        return pure_apply(self.block, self._collect_param_list(), param_datas,
+                          input_datas, key, training=training)
 
     def _get_fn(self, training):
         fn = self._fns.get(training)

@@ -1,3 +1,6 @@
 """gluon.model_zoo (parity: python/mxnet/gluon/model_zoo/)."""
 from . import vision
+from . import bert
 from .vision import get_model
+from .bert import (BERTModel, BERTForPretraining, bert_base, bert_large,
+                   shard_for_tensor_parallel)

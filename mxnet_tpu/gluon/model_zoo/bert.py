@@ -1,0 +1,223 @@
+"""BERT model family (the BASELINE.json "BERT-base pretraining" config).
+
+The reference carries the *ops* for BERT — fused interleaved attention matmuls
+(src/operator/contrib/transformer.cc:650-828), masked softmax
+(nn/softmax-inl.h:682-733), LayerNorm — while the model itself lives downstream
+in GluonNLP. Here the model is part of the model zoo so the benchmark config is
+self-contained.
+
+TPU-native design: every sub-block is a HybridBlock, so the whole pretraining
+step traces into ONE XLA computation. Attention uses a single fused QKV
+projection (the interleaved_matmul_selfatt design) so the MXU sees one big
+matmul. `shard_for_tensor_parallel` annotates the weights with PartitionSpecs
+(Megatron-style: QKV/FFN-in column-parallel, proj/FFN-out row-parallel) for
+ParallelTrainStep; sequence parallelism comes from sharding the sequence axis
+of the inputs (sp) and, for long contexts, parallel.ring_attention.
+"""
+from __future__ import annotations
+
+import math
+
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+
+__all__ = ["BERTEncoder", "BERTModel", "BERTForPretraining", "BERTPretrainingLoss",
+           "bert_base", "bert_large", "shard_for_tensor_parallel"]
+
+
+class SelfAttention(HybridBlock):
+    """Multi-head self-attention with fused QKV (contrib/transformer.cc:650
+    interleaved_matmul_selfatt_qk/valatt semantics, one projection matmul)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, in_units=units)
+            self.proj = Dense(units, flatten=False, in_units=units)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        qkv = self.qkv(x)
+        q = F.slice_axis(qkv, axis=-1, begin=0, end=self._units)
+        k = F.slice_axis(qkv, axis=-1, begin=self._units, end=2 * self._units)
+        v = F.slice_axis(qkv, axis=-1, begin=2 * self._units, end=3 * self._units)
+        out = F.multi_head_attention(q, k, v, mask, heads=self._heads)
+        return self.drop(self.proj(out))
+
+    # container block: children have static in_units, nothing deferred
+    def forward(self, x, mask=None):
+        return self.hybrid_forward(_F(), x, mask)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn1 = Dense(hidden_size, flatten=False, in_units=units)
+            self.ffn2 = Dense(units, flatten=False, in_units=hidden_size)
+            self.drop = Dropout(dropout)
+        self._act = activation
+
+    def forward(self, x):
+        F = _F()
+        h = self.ffn1(x)
+        h = getattr(F, self._act)(h)
+        return self.drop(self.ffn2(h))
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Post-LN transformer encoder layer (BERT convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = SelfAttention(units, num_heads, dropout)
+            self.ln1 = LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+            self.ln2 = LayerNorm(in_channels=units)
+
+    def forward(self, x, mask=None):
+        x = self.ln1(x + self.attention(x, mask))
+        x = self.ln2(x + self.ffn(x))
+        return x
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+        with self.name_scope():
+            for i in range(num_layers):
+                layer = TransformerEncoderLayer(units, hidden_size, num_heads,
+                                                dropout)
+                self.register_child(layer, f"layer{i}")
+                self._layers.append(layer)
+
+    def forward(self, x, mask=None):
+        for layer in self._layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler. Returns (sequence_output, pooled_output)."""
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072, num_heads=12,
+                 vocab_size=30522, max_length=512, type_vocab_size=2,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units)
+            self.token_type_embed = Embedding(type_vocab_size, units)
+            self.position_embed = Embedding(max_length, units)
+            self.embed_ln = LayerNorm(in_channels=units)
+            self.embed_drop = Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
+                                       dropout)
+            self.pooler = Dense(units, activation="tanh", flatten=False,
+                                in_units=units)
+
+    def forward(self, tokens, token_types=None, valid_mask=None):
+        F = _F()
+        B, S = tokens.shape[0], tokens.shape[1]
+        positions = F.arange(0, S, dtype="int32")
+        h = self.word_embed(tokens) + self.position_embed(positions)
+        if token_types is not None:
+            h = h + self.token_type_embed(token_types)
+        h = self.embed_drop(self.embed_ln(h))
+        attn_mask = None
+        if valid_mask is not None:
+            # (B, S) valid-token mask -> (B, 1, 1, S) attention mask
+            attn_mask = valid_mask.reshape(B, 1, 1, S)
+        seq = self.encoder(h, attn_mask)
+        pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1)
+                             .reshape(B, self._units))
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads over BERTModel; output logits.
+
+    forward(tokens, token_types, valid_mask) -> (mlm_logits, nsp_logits).
+    The MLM decoder ties to the word embedding (standard BERT)."""
+
+    def __init__(self, backbone: BERTModel, vocab_size=30522, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab = vocab_size
+        with self.name_scope():
+            self.backbone = backbone
+            self.mlm_transform = Dense(backbone._units, activation=None,
+                                       flatten=False, in_units=backbone._units)
+            self.mlm_ln = LayerNorm(in_channels=backbone._units)
+            self.nsp = Dense(2, flatten=False, in_units=backbone._units)
+
+    def forward(self, tokens, token_types=None, valid_mask=None):
+        F = _F()
+        seq, pooled = self.backbone(tokens, token_types, valid_mask)
+        h = self.mlm_ln(F.gelu(self.mlm_transform(seq)))
+        embed_w = self.backbone.word_embed.weight.data(
+            h.context if hasattr(h, "context") else None)
+        mlm = F.dot(h.reshape(-1, h.shape[-1]), embed_w.T) \
+            .reshape(h.shape[0], h.shape[1], self._vocab)
+        return mlm, self.nsp(pooled)
+
+
+class BERTPretrainingLoss(HybridBlock):
+    """Masked-LM + NSP loss. mlm_labels uses -1 for unmasked (ignored) positions
+    (the reference's SoftmaxOutput ignore_label convention, nn/softmax-inl.h)."""
+
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+        F = _F()
+        V = mlm_logits.shape[-1]
+        logp = F.log_softmax(mlm_logits, axis=-1)
+        labels = mlm_labels.astype("int32")
+        safe = F.maximum(labels, F.zeros_like(labels))
+        picked = F.pick(logp, safe.astype("float32"), axis=-1)
+        valid = (labels >= F.zeros_like(labels)).astype("float32")
+        mlm_loss = -(picked * valid).sum() / F.maximum(
+            valid.sum(), F.ones_like(valid.sum()))
+        nsp_logp = F.log_softmax(nsp_logits, axis=-1)
+        nsp_loss = -F.pick(nsp_logp, nsp_labels.astype("float32"), axis=-1).mean()
+        return mlm_loss + nsp_loss
+
+
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1, **kwargs):
+    return BERTModel(num_layers=12, units=768, hidden_size=3072, num_heads=12,
+                     vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, **kwargs)
+
+
+def bert_large(vocab_size=30522, max_length=512, dropout=0.1, **kwargs):
+    return BERTModel(num_layers=24, units=1024, hidden_size=4096, num_heads=16,
+                     vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, **kwargs)
+
+
+def shard_for_tensor_parallel(model: HybridBlock, tp_axis: str = "tp"):
+    """Annotate transformer weights with Megatron-style tensor-parallel specs.
+
+    Dense weights are (out, in): QKV and FFN-in shard the OUT dim (column
+    parallel — each chip holds a head/neuron slice); proj and FFN-out shard the
+    IN dim (row parallel — XLA inserts the all-reduce after the matmul).
+    Embeddings shard the vocab/feature dim. ParallelTrainStep reads the specs.
+    """
+    from jax.sharding import PartitionSpec as P
+    for name, p in model.collect_params().items():
+        if p.shape is None:
+            continue
+        if ("qkv" in name or "ffn1" in name) and name.endswith("weight"):
+            p.shard(P(tp_axis, None))
+        elif ("proj" in name or "ffn2" in name) and name.endswith("weight"):
+            p.shard(P(None, tp_axis))
+        elif "word_embed" in name and name.endswith("weight"):
+            p.shard(P(None, tp_axis))
+    return model
+
+
+def _F():
+    from ... import ndarray as nd_mod
+    return nd_mod

@@ -59,11 +59,11 @@ def convolution(x, weight, bias=None, *, kernel=None, stride=None, dilate=None,
     dilate = _tup(dilate, nd)
     pad = _tup(pad if pad is not None else 0, nd)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, _CONV_DN[nd])
+    # no preferred_element_type: the TPU MXU already accumulates bf16 convs in
+    # fp32, and requesting fp32 output breaks lax's conv transpose (grad) rule
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=[(p, p) for p in pad],
-        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    y = y.astype(x.dtype)
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         y = y + bias.reshape((1, -1) + (1,) * nd)
     return y

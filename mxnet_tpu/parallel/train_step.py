@@ -25,40 +25,7 @@ from .mesh import DeviceMesh
 __all__ = ["ParallelTrainStep", "pure_apply"]
 
 
-def _mk_nd(data) -> NDArray:
-    arr = NDArray.__new__(NDArray)
-    arr._data = data
-    arr._ctx = Context("cpu", 0)
-    arr._grad = None
-    arr._grad_req = "null"
-    arr._tape_node = None
-    arr._tape_index = 0
-    return arr
-
-
-def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
-    """Run ``block`` as a pure function of explicit parameter arrays.
-
-    Returns (out_datas, aux_values, aux_param_ids): aux_* capture in-graph state
-    writes (BatchNorm moving stats) as extra outputs instead of side effects.
-    This is the single tracing primitive shared by CachedOp (eager hybridize)
-    and ParallelTrainStep (multi-chip training).
-    """
-    from .. import autograd, tracing, random as _rng
-    from ..gluon.block import _TraceContext as TraceContext
-    param_map = {id(p): _mk_nd(d) for p, d in zip(param_list, param_datas)}
-    inputs = [_mk_nd(d) for d in input_datas]
-    tctx = TraceContext(param_map, key)
-    with tracing.activate(tctx):
-        _rng.push_key_source(tctx.take_key)
-        try:
-            with autograd._RecordingStateScope(False, training):
-                out = block._eager_forward(*inputs)
-        finally:
-            _rng.pop_key_source()
-    outs = out if isinstance(out, (list, tuple)) else (out,)
-    out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
-    return out_datas, tuple(tctx.aux_updates.values()), tuple(tctx.aux_updates)
+from ..gluon.block import pure_apply, _trace_nd as _mk_nd  # shared primitive
 
 
 class ParallelTrainStep:
@@ -179,8 +146,10 @@ class ParallelTrainStep:
                     block, plist, cur, (xin,) + tuple(extras), key, training=True)
                 aux_cell.clear()
                 aux_cell.extend(aux_pids)
-                out_nd = _mk_nd(outs[0])
-                loss_nd = loss_blk(out_nd, _mk_nd(y))
+                outs_nd = [_mk_nd(o) for o in outs]
+                labels_nd = [_mk_nd(l) for l in (y if isinstance(y, (tuple, list))
+                                                 else (y,))]
+                loss_nd = loss_blk(*outs_nd, *labels_nd)
                 loss_val = jnp.mean(loss_nd.data.astype(jnp.float32))
                 return loss_val, aux_vals
 
@@ -224,8 +193,14 @@ class ParallelTrainStep:
         import jax.numpy as jnp
         if self._step_fn is None:
             self._build()
+        if not isinstance(y, (tuple, list, NDArray)) and not hasattr(y, "shape"):
+            raise MXNetError(
+                "labels must be an array or a flat tuple/list of arrays "
+                f"(matching the loss signature); got {type(y).__name__}")
         x = x.data if isinstance(x, NDArray) else jnp.asarray(x)
-        y = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        y = jax.tree_util.tree_map(
+            lambda a: a.data if isinstance(a, NDArray) else jnp.asarray(a), y,
+            is_leaf=lambda a: isinstance(a, NDArray))
         extras = tuple(e.data if isinstance(e, NDArray) else jnp.asarray(e)
                        for e in extras)
         x = jax.device_put(x, self._data_sharding)
