@@ -38,15 +38,9 @@ def main():
         mx.optimizer.SGD(learning_rate=0.05, momentum=0.9), mesh,
         compute_dtype="bfloat16")
 
-    import jax
-    import jax.numpy as jnp
     rng = onp.random.RandomState(0)
-    x = jax.device_put(jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.float32),
-                       step._data_sharding)
-    y = jax.device_put(jnp.asarray(rng.randint(0, 1000, batch), jnp.float32),
-                       step._label_sharding)
-    from mxnet_tpu.parallel.train_step import _mk_nd
-    xn, yn = _mk_nd(x), _mk_nd(y)
+    xn, yn = step.place_batch(rng.rand(batch, 3, 224, 224).astype("float32"),
+                              rng.randint(0, 1000, batch).astype("float32"))
 
     for _ in range(warmup):
         loss = step(xn, yn)

@@ -46,10 +46,6 @@ class SelfAttention(HybridBlock):
         out = F.multi_head_attention(q, k, v, mask, heads=self._heads)
         return self.drop(self.proj(out))
 
-    # container block: children have static in_units, nothing deferred
-    def forward(self, x, mask=None):
-        return self.hybrid_forward(_F(), x, mask)
-
 
 class PositionwiseFFN(HybridBlock):
     def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
@@ -203,19 +199,33 @@ def shard_for_tensor_parallel(model: HybridBlock, tp_axis: str = "tp"):
     Dense weights are (out, in): QKV and FFN-in shard the OUT dim (column
     parallel — each chip holds a head/neuron slice); proj and FFN-out shard the
     IN dim (row parallel — XLA inserts the all-reduce after the matmul).
-    Embeddings shard the vocab/feature dim. ParallelTrainStep reads the specs.
+    Embeddings shard the hidden dim. ParallelTrainStep reads the specs.
+
+    Walks the block structure (auto-generated parameter names carry no role
+    information), so it works on any model composed of these blocks.
+    Returns the number of parameters annotated.
     """
     from jax.sharding import PartitionSpec as P
-    for name, p in model.collect_params().items():
-        if p.shape is None:
-            continue
-        if ("qkv" in name or "ffn1" in name) and name.endswith("weight"):
-            p.shard(P(tp_axis, None))
-        elif ("proj" in name or "ffn2" in name) and name.endswith("weight"):
-            p.shard(P(None, tp_axis))
-        elif "word_embed" in name and name.endswith("weight"):
-            p.shard(P(None, tp_axis))
-    return model
+    count = [0]
+
+    def annotate(p, spec):
+        p.shard(spec)
+        count[0] += 1
+
+    def visit(block):
+        if isinstance(block, SelfAttention):
+            annotate(block.qkv.weight, P(tp_axis, None))
+            annotate(block.qkv.bias, P(tp_axis))
+            annotate(block.proj.weight, P(None, tp_axis))
+        elif isinstance(block, PositionwiseFFN):
+            annotate(block.ffn1.weight, P(tp_axis, None))
+            annotate(block.ffn1.bias, P(tp_axis))
+            annotate(block.ffn2.weight, P(None, tp_axis))
+        elif isinstance(block, BERTModel):
+            annotate(block.word_embed.weight, P(None, tp_axis))
+
+    model.apply(visit)
+    return count[0]
 
 
 def _F():

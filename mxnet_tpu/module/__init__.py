@@ -1,0 +1,11 @@
+"""mx.mod: legacy Module training API (parity: python/mxnet/module/ —
+BaseModule.fit base_module.py:409, Module.bind/forward/backward/update
+module.py:364-646, BucketingModule bucketing_module.py:40, checkpointing
+module.py:165,793).
+
+TPU-native: Module drives the symbol Executor (autograd/XLA-backed) and the
+shared optimizer/kvstore stack; there is no separate "bound graph engine".
+"""
+from .module import BaseModule, Module, BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -230,6 +230,24 @@ class ParallelTrainStep:
 
     __call__ = step
 
+    def place_batch(self, x, y, *extras):
+        """Pre-place a batch on the mesh with the step's input shardings (for
+        input pipelines/benchmarks: subsequent step() calls see already-placed
+        arrays and skip the host transfer)."""
+        import jax
+        import jax.numpy as jnp
+        x = jax.device_put(jnp.asarray(x.data if isinstance(x, NDArray) else x),
+                           self._data_sharding)
+        y = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                jnp.asarray(a.data if isinstance(a, NDArray) else a),
+                self._label_sharding), y,
+            is_leaf=lambda a: isinstance(a, NDArray))
+        extras = tuple(
+            jax.device_put(jnp.asarray(e.data if isinstance(e, NDArray) else e), sh)
+            for e, sh in zip(extras, self._extra_shardings))
+        return (x, y) + extras
+
     # ------------------------------------------------------------------
     def sync_to_block(self):
         """Write the on-mesh parameter values back into the Gluon block

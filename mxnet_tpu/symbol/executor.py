@@ -1,0 +1,284 @@
+"""Executor: bound symbolic graph (parity: src/executor/graph_executor.h:57-92
+GraphExecutor::Init/Forward/Backward and python/mxnet/executor.py).
+
+TPU-native: no bespoke graph engine. The DAG evaluates through the `nd`
+frontend (one op implementation for imperative AND symbolic, like the shared
+nnvm registry in the reference), autograd supplies the backward pass
+(MXGradient analog), and XLA compiles/fuses. Memory planning, inplace
+detection, and op bulking (exec_pass.h:195-317) are subsumed by XLA buffer
+assignment + fusion.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import Context, MXNetError, current_context
+from ..ndarray.ndarray import NDArray
+from .symbol import Symbol, _is_aux_name
+
+__all__ = ["Executor"]
+
+# ops whose parameter shapes must be inferred from data shapes before the
+# per-node eval_shape pass can run (the deferred-shape part of InferShape)
+def _param_shape_hook(op, attrs, in_shapes, arg_names):
+    """Return {slot_index: shape} for unknown parameter slots."""
+    out = {}
+    data = in_shapes[0]
+    if data is None:
+        return out
+    if op == "FullyConnected":
+        units = int(attrs.get("num_hidden", 0))
+        flatten = attrs.get("flatten", True)
+        in_units = int(onp.prod(data[1:])) if flatten else data[-1]
+        out[1] = (units, in_units)
+        if len(arg_names) > 2:
+            out[2] = (units,)
+    elif op in ("Convolution", "Deconvolution"):
+        k = tuple(attrs.get("kernel") or ())
+        nf = int(attrs.get("num_filter", 0))
+        g = int(attrs.get("num_group", 1))
+        if op == "Convolution":
+            out[1] = (nf, data[1] // g) + k
+        else:
+            out[1] = (data[1], nf // g) + k
+        if len(arg_names) > 2:
+            out[2] = (nf,)
+    elif op == "BatchNorm":
+        axis = int(attrs.get("axis", 1))
+        c = data[axis]
+        for slot in (1, 2, 3, 4):
+            out[slot] = (c,)
+    elif op in ("LayerNorm", "RMSNorm", "InstanceNorm", "GroupNorm"):
+        axis = int(attrs.get("axis", -1))
+        c = data[axis]
+        for slot in range(1, len(arg_names)):
+            out[slot] = (c,)
+    elif op == "Embedding":
+        out[1] = (int(attrs.get("input_dim", 0)), int(attrs.get("output_dim", 0)))
+    return out
+
+
+def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False):
+    """Forward shape-inference walk (infer_graph_attr_pass.cc analog)."""
+    import jax
+    import jax.numpy as jnp
+    from .. import ndarray as nd_mod
+
+    nodes = sym._topo()
+    shapes: Dict[str, tuple] = dict(known)
+    node_out_shapes: Dict[int, tuple] = {}
+
+    for n in nodes:
+        if n.is_var:
+            if n.name not in shapes and "__shape__" in n.attrs:
+                shapes[n.name] = tuple(n.attrs["__shape__"])
+            continue
+        in_shapes = []
+        for inp in n.inputs:
+            if inp is None:
+                in_shapes.append(None)
+            elif inp[0].is_var:
+                in_shapes.append(shapes.get(inp[0].name))
+            else:
+                outs = node_out_shapes.get(id(inp[0]))
+                in_shapes.append(outs[inp[1]] if outs is not None else None)
+        # fill unknown parameter shapes from the hook
+        hook = _param_shape_hook(n.op, n.attrs, in_shapes, n.arg_names)
+        for slot, shp in hook.items():
+            if slot < len(n.inputs) and n.inputs[slot] is not None:
+                vn = n.inputs[slot][0]
+                if vn.is_var and vn.name not in shapes:
+                    shapes[vn.name] = shp
+                    in_shapes[slot] = shp
+        if any(s is None for i, s in enumerate(in_shapes)
+               if n.inputs[i] is not None):
+            if partial:
+                node_out_shapes[id(n)] = None
+                continue
+            missing = [n.inputs[i][0].name for i, s in enumerate(in_shapes)
+                       if s is None and n.inputs[i] is not None]
+            raise MXNetError(f"infer_shape: missing shapes for {missing} "
+                             f"(node {n.name})")
+        # per-node eval_shape through the nd frontend
+        fn = getattr(nd_mod, n.op)
+        structs = [jax.ShapeDtypeStruct(s, jnp.float32) if s is not None else None
+                   for s in in_shapes]
+
+        def run(*arrs):
+            from ..gluon.block import _trace_nd
+            nds = [(_trace_nd(a) if a is not None else None) for a in arrs]
+            while nds and nds[-1] is None:
+                nds.pop()
+            out = fn(*nds, **n.attrs)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            return tuple(o.data if isinstance(o, NDArray) else o for o in outs)
+
+        try:
+            out_structs = jax.eval_shape(run, *structs)
+        except Exception as e:  # noqa: BLE001
+            if partial:
+                node_out_shapes[id(n)] = None
+                continue
+            raise MXNetError(f"infer_shape failed at node {n.name} ({n.op}): {e}")
+        n.num_outputs = len(out_structs)
+        node_out_shapes[id(n)] = tuple(tuple(o.shape) for o in out_structs)
+
+    out_shapes = []
+    for s in sym._outputs():
+        if s._node.is_var:
+            out_shapes.append(shapes.get(s._node.name))
+        else:
+            outs = node_out_shapes.get(id(s._node))
+            out_shapes.append(outs[s._index] if outs else None)
+    return shapes, out_shapes, None
+
+
+class Executor:
+    """Bound graph executor (GraphExecutor analog, autograd/XLA-backed)."""
+
+    def __init__(self, sym: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = sym
+        self._ctx = ctx or current_context()
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(aux_names, aux_states))
+        self.arg_dict: Dict[str, NDArray] = dict(args or {})
+        self.aux_dict: Dict[str, NDArray] = dict(aux_states or {})
+        missing = [a for a in arg_names if a not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+
+        if isinstance(grad_req, str):
+            grad_req = {a: grad_req for a in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(arg_names, grad_req))
+        self._grad_req = grad_req
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip([a for a in arg_names
+                                  if grad_req.get(a, "write") != "null"],
+                                 args_grad))
+        self.grad_dict: Dict[str, NDArray] = dict(args_grad or {})
+        for a in arg_names:
+            req = grad_req.get(a, "write")
+            if req != "null" and a not in self.grad_dict:
+                arr = self.arg_dict[a]
+                import jax.numpy as jnp
+                self.grad_dict[a] = NDArray(jnp.zeros(arr.shape, arr.dtype),
+                                            ctx=arr.context)
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+        self.outputs: List[NDArray] = []
+        self._out_heads = None
+
+    # -- factory used by Symbol.simple_bind ---------------------------------
+    @staticmethod
+    def _simple_bind(sym: Symbol, ctx, grad_req, shape_kwargs):
+        import jax.numpy as jnp
+        shapes, _, _ = _infer_shapes(sym, {k: tuple(v)
+                                           for k, v in shape_kwargs.items()})
+        args = {}
+        for a in sym.list_arguments():
+            if a not in shapes:
+                raise MXNetError(f"simple_bind: could not infer shape of {a}")
+            args[a] = NDArray(jnp.zeros(shapes[a], jnp.float32), ctx=ctx)
+        aux = {}
+        for a in sym.list_auxiliary_states():
+            if a not in shapes:
+                raise MXNetError(f"simple_bind: could not infer shape of {a}")
+            aux[a] = NDArray(jnp.zeros(shapes[a], jnp.float32), ctx=ctx)
+        return Executor(sym, ctx, args, None, grad_req, aux)
+
+    # -- execution ----------------------------------------------------------
+    def _eval_graph(self):
+        from .. import ndarray as nd_mod
+        values: Dict[int, tuple] = {}
+        for n in self._symbol._topo():
+            if n.is_var:
+                if n.name in self.arg_dict:
+                    values[id(n)] = (self.arg_dict[n.name],)
+                elif n.name in self.aux_dict:
+                    values[id(n)] = (self.aux_dict[n.name],)
+                else:
+                    raise MXNetError(f"unbound variable {n.name}")
+                continue
+            ins = []
+            for inp in n.inputs:
+                ins.append(None if inp is None else values[id(inp[0])][inp[1]])
+            while ins and ins[-1] is None:
+                ins.pop()
+            out = getattr(nd_mod, n.op)(*ins, **n.attrs)
+            outs = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            n.num_outputs = len(outs)
+            values[id(n)] = outs
+        return [values[id(s._node)][s._index] for s in self._symbol._outputs()]
+
+    def forward(self, is_train=False, **kwargs):
+        from .. import autograd
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown argument {k}")
+            src = v if isinstance(v, NDArray) else NDArray(v)
+            self.arg_dict[k]._set_data(src.data.astype(self.arg_dict[k].dtype))
+        if is_train:
+            grad_vars = [self.arg_dict[a] for a in self._arg_names
+                         if self._grad_req.get(a, "write") != "null"]
+            grads = [self.grad_dict[a] for a in self._arg_names
+                     if self._grad_req.get(a, "write") != "null"]
+            reqs = [self._grad_req.get(a, "write") for a in self._arg_names
+                    if self._grad_req.get(a, "write") != "null"]
+            autograd.mark_variables(grad_vars, grads, reqs)
+            with autograd.record(train_mode=True):
+                self.outputs = self._eval_graph()
+                self._out_heads = list(self.outputs)
+        else:
+            with autograd.pause(train_mode=False):
+                self.outputs = self._eval_graph()
+            self._out_heads = None
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from .. import autograd
+        if self._out_heads is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        autograd.backward(self._out_heads, out_grads)
+
+    # -- accessors (executor.py parity) --------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[a] for a in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(a) for a in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[a] for a in self._aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(v.data.astype(self.arg_dict[k].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown parameter {k}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._set_data(v.data.astype(self.aux_dict[k].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        shapes = {a: kwargs.get(a, self.arg_dict[a].shape)
+                  for a in self._arg_names}
+        return Executor._simple_bind(self._symbol, self._ctx, self._grad_req,
+                                     {k: v for k, v in kwargs.items()})

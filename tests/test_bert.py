@@ -47,7 +47,8 @@ def test_bert_tp_sp_training_step():
     from jax.sharding import PartitionSpec as P
     model = _tiny()
     model.initialize()
-    bert.shard_for_tensor_parallel(model)
+    n_sharded = bert.shard_for_tensor_parallel(model)
+    assert n_sharded > 0, "tensor-parallel annotation must hit real parameters"
     mesh = parallel.make_mesh({"dp": 2, "tp": 2, "sp": 2})
     step = parallel.ParallelTrainStep(
         model, bert.BERTPretrainingLoss(), mx.optimizer.Adam(learning_rate=2e-3),

@@ -1,0 +1,132 @@
+"""Legacy Symbol/Executor/Module API tests (reference pattern:
+tests/python/unittest/test_module.py, test_symbol.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp_symbol():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_symbol_arguments_autocreate():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert "data" in args and "fc1_weight" in args and "fc1_bias" in args
+    assert "fc2_weight" in args and "softmax_label" in args
+
+
+def test_symbol_infer_shape():
+    s = _mlp_symbol()
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(8, 32),
+                                              softmax_label=(8,))
+    shapes = dict(zip(s.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (16, 32)
+    assert shapes["fc1_bias"] == (16,)
+    assert shapes["fc2_weight"] == (4, 16)
+    assert out_shapes[0] == (8, 4)
+
+
+def test_symbol_json_roundtrip():
+    s = _mlp_symbol()
+    s2 = mx.sym.load_json(s.tojson())
+    assert s2.list_arguments() == s.list_arguments()
+    arg_shapes, _, _ = s2.infer_shape(data=(4, 32), softmax_label=(4,))
+    assert dict(zip(s2.list_arguments(), arg_shapes))["fc1_weight"] == (16, 32)
+
+
+def test_executor_forward_backward():
+    s = _mlp_symbol()
+    ex = s.simple_bind(mx.cpu(), data=(8, 32), softmax_label=(8,))
+    rng = onp.random.RandomState(0)
+    for name in ("fc1_weight", "fc2_weight"):
+        arr = ex.arg_dict[name]
+        arr._set_data(mx.nd.array(
+            rng.randn(*arr.shape).astype("float32") * 0.1).data)
+    x = rng.randn(8, 32).astype("float32")
+    y = rng.randint(0, 4, (8,)).astype("float32")
+    out = ex.forward(is_train=True, data=x, softmax_label=y)
+    assert out[0].shape == (8, 4)
+    probs = out[0].asnumpy()
+    onp.testing.assert_allclose(probs.sum(-1), onp.ones(8), rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_module_fit_learns():
+    """Small real training asserting accuracy (reference pattern:
+    tests/python/train/test_mlp.py)."""
+    rng = onp.random.RandomState(42)
+    n, d = 256, 16
+    x = rng.randn(n, d).astype("float32")
+    w_true = rng.randn(d, 2).astype("float32")
+    y = (x @ w_true).argmax(-1).astype("float32")
+    train = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                              label_name="softmax_label")
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("softmax_label",), context=mx.cpu())
+    # SoftmaxOutput grads are per-sample (normalization="null"), so keep lr
+    # modest like the reference examples do
+    mod.fit(train, num_epoch=8, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.05})
+    train.reset()
+    score = mod.score(train, "acc")
+    assert dict(score)["accuracy"] > 0.9, score
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    s = _mlp_symbol()
+    m = mx.mod.Module(s, context=mx.cpu())
+    m.bind(data_shapes=[("data", (4, 32))],
+           label_shapes=[("softmax_label", (4,))])
+    m.init_params(mx.init.Uniform(0.1))
+    prefix = str(tmp_path / "mlp")
+    m.save_checkpoint(prefix, 3)
+    sym2, arg, aux = mx.mod.Module.load_checkpoint(prefix, 3)
+    assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    onp.testing.assert_allclose(arg["fc1_weight"].asnumpy(),
+                                m.get_params()[0]["fc1_weight"].asnumpy())
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=16, context=mx.cpu())
+    bm.bind(data_shapes=[("data", (4, 16))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params(mx.init.Uniform(0.1))
+    bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+
+    class B:
+        def __init__(self, key, n):
+            self.bucket_key = key
+            self.data = [mx.nd.array(onp.random.randn(4, n).astype("float32"))]
+            self.label = [mx.nd.array(onp.zeros(4, "float32"))]
+            self.provide_data = [("data", (4, n))]
+            self.provide_label = [("softmax_label", (4,))]
+
+    bm.forward(B(16, 16), is_train=True)
+    bm.backward()
+    bm.update()
+    # same parameters must serve the other bucket
+    bm.forward(B(16, 16), is_train=True)
+    out = bm.get_outputs()[0]
+    assert out.shape == (4, 8)
