@@ -169,13 +169,13 @@ class Module(BaseModule):
                     reqs[a] = req
         else:
             reqs = req
-        ex = self._symbol.simple_bind(self._context, grad_req="null",
-                                      **shape_kwargs)
-        # rebuild with per-arg reqs (simple_bind gave us shapes/arrays)
-        from ..symbol.executor import Executor
-        self._exec = Executor(self._symbol, self._context, ex.arg_dict, None,
-                              reqs, ex.aux_dict)
+        self._exec = self._symbol.simple_bind(self._context, grad_req=reqs,
+                                              **shape_kwargs)
         self.binded = True
+        preloaded = getattr(self, "_preloaded", None)
+        if preloaded is not None:
+            self.init_params(arg_params=preloaded[0], aux_params=preloaded[1],
+                             allow_missing=True)
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
@@ -190,9 +190,11 @@ class Module(BaseModule):
                 continue
             if arg_params and name in arg_params:
                 arr._set_data(arg_params[name].data.astype(arr.dtype))
-            elif not allow_missing or arg_params is None:
-                desc = init_mod.InitDesc(name)
-                initializer(desc, arr)
+            elif arg_params and not allow_missing:
+                raise MXNetError(f"Parameter {name} missing from arg_params "
+                                 "(pass allow_missing=True to initialize it)")
+            else:
+                initializer(init_mod.InitDesc(name), arr)
         for name, arr in self._exec.aux_dict.items():
             if aux_params and name in aux_params:
                 arr._set_data(aux_params[name].data.astype(arr.dtype))
@@ -306,6 +308,7 @@ class BucketingModule(BaseModule):
         self._kwargs = kwargs
         self._buckets: Dict = {}
         self._curr = None
+        self._curr_fwd = None
         self._shared_params = None
 
     @property
@@ -360,17 +363,22 @@ class BucketingModule(BaseModule):
         self._curr_fwd = mod
         mod.forward(data_batch, is_train)
 
+    def _active(self):
+        if self._curr_fwd is None:
+            raise MXNetError("BucketingModule: call forward() first")
+        return self._curr_fwd
+
     def backward(self, out_grads=None):
-        self._curr_fwd.backward(out_grads)
+        self._active().backward(out_grads)
 
     def update(self):
-        self._curr_fwd.update()
+        self._active().update()
 
     def get_outputs(self, merge_multi_context=True):
-        return self._curr_fwd.get_outputs()
+        return self._active().get_outputs()
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        self._curr_fwd.update_metric(eval_metric, labels)
+        self._active().update_metric(eval_metric, labels)
 
     def get_params(self):
         return self._curr.get_params()

@@ -226,6 +226,7 @@ def _install_wrappers():
 _install_wrappers()
 
 from . import random  # noqa: E402  (nd.random namespace)
+from . import contrib  # noqa: E402  (nd.contrib: control flow + contrib ops)
 from .utils import save, load  # noqa: E402
 
 waitall = None

@@ -1,0 +1,149 @@
+"""nd.contrib namespace: control flow + contrib op aliases.
+
+Parity: src/operator/control_flow.cc (_foreach:1089, _while_loop:1150,
+_cond:1211) and python/mxnet/ndarray/contrib.py. The reference implements these
+as stateful subgraph ops executed node-by-node; here they ARE the XLA-native
+structured-control-flow primitives (lax.scan / lax.while_loop / lax.cond) —
+SURVEY.md §2.2 "control flow → XLA While/Cond, natural fit".
+
+The body/cond callables receive NDArrays and may use any registered op; they
+are traced once (no data-dependent Python control flow inside, like the
+reference's requirement that subgraphs be static).
+"""
+from __future__ import annotations
+
+import sys as _sys
+from typing import Callable, List
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+_this = _sys.modules[__name__]
+
+
+def _wrap(datas):
+    from ..gluon.block import _trace_nd
+    if isinstance(datas, (list, tuple)):
+        return [_trace_nd(d) for d in datas]
+    return _trace_nd(datas)
+
+
+def _unwrap(nds):
+    if isinstance(nds, (list, tuple)):
+        return tuple(x.data if isinstance(x, NDArray) else x for x in nds)
+    return nds.data if isinstance(nds, NDArray) else nds
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan `body` over the leading axis of `data` (control_flow.cc:1089).
+
+    body(x_t, states) -> (out_t, new_states); returns (stacked_outs, states).
+    Lowers to ONE lax.scan — the loop body is compiled once regardless of
+    sequence length (vs. the reference's per-step subgraph replay).
+    """
+    import jax
+    from jax import lax
+
+    single_data = isinstance(data, NDArray)
+    single_state = isinstance(init_states, NDArray)
+    xs = data.data if single_data else tuple(d.data for d in data)
+    init = init_states.data if single_state else \
+        tuple(s.data for s in init_states)
+
+    def step(carry, x):
+        x_nd = _wrap(x)
+        s_nd = _wrap(carry)
+        out, new_s = body(x_nd, s_nd)
+        new_carry = new_s.data if isinstance(new_s, NDArray) else _unwrap(new_s)
+        return new_carry, _unwrap(out)
+
+    final, stacked = lax.scan(step, init, xs)
+    outs = tuple(NDArray(o) for o in stacked) if isinstance(stacked, tuple) \
+        else NDArray(stacked)
+    states = NDArray(final) if single_state else [NDArray(f) for f in final]
+    return outs, states
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars, max_iterations=None):
+    """Bounded while loop (control_flow.cc:1150).
+
+    cond(*loop_vars) -> boolean NDArray; func(*loop_vars) -> (step_output,
+    new_loop_vars). Returns (outputs, final_loop_vars). Outputs are stacked to
+    `max_iterations` with zero padding (static shapes on TPU; the reference
+    pads the same way and reports valid length).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static bound)")
+    lv = tuple(v.data for v in loop_vars)
+
+    probe_out, _ = func(*[_wrap(v) for v in lv])
+    probe_list = probe_out if isinstance(probe_out, (list, tuple)) else \
+        [probe_out]
+    out_bufs = tuple(jnp.zeros((max_iterations,) + tuple(o.shape),
+                               o.data.dtype if isinstance(o, NDArray) else o.dtype)
+                     for o in probe_list)
+
+    def c(state):
+        i, vars_, _ = state
+        ok = cond(*[_wrap(v) for v in vars_])
+        ok = ok.data if isinstance(ok, NDArray) else ok
+        # comparisons return float (mxnet convention); cast for the predicate
+        return (i < max_iterations) & ok.reshape(()).astype(bool)
+
+    def b(state):
+        i, vars_, bufs = state
+        out, new_vars = func(*[_wrap(v) for v in vars_])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        bufs = tuple(buf.at[i].set(o.data if isinstance(o, NDArray) else o)
+                     for buf, o in zip(bufs, outs))
+        return (i + 1, _unwrap(new_vars), bufs)
+
+    n, final_vars, bufs = lax.while_loop(c, b, (jnp.int32(0), lv, out_bufs))
+    outs = [NDArray(b_) for b_ in bufs]
+    return (outs[0] if len(outs) == 1 else outs,
+            [NDArray(v) for v in final_vars])
+
+
+def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
+    """Conditional execution (control_flow.cc:1211) — lax.cond, both branches
+    compiled, one executed."""
+    from jax import lax
+
+    p = pred.data if isinstance(pred, NDArray) else pred
+    inputs = inputs or []
+    datas = tuple(x.data for x in inputs)
+
+    def mk(fn):
+        def branch(args):
+            out = fn(*[_wrap(a) for a in args]) if args else fn()
+            return _unwrap(out) if isinstance(out, (list, tuple)) else \
+                (out.data if isinstance(out, NDArray) else out)
+        return branch
+
+    out = lax.cond(p.reshape(()).astype(bool), mk(then_func), mk(else_func),
+                   datas)
+    if isinstance(out, tuple):
+        return [NDArray(o) for o in out]
+    return NDArray(out)
+
+
+def _install_aliases():
+    """Expose _contrib_* registered ops under nd.contrib without the prefix."""
+    from ..ops import registry as _registry
+    from ..ops.registry import make_nd_wrapper
+    for name in _registry.list_ops():
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if not hasattr(_this, short):
+                setattr(_this, short, make_nd_wrapper(_registry.get_op(name)))
+        elif name in ("MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+                      "multi_sum_sq", "all_finite", "multi_all_finite",
+                      "reset_arrays"):
+            if not hasattr(_this, name):
+                setattr(_this, name, make_nd_wrapper(_registry.get_op(name)))
+
+
+_install_aliases()

@@ -1,0 +1,466 @@
+"""Contrib operators (parity: src/operator/contrib/ — multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, bounding_box.cc, roi_align.cc,
+multi_sum_sq, all_finite.cc, fft.cc, count_sketch.cc, hawkes_ll.cc).
+
+TPU-native design notes:
+- Detection ops keep STATIC shapes end-to-end: NMS marks suppressed rows with
+  class id -1 instead of compacting (XLA-friendly; the reference CUDA kernels
+  also keep fixed-size outputs, multibox_detection.cc). Suppression is a
+  sequential lax.fori_loop over a precomputed pairwise-IOU matrix — O(N²)
+  vectorized work on the VPU instead of data-dependent control flow.
+- Multi-tensor optimizer support ops (multi_sum_sq / all_finite family) are
+  variadic and fuse into one XLA computation per call.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# box geometry helpers
+# ---------------------------------------------------------------------------
+def _corner_iou(a, b):
+    """IOU for boxes in corner format. a: (..., N, 4), b: (..., M, 4) ->
+    (..., N, M)."""
+    ax1, ay1, ax2, ay2 = (a[..., i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., i] for i in range(4))
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner(box):
+    x, y, w, h = (box[..., i] for i in range(4))
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+
+
+def _corner_to_center(box):
+    x1, y1, x2, y2 = (box[..., i] for i in range(4))
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+@register("_contrib_box_iou", jit=True)
+def box_iou(lhs, rhs, *, format="corner"):
+    """Pairwise IOU (bounding_box.cc box_iou)."""
+    if format == "center":
+        lhs, rhs = _center_to_corner(lhs), _center_to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+@register("_contrib_box_nms", jit=True)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=0, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Greedy NMS with static shapes (bounding_box.cc BoxNMS). Suppressed /
+    invalid rows get all fields set to -1, ordering is by descending score."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, K = data.shape
+    scores = data[..., score_index]
+    ids = data[..., id_index] if id_index >= 0 else jnp.zeros_like(scores)
+    boxes = lax.dynamic_slice_in_dim(data, coord_start, 4, axis=2)
+    if in_format == "center":
+        boxes = _center_to_corner(boxes)
+
+    order = jnp.argsort(-scores, axis=1)
+    data_s = jnp.take_along_axis(data, order[..., None], axis=1)
+    scores_s = jnp.take_along_axis(scores, order, axis=1)
+    ids_s = jnp.take_along_axis(ids, order, axis=1)
+    boxes_s = jnp.take_along_axis(boxes, order[..., None], axis=1)
+
+    valid = scores_s > valid_thresh
+    if id_index >= 0 and background_id >= 0:
+        valid &= ids_s != background_id
+    if topk > 0:
+        valid &= jnp.arange(N)[None, :] < topk
+
+    iou = _corner_iou(boxes_s, boxes_s)                      # (B, N, N)
+    same_cls = (ids_s[..., :, None] == ids_s[..., None, :]) | force_suppress
+    upper = jnp.triu(jnp.ones((N, N), bool), k=1)[None]
+    suppress_pair = (iou > overlap_thresh) & same_cls & upper
+
+    def body(i, keep):
+        ki = keep[:, i] & valid[:, i]
+        return keep & ~(ki[:, None] & suppress_pair[:, i, :])
+
+    keep = lax.fori_loop(0, N, body, jnp.ones_like(valid))
+    keep &= valid
+    out = jnp.where(keep[..., None], data_s, -jnp.ones_like(data_s))
+    if squeeze:
+        out = out[0]
+    return out
+
+
+@register("_contrib_box_encode", jit=True)
+def box_encode(samples, matches, anchors, refs, *, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched boxes against anchors (bounding_box.cc BoxEncode)."""
+    a = _corner_to_center(anchors)
+    matched = jnp.take_along_axis(refs, matches[..., None].astype(jnp.int32),
+                                  axis=1)
+    g = _corner_to_center(matched)
+    means = jnp.asarray(means)
+    stds = jnp.asarray(stds)
+    t = jnp.stack([(g[..., 0] - a[..., 0]) / a[..., 2],
+                   (g[..., 1] - a[..., 1]) / a[..., 3],
+                   jnp.log(jnp.maximum(g[..., 2] / a[..., 2], 1e-12)),
+                   jnp.log(jnp.maximum(g[..., 3] / a[..., 3], 1e-12))], axis=-1)
+    t = (t - means) / stds
+    mask = (samples > 0.5)[..., None]
+    return jnp.where(mask, t, 0.0), mask.astype(t.dtype)
+
+
+@register("_contrib_box_decode", jit=True)
+def box_decode(data, anchors, *, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Decode box regressions against anchors (bounding_box.cc BoxDecode)."""
+    a = _corner_to_center(anchors) if format == "corner" else anchors
+    stds = jnp.asarray([std0, std1, std2, std3])
+    d = data * stds
+    x = d[..., 0] * a[..., 2] + a[..., 0]
+    y = d[..., 1] * a[..., 3] + a[..., 1]
+    dw, dh = d[..., 2], d[..., 3]
+    if clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    w = jnp.exp(dw) * a[..., 2]
+    h = jnp.exp(dh) * a[..., 3]
+    return _center_to_corner(jnp.stack([x, y, w, h], axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# MultiBox (SSD) family — multibox_prior.cc / multibox_target.cc /
+# multibox_detection.cc
+# ---------------------------------------------------------------------------
+@register("MultiBoxPrior", jit=True, differentiable=False)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation: (1, H*W*(S+R-1), 4) corner boxes in [0,1] coords."""
+    H, W = data.shape[-2], data.shape[-1]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[1] if steps[1] > 0 else 1.0 / H
+    step_x = steps[0] if steps[0] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[1]) * step_y
+    cx = (jnp.arange(W) + offsets[0]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    wh = []
+    for s in sizes:
+        wh.append((s, s))
+    for r in ratios[1:]:
+        sr = math.sqrt(r)
+        wh.append((sizes[0] * sr, sizes[0] / sr))
+    anchors = []
+    for w, h in wh:
+        anchors.append(jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                                  cy + h / 2], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("MultiBoxTarget", jit=True, differentiable=False)
+def multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor matching + target encoding. label: (B, M, 5) [cls, x1, y1, x2, y2]
+    with cls -1 padding. Returns (box_target (B, N*4), box_mask (B, N*4),
+    cls_target (B, N))."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    B, M = label.shape[0], label.shape[1]
+    gt_valid = label[..., 0] >= 0                           # (B, M)
+    gt_boxes = label[..., 1:5]
+    iou = _corner_iou(anchors[None], gt_boxes)              # (B, N, M)
+    iou = jnp.where(gt_valid[:, None, :], iou, 0.0)
+
+    best_gt = jnp.argmax(iou, axis=2)                       # (B, N)
+    best_iou = jnp.max(iou, axis=2)
+    matched = best_iou >= overlap_threshold
+    # force-match: each gt's best anchor
+    best_anchor = jnp.argmax(iou, axis=1)                   # (B, M)
+    forced = jnp.zeros((B, N), bool)
+    batch_idx = jnp.arange(B)[:, None]
+    forced = forced.at[batch_idx, best_anchor].set(gt_valid)
+    forced_gt = jnp.zeros((B, N), jnp.int32)
+    forced_gt = forced_gt.at[batch_idx, best_anchor].set(
+        jnp.broadcast_to(jnp.arange(M)[None], (B, M)))
+    gt_idx = jnp.where(forced, forced_gt, best_gt)
+    matched = matched | forced
+
+    matched_boxes = jnp.take_along_axis(gt_boxes, gt_idx[..., None], axis=1)
+    a = _corner_to_center(anchors)[None]
+    g = _corner_to_center(matched_boxes)
+    var = jnp.asarray(variances)
+    t = jnp.stack([(g[..., 0] - a[..., 0]) / a[..., 2],
+                   (g[..., 1] - a[..., 1]) / a[..., 3],
+                   jnp.log(jnp.maximum(g[..., 2] / a[..., 2], 1e-12)),
+                   jnp.log(jnp.maximum(g[..., 3] / a[..., 3], 1e-12))],
+                  axis=-1) / var
+    box_target = jnp.where(matched[..., None], t, 0.0).reshape(B, N * 4)
+    box_mask = jnp.where(matched[..., None],
+                         jnp.ones_like(t), 0.0).reshape(B, N * 4)
+    matched_cls = jnp.take_along_axis(label[..., 0], gt_idx, axis=1) + 1
+    cls_target = jnp.where(matched, matched_cls, 0.0)
+    return box_target, box_mask, cls_target
+
+
+@register("MultiBoxDetection", jit=True, differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, *, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + per-class NMS. cls_prob (B, C, N), loc_pred (B, N*4),
+    anchor (1, N, 4) -> (B, N, 6) rows [cls_id, score, x1, y1, x2, y2],
+    suppressed rows -1."""
+    B, C, N = cls_prob.shape
+    var = jnp.asarray(variances)
+    d = loc_pred.reshape(B, N, 4) * var
+    a = _corner_to_center(anchor.reshape(-1, 4))[None]
+    x = d[..., 0] * a[..., 2] + a[..., 0]
+    y = d[..., 1] * a[..., 3] + a[..., 1]
+    w = jnp.exp(d[..., 2]) * a[..., 2]
+    h = jnp.exp(d[..., 3]) * a[..., 3]
+    boxes = _center_to_corner(jnp.stack([x, y, w, h], axis=-1))
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # class with best prob excluding background; scores from that class
+    probs = cls_prob.transpose(0, 2, 1)                     # (B, N, C)
+    mask = jnp.arange(C)[None, None] != background_id
+    probs_nb = jnp.where(mask, probs, -jnp.inf)
+    cls_id = jnp.argmax(probs_nb, axis=-1)
+    score = jnp.take_along_axis(probs, cls_id[..., None], axis=-1)[..., 0]
+    cls_out = cls_id.astype(boxes.dtype) - (cls_id > background_id)
+    valid = score > threshold
+    rows = jnp.concatenate([jnp.where(valid, cls_out, -1.0)[..., None],
+                            jnp.where(valid, score, -1.0)[..., None],
+                            boxes], axis=-1)
+    return box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   background_id=-1, force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign / ROIPooling (roi_align.cc, roi_pooling.cc)
+# ---------------------------------------------------------------------------
+def _bilinear_sample(feat, ys, xs):
+    """feat (C, H, W); ys/xs arbitrary shape -> (C, *shape)."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, H - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, W - 1)
+    y1i = jnp.clip(y0i + 1, 0, H - 1)
+    x1i = jnp.clip(x0i + 1, 0, W - 1)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    out = (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+           + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+    oob = (ys < -1) | (ys > H) | (xs < -1) | (xs > W)
+    return jnp.where(oob, 0.0, out)
+
+
+@register("_contrib_ROIAlign", jit=True)
+def roi_align(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """ROIAlign (roi_align.cc). data (B, C, H, W); rois (R, 5) [b, x1, y1, x2, y2]."""
+    PH, PW = pooled_size
+    sr = max(int(sample_ratio), 1)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - off, roi[2] * spatial_scale - off, \
+            roi[3] * spatial_scale - off, roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bw, bh = rw / PW, rh / PH
+        iy = (jnp.arange(PH)[:, None] * bh + y1
+              + (jnp.arange(sr)[None, :] + 0.5) * bh / sr)      # (PH, sr)
+        ix = (jnp.arange(PW)[:, None] * bw + x1
+              + (jnp.arange(sr)[None, :] + 0.5) * bw / sr)      # (PW, sr)
+        ys = jnp.broadcast_to(iy[:, None, :, None], (PH, PW, sr, sr))
+        xs = jnp.broadcast_to(ix[None, :, None, :], (PH, PW, sr, sr))
+        feat = data[b]
+        vals = _bilinear_sample(feat, ys, xs)                   # (C, PH, PW, sr, sr)
+        return jnp.mean(vals, axis=(-1, -2))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("ROIPooling", jit=True)
+def roi_pooling(data, rois, *, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max ROI pooling (roi_pooling.cc) via dense ROIAlign-style sampling."""
+    PH, PW = pooled_size
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        sr = 4
+        iy = y1 + (jnp.arange(PH)[:, None] + 0.0) * rh / PH + \
+            (jnp.arange(sr)[None, :] + 0.5) * rh / (PH * sr)
+        ix = x1 + (jnp.arange(PW)[:, None] + 0.0) * rw / PW + \
+            (jnp.arange(sr)[None, :] + 0.5) * rw / (PW * sr)
+        ys = jnp.broadcast_to(iy[:, None, :, None], (PH, PW, sr, sr))
+        xs = jnp.broadcast_to(ix[None, :, None, :], (PH, PW, sr, sr))
+        vals = _bilinear_sample(data[b], ys, xs)
+        return jnp.max(vals, axis=(-1, -2))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor optimizer support (contrib multi_sum_sq.cc, all_finite.cc,
+# reset_arrays.cc) — variadic, fuse into one XLA computation
+# ---------------------------------------------------------------------------
+@register("multi_sum_sq", jit=True, differentiable=False)
+def multi_sum_sq(*arrays, num_arrays=0):
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+@register("all_finite", jit=True, differentiable=False)
+def all_finite(data, *, init_output=True):
+    return jnp.all(jnp.isfinite(data)).reshape(1)
+
+
+@register("multi_all_finite", jit=True, differentiable=False)
+def multi_all_finite(*arrays, num_arrays=0, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok &= jnp.all(jnp.isfinite(a))
+    return ok.reshape(1)
+
+
+@register("reset_arrays", differentiable=False)
+def reset_arrays(*arrays, num_arrays=0):
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+# ---------------------------------------------------------------------------
+# FFT (contrib fft.cc/ifft.cc — cuFFT in the reference, XLA FFT here)
+# ---------------------------------------------------------------------------
+@register("_contrib_fft", jit=True, differentiable=False)
+def contrib_fft(data, *, compute_size=128):
+    """rfft-style: real input (..., d) -> interleaved re/im (..., 2d)."""
+    out = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        data.shape[:-1] + (2 * data.shape[-1],)).astype(data.dtype)
+
+
+@register("_contrib_ifft", jit=True, differentiable=False)
+def contrib_ifft(data, *, compute_size=128):
+    d = data.shape[-1] // 2
+    c = data.reshape(data.shape[:-1] + (d, 2))
+    comp = c[..., 0] + 1j * c[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(data.dtype) * d
+
+
+# ---------------------------------------------------------------------------
+# count_sketch.cc / hawkes_ll.cc
+# ---------------------------------------------------------------------------
+@register("_contrib_count_sketch", jit=True, differentiable=False)
+def count_sketch(data, h, s, *, out_dim=0, processing_batch_size=32):
+    """Count sketch projection: out[b, h[i]] += s[i] * data[b, i]."""
+    B, D = data.shape
+    idx = h.reshape(-1).astype(jnp.int32)[:D]
+    sign = s.reshape(-1)[:D]
+    out = jnp.zeros((B, out_dim), data.dtype)
+    return out.at[:, idx].add(data * sign[None, :])
+
+
+@register("_contrib_hawkes_ll", jit=True)
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Hawkes process log-likelihood (hawkes_ll.cc), vectorized over batch."""
+    B, T = lags.shape
+    K = lda.shape[-1]
+    m = marks.astype(jnp.int32)
+
+    def step(carry, inp):
+        rem, ll = carry
+        lag, mark, idx = inp
+        valid = idx < valid_length
+        rem = rem * jnp.exp(-beta * lag[:, None])
+        intensity = lda + jnp.take_along_axis(rem, mark[:, None], axis=1)[:, 0] \
+            * jnp.take_along_axis(jnp.broadcast_to(alpha[None], (B, K)),
+                                  mark[:, None], axis=1)[:, 0]
+        ll = ll + jnp.where(valid, jnp.log(jnp.maximum(intensity, 1e-20)), 0.0)
+        rem = rem.at[jnp.arange(B), mark].add(jnp.where(valid, 1.0, 0.0))
+        return (rem, ll), None
+
+    rem0 = state if state is not None else jnp.zeros((B, K))
+    ll0 = -jnp.sum(lda * max_time, axis=-1) if lda.ndim > 1 else \
+        -lda.sum() * jnp.ones(B) * max_time
+    (rem, ll), _ = lax.scan(
+        step, (rem0, jnp.zeros(B)),
+        (lags.T, m.T, jnp.arange(T)))
+    return ll + ll0, rem
+
+
+# ---------------------------------------------------------------------------
+# misc contrib
+# ---------------------------------------------------------------------------
+@register("_contrib_quadratic", jit=True)
+def quadratic(data, *, a=0.0, b=0.0, c=0.0):
+    """The tutorial op (contrib quadratic_op.cc): a*x^2 + b*x + c."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("_contrib_index_copy", jit=True)
+def index_copy(old, index, new):
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array", jit=True, differentiable=False)
+def index_array(data, *, axes=None):
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(data.ndim))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64 if False else jnp.int32)
+
+
+@register("_contrib_getnnz", differentiable=False)
+def getnnz(data, *, axis=None):
+    return jnp.sum((data != 0).astype(jnp.int32), axis=axis)
+
+
+@register("_contrib_gradientmultiplier", jit=True)
+def gradient_multiplier(data, *, scalar=1.0):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    f.defvjp(fwd, bwd)
+    return f(data)

@@ -624,14 +624,23 @@ def div_sqrt_dim(x):
 
 
 @register("multi_head_attention", jit=True)
-def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=False):
-    """Batched SDPA: q/k/v (N, L, H*D). Composite op; the flash-attention Pallas
-    kernel (ops/pallas/flash_attention.py) is used by models for long sequences."""
+def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=False,
+                         use_flash=None):
+    """Batched SDPA: q/k/v (N, L, H*D). On TPU the unmasked/causal path runs the
+    flash-attention Pallas kernel (ops/pallas/flash_attention.py); padding-mask
+    and non-TPU paths use the XLA composite."""
     N, Lq, HD = q.shape
     D = HD // heads
     qh = q.reshape(N, Lq, heads, D).transpose(0, 2, 1, 3)
     kh = k.reshape(N, -1, heads, D).transpose(0, 2, 1, 3)
     vh = v.reshape(N, -1, heads, D).transpose(0, 2, 1, 3)
+    if use_flash is None:
+        from .pallas.flash_attention import _on_tpu
+        use_flash = mask is None and Lq == kh.shape[2] and _on_tpu()
+    if use_flash and mask is None and Lq == kh.shape[2]:
+        from .pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal)
+        return out.transpose(0, 2, 1, 3).reshape(N, Lq, heads * D)
     att = jnp.einsum("nhld,nhmd->nhlm", qh, kh,
                      preferred_element_type=jnp.float32) / math.sqrt(D)
     if causal:

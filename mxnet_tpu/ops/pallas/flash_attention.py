@@ -1,0 +1,181 @@
+"""Flash attention Pallas kernel.
+
+The TPU-native replacement for the reference's fused interleaved attention
+matmuls (src/operator/contrib/transformer.cc:650-828): instead of two fused
+batched GEMMs materializing the (S x S) score matrix in HBM, the kernel tiles
+Q into VMEM blocks and streams K/V blocks through VMEM with the online-softmax
+running (max, sum, out) accumulation — HBM traffic O(S·D) instead of O(S²),
+and every tile lands on the MXU at (block, head_dim) granularity.
+
+Forward is the Pallas kernel; backward is a custom VJP that recomputes
+attention blockwise with XLA einsums (the standard recompute-style flash
+backward; Pallas backward kernel is a further optimization).
+
+Layout: (B, H, S, D) with D the head dim. D should be a multiple of 128 lanes
+or small enough to pad; S blocks of 128/256 keep the MXU shape-friendly.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                          causal, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Bq, D)
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    q_offset = qi * block_q
+
+    num_k = pl.cdiv(seq_len, block_k)
+    if causal:
+        # only blocks at or before the diagonal contribute
+        num_k = jnp.minimum(num_k, (q_offset + block_q + block_k - 1) // block_k)
+
+    def body(ki, carry):
+        m_acc, l_acc, o_acc = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+        valid = cols < seq_len          # mask the padded K tail
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 0)
+            valid &= rows >= cols
+        s = jnp.where(valid, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    D = q_ref.shape[-1]
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    o0 = jnp.zeros((block_q, D), jnp.float32)
+    m_f, l_f, o_f = jax.lax.fori_loop(0, num_k, body, (m0, l0, o0))
+    l_safe = jnp.maximum(l_f, 1e-30)
+    o_ref[0] = (o_f / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m_f + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    B, H, S, D = q.shape
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    # pad S to a block multiple: pl.ds clamps out-of-range starts (silently
+    # re-reading earlier rows), so the kernel must never index past the buffer
+    Sp = -(-S // max(bq, bk)) * max(bq, bk)
+    if Sp != S:
+        pad = [(0, 0), (0, 0), (0, Sp - S), (0, 0)]
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    qr = q.reshape(B * H, Sp, D)
+    kr = k.reshape(B * H, Sp, D)
+    vr = v.reshape(B * H, Sp, D)
+    grid = (B * H, pl.cdiv(Sp, bq))
+    kernel = functools.partial(_attention_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=bk, seq_len=S)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sp, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sp, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sp, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    out = out.reshape(B, H, Sp, D)[:, :, :S]
+    lse = lse.reshape(B, H, Sp)[:, :, :S]
+    return out, lse
+
+
+def _dense_bwd(q, k, v, out, lse, g, sm_scale, causal):
+    """Recompute-style backward with XLA einsums (fp32 accumulation)."""
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * sm_scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])                       # softmax probs
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v32)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * sm_scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _dense_bwd(q, k, v, out, lse, g, sm_scale, causal)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+@register("flash_attention", jit=True)
+def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Fused attention over (B, H, S, D). Pallas kernel on TPU; interpreter
+    (still the same kernel) elsewhere so tests exercise identical code."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
+                  int(block_k), bool(interpret))

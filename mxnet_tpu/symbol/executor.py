@@ -18,7 +18,12 @@ from ..base import Context, MXNetError, current_context
 from ..ndarray.ndarray import NDArray
 from .symbol import Symbol, _is_aux_name
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "IncompleteShapeError"]
+
+
+class IncompleteShapeError(MXNetError):
+    """Not enough input shapes to complete inference (vs. a genuine shape
+    inconsistency, which raises plain MXNetError)."""
 
 # ops whose parameter shapes must be inferred from data shapes before the
 # per-node eval_shape pass can run (the deferred-shape part of InferShape)
@@ -99,8 +104,8 @@ def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False):
                 continue
             missing = [n.inputs[i][0].name for i, s in enumerate(in_shapes)
                        if s is None and n.inputs[i] is not None]
-            raise MXNetError(f"infer_shape: missing shapes for {missing} "
-                             f"(node {n.name})")
+            raise IncompleteShapeError(
+                f"infer_shape: missing shapes for {missing} (node {n.name})")
         # per-node eval_shape through the nd frontend
         fn = getattr(nd_mod, n.op)
         structs = [jax.ShapeDtypeStruct(s, jnp.float32) if s is not None else None
@@ -278,7 +283,18 @@ class Executor:
                 raise MXNetError(f"unknown aux state {k}")
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
-        shapes = {a: kwargs.get(a, self.arg_dict[a].shape)
+        """Re-bind with new input shapes, preserving parameter values whose
+        shapes are unchanged (executor.py reshape semantics)."""
+        shapes = {a: tuple(kwargs.get(a, self.arg_dict[a].shape))
                   for a in self._arg_names}
-        return Executor._simple_bind(self._symbol, self._ctx, self._grad_req,
-                                     {k: v for k, v in kwargs.items()})
+        new_ex = Executor._simple_bind(self._symbol, self._ctx, self._grad_req,
+                                       shapes)
+        for name, arr in self.arg_dict.items():
+            if name in new_ex.arg_dict and \
+                    new_ex.arg_dict[name].shape == arr.shape:
+                new_ex.arg_dict[name]._set_data(arr.data)
+        for name, arr in self.aux_dict.items():
+            if name in new_ex.aux_dict and \
+                    new_ex.aux_dict[name].shape == arr.shape:
+                new_ex.aux_dict[name]._set_data(arr.data)
+        return new_ex

@@ -239,10 +239,12 @@ class Symbol:
 
     # -- shape/type inference (infer_graph_attr_pass.cc analog) ---------------
     def infer_shape(self, **kwargs):
-        from .executor import _infer_shapes
+        from .executor import _infer_shapes, IncompleteShapeError
         try:
             shapes, out_shapes, aux_shapes = _infer_shapes(self, kwargs)
-        except MXNetError:
+        except IncompleteShapeError:
+            # under-specified is a soft failure (reference returns Nones);
+            # genuine shape inconsistencies propagate as MXNetError
             return None, None, None
         args = self.list_arguments()
         auxs = self.list_auxiliary_states()

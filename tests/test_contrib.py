@@ -1,0 +1,132 @@
+"""Contrib ops: detection (SSD config), control flow, multi-tensor support.
+Reference patterns: tests/python/unittest/test_contrib_operator.py,
+test_contrib_control_flow.py."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_box_iou():
+    a = nd.array([[0, 0, 2, 2]], dtype="float32")
+    b = nd.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]], dtype="float32")
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_box_nms_suppression():
+    # three boxes: two overlapping (same class), one distinct
+    rows = onp.array([[0, 0.9, 0, 0, 2, 2],
+                      [0, 0.8, 0.1, 0.1, 2, 2],
+                      [1, 0.7, 5, 5, 6, 6]], "float32")
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                             coord_start=2, score_index=1,
+                             id_index=0).asnumpy()
+    assert out[0][1] == pytest.approx(0.9)      # best kept
+    assert (out[1] == -1).all()                 # overlapping suppressed
+    assert out[2][0] == 1                       # other class kept
+
+
+def test_multibox_prior_shapes():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()
+    assert (a[..., 2] >= a[..., 0]).all()
+
+
+def test_multibox_target_matching():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       dtype="float32")
+    # one gt box matching the second anchor
+    label = nd.array([[[1.0, 0.55, 0.55, 0.95, 0.95]]], dtype="float32")
+    bt, bm, ct = nd.contrib.MultiBoxTarget(anchors, label, nd.zeros((1, 2, 2)))
+    ct = ct.asnumpy()
+    assert ct[0, 1] == 2.0          # class 1 -> target 2 (0 is background)
+    assert ct[0, 0] == 0.0
+    assert bm.asnumpy()[0, 4:].sum() == 4
+
+
+def test_multibox_detection_pipeline():
+    anchors = nd.array([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]]],
+                       dtype="float32")
+    cls_prob = nd.array([[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]],
+                        dtype="float32")  # (B=1, C=3, N=2)
+    loc = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc, anchors,
+                                       threshold=0.05).asnumpy()
+    assert out.shape == (1, 2, 6)
+    kept = out[0][out[0][:, 0] >= 0]
+    assert len(kept) == 2
+
+
+def test_roi_align():
+    feat = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = nd.array([[0, 0, 0, 3, 3]], dtype="float32")
+    out = mx.nd.contrib.ROIAlign(feat, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0, sample_ratio=1)
+    assert out.shape == (1, 1, 2, 2)
+    o = out.asnumpy()[0, 0]
+    assert o[0, 0] < o[1, 1]
+
+
+def test_foreach_scan():
+    def body(x, state):
+        new_s = state + x
+        return new_s, new_s
+
+    data = nd.array(onp.ones((5, 3), "float32"))
+    init = nd.zeros((3,))
+    outs, final = nd.contrib.foreach(body, data, init)
+    onp.testing.assert_allclose(final.asnumpy(), onp.full(3, 5.0))
+    onp.testing.assert_allclose(outs.asnumpy()[2], onp.full(3, 3.0))
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, final = nd.contrib.while_loop(cond, func,
+                                        [nd.array([0.0]), nd.array([0.0])],
+                                        max_iterations=8)
+    assert float(final[0].asscalar()) == 5.0
+    assert float(final[1].asscalar()) == 10.0   # 0+1+2+3+4
+
+
+def test_cond():
+    x = nd.array([2.0])
+    out = nd.contrib.cond(x.sum() > 1,
+                          lambda a: a * 2, lambda a: a * 3, inputs=[x])
+    assert float(out.asscalar()) == 4.0
+
+
+def test_all_finite_and_multi_sum_sq():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0, 4.0]])
+    ok = nd.contrib.all_finite(a)
+    assert bool(ok.asnumpy()[0])
+    bad = nd.array([onp.inf, 1.0])
+    assert not bool(nd.contrib.all_finite(bad).asnumpy()[0])
+    ss = nd.contrib.multi_sum_sq(a, b, num_arrays=2).asnumpy()
+    onp.testing.assert_allclose(ss, [5.0, 25.0])
+
+
+def test_fft_roundtrip():
+    x = nd.array(onp.random.RandomState(0).randn(2, 8).astype("float32"))
+    f = nd.contrib.fft(x)
+    assert f.shape == (2, 16)
+    back = nd.contrib.ifft(f) / 8
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(), atol=1e-4)
+
+
+def test_gradient_multiplier():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=0.5).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0.5, 0.5])

@@ -1,0 +1,63 @@
+"""Flash attention kernel vs dense reference (fwd + grads)."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _dense(q, k, v, causal=False):
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(jnp.float32(D))
+    if causal:
+        S = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    rng = onp.random.RandomState(0)
+    B, H, S, D = 2, 2, 256, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    out = flash_attention(q, k, v, causal=causal)
+    ref = _dense(q, k, v, causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-3, atol=2e-3)
+
+
+def test_flash_gradients_match_dense():
+    rng = onp.random.RandomState(1)
+    B, H, S, D = 1, 2, 128, 32
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=5e-3, atol=5e-3)
+
+
+def test_flash_uneven_seq():
+    rng = onp.random.RandomState(2)
+    B, H, S, D = 1, 1, 192, 64  # not a multiple of the 128 block
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    out = flash_attention(q, k, v)
+    ref = _dense(q, k, v)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-3, atol=2e-3)

@@ -102,6 +102,33 @@ def test_module_checkpoint_roundtrip(tmp_path):
                                 m.get_params()[0]["fc1_weight"].asnumpy())
 
 
+def test_module_load_restores_params(tmp_path):
+    s = _mlp_symbol()
+    m = mx.mod.Module(s, context=mx.cpu())
+    m.bind(data_shapes=[("data", (4, 32))],
+           label_shapes=[("softmax_label", (4,))])
+    m.init_params(mx.init.Uniform(0.1))
+    prefix = str(tmp_path / "m")
+    m.save_checkpoint(prefix, 1)
+    m2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+    m2.bind(data_shapes=[("data", (4, 32))],
+            label_shapes=[("softmax_label", (4,))])
+    onp.testing.assert_allclose(
+        m2.get_params()[0]["fc1_weight"].asnumpy(),
+        m.get_params()[0]["fc1_weight"].asnumpy())
+
+
+def test_executor_reshape_preserves_params():
+    s = _mlp_symbol()
+    ex = s.simple_bind(mx.cpu(), data=(8, 32), softmax_label=(8,))
+    ex.arg_dict["fc1_weight"]._set_data(
+        mx.nd.full(ex.arg_dict["fc1_weight"].shape, 0.7).data)
+    ex2 = ex.reshape(data=(16, 32), softmax_label=(16,))
+    assert ex2.arg_dict["data"].shape == (16, 32)
+    onp.testing.assert_allclose(ex2.arg_dict["fc1_weight"].asnumpy(),
+                                onp.full((16, 32), 0.7))
+
+
 def test_bucketing_module():
     def sym_gen(seq_len):
         data = sym.var("data")
