@@ -28,6 +28,7 @@ from . import recordio
 from . import image
 from . import profiler
 from . import runtime
+from . import engine
 from . import callback
 from . import visualization
 from . import util

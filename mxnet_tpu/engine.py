@@ -1,0 +1,86 @@
+"""mx.engine: engine control surface (parity: python/mxnet/engine.py bulk
+context managers + include/mxnet/engine.h push/wait API).
+
+On TPU the compute path is scheduled by PJRT/XLA async streams (op bulking is
+subsumed by XLA fusion, so `bulk` is a no-op context kept for API parity). The
+host-side dependency engine (native/engine.cc — ThreadedEngine semantics:
+per-var FIFO read/write deps, async push, exceptions at sync points) schedules
+IO/decode/checkpoint work; a Python fallback engine covers builds without the
+native library.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["Engine", "get_engine", "wait_all", "bulk", "set_bulk_size"]
+
+_engine = None
+_lock = threading.Lock()
+
+
+class _PythonEngine:
+    """Degraded fallback: synchronous execution, same API."""
+
+    def __init__(self, num_workers=0):
+        self._err = None
+        self._n = 0
+
+    def new_var(self):
+        self._n += 1
+        return self._n
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            if self._err is None:
+                self._err = e
+
+    def _raise(self):
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(str(err))
+
+    def wait_for_var(self, var):
+        self._raise()
+
+    def wait_all(self):
+        self._raise()
+
+    def close(self):
+        pass
+
+
+def Engine(num_workers=4):
+    """Create a host-task dependency engine (NativeEngine when built)."""
+    from . import native
+    if native.available():
+        return native.NativeEngine(num_workers)
+    return _PythonEngine(num_workers)
+
+
+def get_engine():
+    """Process-global engine (Engine::Get analog)."""
+    global _engine
+    with _lock:
+        if _engine is None:
+            _engine = Engine()
+        return _engine
+
+
+def wait_all():
+    """Block until all pushed host tasks complete (MXNDArrayWaitAll analog for
+    host work; device work syncs via NDArray.wait_to_read)."""
+    get_engine().wait_all()
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Op-bulking context (engine.py bulk). XLA fuses compiled regions, so this
+    is a no-op kept for API compatibility."""
+    yield
+
+
+def set_bulk_size(size):
+    return 0

@@ -204,17 +204,85 @@ class CSVIter(DataIter):
         return self._inner.iter_next()
 
 
+class NativeImageRecordIter(DataIter):
+    """C++ decode→augment→batch→prefetch pipeline over RecordIO
+    (mxnet_tpu/native/image_pipeline.cc; iter_image_recordio_2.cc analog)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean=None, std=None,
+                 preprocess_threads=4, label_width=1, seed=0,
+                 data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        import ctypes
+        from . import native
+        lib = native.get_lib()
+        if lib is None or not hasattr(lib, "mxtpu_impipe_create"):
+            raise MXNetError("native image pipeline unavailable: "
+                             f"{native.build_error()}")
+        self._lib = lib
+        c, h, w = data_shape
+        mean_arr = (ctypes.c_float * 3)(*(mean if mean is not None
+                                          else (0.0, 0.0, 0.0)))
+        std_arr = (ctypes.c_float * 3)(*(std if std is not None
+                                         else (1.0, 1.0, 1.0)))
+        self._h = lib.mxtpu_impipe_create(
+            str(path_imgrec).encode(), batch_size, c, h, w, int(shuffle),
+            preprocess_threads, int(rand_mirror), int(rand_crop), mean_arr,
+            std_arr, label_width, seed)
+        if not self._h:
+            raise MXNetError(f"could not open {path_imgrec}")
+        self._shape = (batch_size,) + tuple(data_shape)
+        self._label_width = label_width
+        self._data_name, self._label_name = data_name, label_name
+        self.provide_data = [DataDesc(data_name, self._shape)]
+        lshape = (batch_size,) if label_width == 1 else (batch_size, label_width)
+        self.provide_label = [DataDesc(label_name, lshape)]
+
+    def reset(self):
+        self._lib.mxtpu_impipe_reset(self._h)
+
+    def next(self):
+        import ctypes
+        data = onp.zeros(self._shape, "float32")
+        label = onp.zeros((self._shape[0], self._label_width), "float32")
+        n = self._lib.mxtpu_impipe_next(
+            self._h, data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n == 0:
+            raise StopIteration
+        from .ndarray import array
+        lab = label[:, 0] if self._label_width == 1 else label
+        return DataBatch(data=[array(data)], label=[array(lab)],
+                         pad=self._shape[0] - n)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.mxtpu_impipe_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
                     shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0,
                     mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
                     preprocess_threads=4, prefetch_buffer=4, **kwargs):
     """ImageRecordIter (src/io/iter_image_recordio_2.cc:887 parity): RecordIO
-    decode→augment→batch with thread prefetch."""
-    from .image import ImageIter, CreateAugmenter
+    decode→augment→batch with thread prefetch. Uses the native C++ pipeline
+    when built; otherwise the Python ImageIter + PrefetchingIter stack."""
     mean = onp.array([mean_r, mean_g, mean_b]) if (mean_r or mean_g or mean_b) \
         else None
     std = onp.array([std_r, std_g, std_b]) if (std_r != 1 or std_g != 1
                                                or std_b != 1) else None
+    from . import native
+    if native.available() and hasattr(native.get_lib(), "mxtpu_impipe_create"):
+        return NativeImageRecordIter(
+            path_imgrec, data_shape, batch_size, shuffle=shuffle,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
+            preprocess_threads=preprocess_threads,
+            label_width=kwargs.get("label_width", 1))
+    from .image import ImageIter, CreateAugmenter
     aug = CreateAugmenter(data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror,
                           mean=mean, std=std)
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
