@@ -194,12 +194,13 @@ class ImageIter:
 
     def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
                  path_imglist=None, path_root=None, shuffle=False, aug_list=None,
-                 **kwargs):
+                 seed=None, **kwargs):
         from .io import DataBatch, DataDesc
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._shuffle = shuffle
+        self._rng = pyrandom.Random(seed) if seed is not None else pyrandom
         self.auglist = aug_list if aug_list is not None else []
         self._records = []
         if path_imgrec:
@@ -224,7 +225,7 @@ class ImageIter:
     def reset(self):
         self._cursor = 0
         if self._shuffle:
-            pyrandom.shuffle(self._keys)
+            self._rng.shuffle(self._keys)
 
     def _next_sample(self):
         if self._cursor >= len(self._keys):

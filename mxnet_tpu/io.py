@@ -210,7 +210,7 @@ class NativeImageRecordIter(DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size, shuffle=False,
                  rand_crop=False, rand_mirror=False, mean=None, std=None,
-                 preprocess_threads=4, label_width=1, seed=0,
+                 preprocess_threads=4, label_width=1, seed=0, prefetch_buffer=4,
                  data_name="data", label_name="softmax_label"):
         super().__init__(batch_size)
         import ctypes
@@ -228,7 +228,7 @@ class NativeImageRecordIter(DataIter):
         self._h = lib.mxtpu_impipe_create(
             str(path_imgrec).encode(), batch_size, c, h, w, int(shuffle),
             preprocess_threads, int(rand_mirror), int(rand_crop), mean_arr,
-            std_arr, label_width, seed)
+            std_arr, label_width, seed, prefetch_buffer)
         if not self._h:
             raise MXNetError(f"could not open {path_imgrec}")
         self._shape = (batch_size,) + tuple(data_shape)
@@ -267,7 +267,7 @@ class NativeImageRecordIter(DataIter):
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
                     shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0,
                     mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
-                    preprocess_threads=4, prefetch_buffer=4, **kwargs):
+                    preprocess_threads=4, prefetch_buffer=4, seed=0, **kwargs):
     """ImageRecordIter (src/io/iter_image_recordio_2.cc:887 parity): RecordIO
     decode→augment→batch with thread prefetch. Uses the native C++ pipeline
     when built; otherwise the Python ImageIter + PrefetchingIter stack."""
@@ -280,13 +280,15 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
         return NativeImageRecordIter(
             path_imgrec, data_shape, batch_size, shuffle=shuffle,
             rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
-            preprocess_threads=preprocess_threads,
+            preprocess_threads=preprocess_threads, seed=seed,
+            prefetch_buffer=prefetch_buffer,
             label_width=kwargs.get("label_width", 1))
     from .image import ImageIter, CreateAugmenter
     aug = CreateAugmenter(data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror,
                           mean=mean, std=std)
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
-                      shuffle=shuffle, aug_list=aug, **kwargs)
+                      shuffle=shuffle, aug_list=aug,
+                      seed=seed if shuffle else None, **kwargs)
     return PrefetchingIter(inner, prefetch=prefetch_buffer)
 
 

@@ -103,7 +103,7 @@ def _configure(lib):
         lib.mxtpu_impipe_create.argtypes = [
             c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
             c.c_int, c.c_int, c.POINTER(c.c_float), c.POINTER(c.c_float),
-            c.c_int, c.c_int]
+            c.c_int, c.c_int, c.c_int]
         lib.mxtpu_impipe_next.restype = c.c_int
         lib.mxtpu_impipe_next.argtypes = [c.c_void_p,
                                           c.POINTER(c.c_float),

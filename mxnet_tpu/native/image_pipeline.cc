@@ -48,6 +48,7 @@ struct Config {
   int shuffle = 0, num_threads = 4, rand_mirror = 0, rand_crop = 0;
   int label_width = 1;
   int seed = 0;
+  int prefetch = 4;
   float mean[3] = {0, 0, 0};
   float std[3] = {1, 1, 1};
 };
@@ -60,7 +61,8 @@ struct Batch {
 class Pipeline {
  public:
   Pipeline(const char* rec_path, const Config& cfg)
-      : cfg_(cfg), rng_(cfg.seed) {
+      : cfg_(cfg), rng_(cfg.seed),
+        queue_depth_(static_cast<size_t>(std::max(1, cfg.prefetch))) {
     // index pass: record offsets for shuffling/epoch resets
     void* r = mxtpu_recio_reader_open(rec_path);
     if (!r) { failed_ = true; return; }
@@ -86,6 +88,7 @@ class Pipeline {
       std::shuffle(order_.begin(), order_.end(), rng_);
     }
     cursor_ = 0;
+    next_out_ = 0;
     epoch_done_ = false;
     StartWorkers();
   }
@@ -137,7 +140,7 @@ class Pipeline {
     }
     for (auto& t : threads_) t.join();
     threads_.clear();
-    std::queue<Batch>().swap(batches_);
+    batches_.clear();
   }
 
   // each worker claims a contiguous range of `batch` records, opens its own
@@ -173,7 +176,8 @@ class Pipeline {
         float* lab = b.label.data() +
                      static_cast<size_t>(b.n) * cfg_.label_width;
         if (hdr.flag > 1) {
-          int64_t lab_bytes = hdr.flag * 4;
+          int64_t lab_bytes = static_cast<int64_t>(hdr.flag) * 4;
+          if (img_len < lab_bytes) continue;  // truncated multi-label record
           int nl = std::min<int>(hdr.flag, cfg_.label_width);
           std::memcpy(lab, img, nl * 4);
           img += lab_bytes;
@@ -189,13 +193,18 @@ class Pipeline {
       }
       {
         std::unique_lock<std::mutex> lk(mu_);
-        in_cv_.wait(lk, [&] { return stop_ || batches_.size() < 4; });
+        // Admission by delivery order, not raw queue size: a size-based bound
+        // deadlocks when out-of-order batches fill the queue while the
+        // consumer waits for next_out_ and the worker holding it blocks here.
+        // The window guarantees the in-order batch is always admissible.
+        in_cv_.wait(lk, [&] {
+          return stop_ || batch_idx < next_out_ + queue_depth_;
+        });
         if (stop_) break;
-        if (b.n > 0) {
-          batches_.emplace(batch_idx, std::move(b));
-        } else {
-          ++empty_skips_;  // decode failures emptied the batch: advance order
-        }
+        // Emplace even when every record failed to decode (b.n == 0): Next()
+        // skips empty batches but must still see this index to advance
+        // next_out_, otherwise it waits forever on the gap.
+        batches_.emplace(batch_idx, std::move(b));
         out_cv_.notify_all();
       }
     }
@@ -250,7 +259,9 @@ class Pipeline {
   std::mt19937 rng_;
   std::mutex mu_;
   std::condition_variable in_cv_, out_cv_;
-  std::queue<Batch> batches_;
+  std::map<size_t, Batch> batches_;  // batch index -> batch, delivered in order
+  size_t next_out_ = 0;
+  size_t queue_depth_;
   std::vector<std::thread> threads_;
   bool stop_ = false, epoch_done_ = false, failed_ = false;
   int workers_done_ = 0;
@@ -263,7 +274,7 @@ extern "C" {
 void* mxtpu_impipe_create(const char* rec_path, int batch, int c, int h, int w,
                           int shuffle, int num_threads, int rand_mirror,
                           int rand_crop, const float* mean, const float* stdv,
-                          int label_width, int seed) {
+                          int label_width, int seed, int prefetch) {
   Config cfg;
   cfg.batch = batch;
   cfg.c = c;
@@ -275,6 +286,7 @@ void* mxtpu_impipe_create(const char* rec_path, int batch, int c, int h, int w,
   cfg.rand_crop = rand_crop;
   cfg.label_width = label_width;
   cfg.seed = seed;
+  cfg.prefetch = prefetch;
   if (mean) std::memcpy(cfg.mean, mean, 3 * sizeof(float));
   if (stdv) std::memcpy(cfg.std, stdv, 3 * sizeof(float));
   auto* p = new Pipeline(rec_path, cfg);

@@ -85,14 +85,28 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None):
     """Join the multi-host job (jax.distributed; replaces DMLC_PS_ROOT_URI/
-    DMLC_ROLE env bootstrapping, tools/launch.py)."""
+    DMLC_ROLE env bootstrapping, tools/launch.py).
+
+    jax.distributed.initialize() must run before any backend-initializing API,
+    so the already-initialized check reads the distributed client state rather
+    than calling jax.process_count() (which would initialize the backend and
+    make a later initialize() raise).
+    """
     import jax
-    if jax.process_count() > 1:
-        return  # already initialized by the launcher
+    try:
+        from jax._src.distributed import global_state
+        if global_state.client is not None:
+            return  # already initialized by the launcher
+    except ImportError:
+        pass  # private API moved: fall through, tolerate double-init below
     if coordinator_address is not None:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
+        try:
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        except RuntimeError as e:
+            if "already" not in str(e).lower():
+                raise
 
 
 def rank() -> int:

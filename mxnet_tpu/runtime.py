@@ -43,8 +43,8 @@ def _has_module(name):
 
 def _has_native_engine():
     try:
-        from ._native import lib  # noqa: F401
-        return lib is not None
+        from . import native
+        return native.get_lib() is not None
     except Exception:
         return False
 

@@ -385,16 +385,22 @@ def Group(symbols) -> Symbol:
 
 
 def load_json(json_str: str) -> Symbol:
+    """Parse symbol JSON: both the native mxnet_tpu/symbol-v1 format and
+    reference-exported MXNet graphs (nodes carry "attrs" or legacy "param";
+    "inputs"/"heads" entries are [id, index] or [id, index, version] — indexed,
+    not tuple-unpacked, so both arities work; "arg_nodes"/"node_row_ptr" are
+    metadata recomputable from the DAG and are ignored)."""
     data = json.loads(json_str)
     nodes: List[_SymNode] = []
     for jn in data["nodes"]:
-        attrs = {k: _unrepr(v) for k, v in jn["attrs"].items()}
-        inputs = [(nodes[i[0]], i[1]) if i is not None else None
-                  for i in jn["inputs"]]
+        raw_attrs = jn.get("attrs") or jn.get("param") or {}
+        attrs = {k: _unrepr(v) for k, v in raw_attrs.items()}
+        inputs = [(nodes[e[0]], e[1]) if e is not None else None
+                  for e in jn["inputs"]]
         op = None if jn["op"] == "null" else jn["op"]
         nodes.append(_SymNode(op, jn["name"], attrs, inputs,
                               tuple(jn.get("arg_names", ()))))
-    heads = [Symbol(nodes[i], j) for i, j in data["heads"]]
+    heads = [Symbol(nodes[e[0]], e[1]) for e in data["heads"]]
     return heads[0] if len(heads) == 1 else Group(heads)
 
 

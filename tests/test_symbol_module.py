@@ -157,3 +157,31 @@ def test_bucketing_module():
     bm.forward(B(16, 16), is_train=True)
     out = bm.get_outputs()[0]
     assert out.shape == (4, 8)
+
+
+def test_load_json_reference_format():
+    """Reference-exported MXNet symbol JSON has 3-element inputs/heads entries
+    ([id, index, version]) plus arg_nodes/node_row_ptr metadata; load_json must
+    accept it (symbol.py load_json; reference nnvm graph JSON)."""
+    import json
+    from mxnet_tpu import symbol as sym
+    ref_json = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": []},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "attrs": {"num_hidden": "4"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "node_row_ptr": [0, 1, 2, 3, 4],
+        "heads": [[3, 0, 0]],
+    })
+    s = sym.load_json(ref_json)
+    assert s.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    # legacy "param" attr container must also parse
+    legacy = json.loads(ref_json)
+    legacy["nodes"][3]["param"] = legacy["nodes"][3].pop("attrs")
+    s2 = sym.load_json(json.dumps(legacy))
+    assert s2.list_arguments() == s.list_arguments()
