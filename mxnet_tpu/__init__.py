@@ -34,6 +34,7 @@ from . import visualization
 from . import util
 from . import amp
 from . import parallel
+from . import sparse
 from . import symbol
 from . import symbol as sym
 from . import module

@@ -147,6 +147,17 @@ def _node_vjp(node: TapeNode, out_cots: List):
     if node.custom_vjp is not None:
         return node.custom_vjp(out_cots)
 
+    # Embedding with sparse_grad: the weight cotangent stays as (ids, rows)
+    # parts instead of a dense scatter into the full (vocab, dim) table
+    # (indexing_op.cc row_sparse Embedding gradient; SURVEY §7(d)).
+    if node.op is not None and node.op.name == "Embedding" \
+            and node.attrs.get("sparse_grad") and out_cots[0] is not None:
+        from .sparse import SparseCotangent
+        idx = node.inputs[0].data.reshape(-1).astype(jnp.int32)
+        dim = node.outputs[0].shape[-1]
+        cot = out_cots[0].reshape(-1, dim)
+        return [None, SparseCotangent([(idx, cot)], node.inputs[1].shape)]
+
     from .ops import registry as _reg
     jax_inputs = tuple(x.data for x in node.inputs)
     try:
@@ -181,10 +192,44 @@ def _node_vjp(node: TapeNode, out_cots: List):
     return list(vjp_exec(jax_inputs, cots))
 
 
+def _write_grad(x, val):
+    """Store an accumulated cotangent into x._grad honouring grad_req and the
+    grad buffer's storage type (dense vs row_sparse)."""
+    from .sparse import BaseSparseNDArray, RowSparseNDArray, SparseCotangent
+
+    if isinstance(val, SparseCotangent):
+        if isinstance(x._grad, RowSparseNDArray):
+            parts = list(val.parts)
+            if x._grad_req == "add" and x._grad.nnz > 0:
+                parts.append((x._grad._indices, x._grad._data))
+            rsp = SparseCotangent(parts, val.dense_shape).to_row_sparse(
+                ctx=x._grad.context)
+            x._grad._assign(rsp._indices, rsp._data.astype(x._grad.dtype))
+            return
+        val = val.todense()
+    if isinstance(x._grad, BaseSparseNDArray):
+        # dense cotangent flowing into a sparse grad buffer: keep semantics,
+        # lose the sparsity (cast_storage at the eager boundary)
+        from .sparse import cast_storage
+        from .ndarray.ndarray import NDArray as _ND
+        dense = _ND(val.astype(x._grad.dtype))
+        if x._grad_req == "add":
+            dense = _ND(x._grad.todense().data + dense.data)
+        rsp = cast_storage(dense, x._grad.stype)
+        x._grad._assign(rsp._indices, rsp._data)
+        return
+    g = val.astype(x._grad.data.dtype)
+    if x._grad_req == "add":
+        x._grad._set_data(x._grad.data + g)
+    else:
+        x._grad._set_data(g)
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Reverse pass from `heads` through the tape (autograd.py:244)."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
+    from .sparse import SparseCotangent
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -214,7 +259,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if not jnp.issubdtype(x.data.dtype, jnp.inexact):
                 continue
             prev = cots.get(id(x))
-            cots[id(x)] = g if prev is None else prev + g
+            if prev is None:
+                cots[id(x)] = g
+            elif isinstance(g, SparseCotangent):
+                cots[id(x)] = g + prev  # sparse-aware merge / densify
+            else:
+                cots[id(x)] = prev + g
 
     # write accumulated cotangents into .grad respecting grad_req
     seen = set()
@@ -224,17 +274,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 continue
             seen.add(id(x))
             if x._grad is not None and x._grad_req != "null" and id(x) in cots:
-                g = cots[id(x)].astype(x._grad.data.dtype)
-                if x._grad_req == "add":
-                    x._grad._set_data(x._grad.data + g)
-                else:
-                    x._grad._set_data(g)
+                _write_grad(x, cots[id(x)])
     for h in heads:  # heads that are themselves leaves
         if id(h) not in seen and h._grad is not None and id(h) in cots:
-            if h._grad_req == "add":
-                h._grad._set_data(h._grad.data + cots[id(h)])
-            else:
-                h._grad._set_data(cots[id(h)].astype(h._grad.data.dtype))
+            _write_grad(h, cots[id(h)])
 
     if not retain_graph:
         for node in tape:
@@ -249,6 +292,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     (autograd.py:271). create_graph (higher-order) is supported by re-recording."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
+    from .sparse import SparseCotangent
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -278,7 +322,12 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             if not jnp.issubdtype(x.data.dtype, jnp.inexact):
                 continue
             prev = cots.get(id(x))
-            cots[id(x)] = g if prev is None else prev + g
+            if prev is None:
+                cots[id(x)] = g
+            elif isinstance(g, SparseCotangent):
+                cots[id(x)] = g + prev  # sparse-aware merge / densify
+            else:
+                cots[id(x)] = prev + g
 
     results = []
     for v in variables:

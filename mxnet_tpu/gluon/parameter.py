@@ -136,7 +136,12 @@ class Parameter:
         self._grad = OrderedDict()
         from ..ndarray import zeros
         for ctx, arr in self._data.items():
-            g = zeros(self._shape, ctx=ctx, dtype=str(arr.dtype))
+            if self._grad_stype != "default":
+                from ..sparse import zeros as sparse_zeros
+                g = sparse_zeros(self._grad_stype, self._shape, ctx=ctx,
+                                 dtype=str(arr.dtype))
+            else:
+                g = zeros(self._shape, ctx=ctx, dtype=str(arr.dtype))
             self._grad[ctx] = g
             arr._grad = g
             arr._grad_req = self._grad_req
@@ -219,8 +224,13 @@ class Parameter:
         if self._grad is None:
             return
         import jax.numpy as jnp
+        from ..sparse import BaseSparseNDArray
         for g in self._grad.values():
-            g._set_data(jnp.zeros_like(g.data))
+            if isinstance(g, BaseSparseNDArray):
+                z = g.zeros_like()
+                g._assign(z._indices, z._data)
+            else:
+                g._set_data(jnp.zeros_like(g.data))
 
     def reset_ctx(self, ctx):
         if isinstance(ctx, Context):

@@ -90,6 +90,23 @@ class KVStore(KVStoreBase):
         """Sum a list of per-device gradients (CommDevice::Reduce analog)."""
         import jax
         import jax.numpy as jnp
+        from ..sparse import BaseSparseNDArray, RowSparseNDArray, add_n
+        if any(isinstance(v, BaseSparseNDArray) for v in values):
+            if all(isinstance(v, RowSparseNDArray) for v in values):
+                agg = values[0] if len(values) == 1 else add_n(values)
+                if self._multi_host:
+                    # gather (indices, values) parts from every worker, then
+                    # one jitted dedup — sparse on the wire, like the
+                    # reference's RowSparsePushPull server path
+                    from jax.experimental import multihost_utils
+                    idx = multihost_utils.process_allgather(agg._indices)
+                    vals = multihost_utils.process_allgather(agg._data)
+                    agg = add_n([RowSparseNDArray(v, i, agg.shape,
+                                                  ctx=agg.context)
+                                 for i, v in zip(idx, vals)])
+                return agg
+            values = [v.todense() if isinstance(v, BaseSparseNDArray) else v
+                      for v in values]
         if len(values) == 1:
             out = values[0].data
         else:
@@ -112,10 +129,12 @@ class KVStore(KVStoreBase):
         keys, values = _listify(key), _listify(value)
         if len(keys) == 1 and len(values) > 1:
             values = [values]
+        from ..sparse import BaseSparseNDArray
         for k, vlist in zip(keys, values):
             vlist = _listify(vlist)
             agg = self._reduce(vlist)
-            if self._compression is not None:
+            sparse_agg = isinstance(agg, BaseSparseNDArray)
+            if self._compression is not None and not sparse_agg:
                 agg = NDArray(self._compression.compress(k, agg), ctx=agg.context)
             if self._updater is not None:
                 if k not in self._store:
@@ -123,7 +142,11 @@ class KVStore(KVStoreBase):
                 self._updater(_key_int(k), agg, self._store[k])
             else:
                 if k in self._store and getattr(self, "_accumulate", False):
-                    self._store[k] += agg
+                    prev = self._store[k]
+                    if sparse_agg and not isinstance(prev, BaseSparseNDArray):
+                        self._store[k] = prev + agg.todense()
+                    else:
+                        self._store[k] = prev + agg
                 else:
                     self._store[k] = agg
 
@@ -164,18 +187,30 @@ class KVStore(KVStoreBase):
         self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull: gathers only requested rows (kvstore.h:178). Dense-backed."""
+        """Sparse pull: only the requested rows travel (kvstore.h:178
+        PullRowSparse). The store is dense; a RowSparseNDArray `out` receives
+        exactly the gathered rows, a dense `out` a zero-padded dense copy."""
+        import jax.numpy as jnp
+        from ..sparse import RowSparseNDArray
         keys = _listify(key)
         outs = _listify(out)
         rids = _listify(row_ids)
+        if len(keys) == 1 and len(outs) > 1:
+            keys = keys * len(outs)
+        if len(rids) == 1 and len(outs) > 1:
+            rids = rids * len(outs)
         for k, o, r in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
             src = self._store[k]
-            rows = src.take(r.astype("int32") if hasattr(r, "astype") else r, axis=0)
-            full = src.zeros_like()
-            import jax.numpy as jnp
-            idx = (r.data if isinstance(r, NDArray) else jnp.asarray(r)).astype(jnp.int32)
-            full._set_data(full.data.at[idx].set(rows.data))
-            full.copyto(o)
+            idx = (r.data if isinstance(r, NDArray)
+                   else jnp.asarray(onp_asarray(r))).reshape(-1).astype(jnp.int32)
+            rows = src.data.at[idx].get(mode="fill", fill_value=0)
+            if isinstance(o, RowSparseNDArray):
+                o._assign(idx, rows.astype(o.dtype))
+            else:
+                full = jnp.zeros_like(src.data).at[idx].set(rows)
+                o._set_data(full.astype(o.data.dtype))
 
     # -- lifecycle / dist control plane (ps-lite scheduler analog) -----------
     def barrier(self, priority=0):
@@ -200,6 +235,11 @@ class KVStore(KVStoreBase):
 
     def __repr__(self):
         return f"<KVStore type={self._type} rank={self.rank}/{self.num_workers}>"
+
+
+def onp_asarray(x):
+    import numpy as _onp
+    return _onp.asarray(x)
 
 
 def _key_int(k):

@@ -228,6 +228,7 @@ _install_wrappers()
 from . import random  # noqa: E402  (nd.random namespace)
 from . import contrib  # noqa: E402  (nd.contrib: control flow + contrib ops)
 from .utils import save, load  # noqa: E402
+from .. import sparse  # noqa: E402  (nd.sparse namespace, reference parity)
 
 waitall = None
 

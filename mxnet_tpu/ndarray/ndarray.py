@@ -185,7 +185,13 @@ class NDArray:
     def attach_grad(self, grad_req: str = "write", stype=None):
         """Allocate a gradient buffer for this array (ndarray.py attach_grad parity)."""
         jnp = _jnp()
-        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype), ctx=self._ctx)
+        if stype is not None and stype != "default":
+            from ..sparse import zeros as sparse_zeros
+            self._grad = sparse_zeros(stype, self.shape, ctx=self._ctx,
+                                      dtype=str(self._data.dtype))
+        else:
+            self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype),
+                                 ctx=self._ctx)
         self._grad_req = grad_req
 
     @property

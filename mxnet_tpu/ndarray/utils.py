@@ -40,10 +40,22 @@ def save(fname: str, data) -> None:
         items = dict(data)
     else:
         raise MXNetError("save expects NDArray, list, or dict of NDArrays")
+    from ..sparse import BaseSparseNDArray, CSRNDArray
     payload = {}
     for k, v in items.items():
         if not isinstance(v, NDArray):
             raise MXNetError(f"save: value for {k!r} is not an NDArray")
+        if isinstance(v, BaseSparseNDArray):
+            # sparse arrays keep their components (ndarray.cc:1679 stores aux
+            # data for kRowSparse/kCSR storage the same way)
+            payload[f"{k}::stype"] = onp.asarray([v.stype])
+            payload[f"{k}::shape"] = onp.asarray(v.shape, onp.int64)
+            payload[f"{k}::indices"] = onp.asarray(v._indices)
+            if isinstance(v, CSRNDArray):
+                payload[f"{k}::indptr"] = onp.asarray(v._indptr)
+            np_arr, is_bf16 = _to_numpy(v.data)
+            payload[f"{k}::values" + (_BF16_SUFFIX if is_bf16 else "")] = np_arr
+            continue
         np_arr, is_bf16 = _to_numpy(v)
         payload[k + (_BF16_SUFFIX if is_bf16 else "")] = np_arr
     payload["__magic__"] = onp.asarray([_MAGIC])
@@ -56,14 +68,34 @@ def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
     import ml_dtypes
     with onp.load(fname, allow_pickle=False) as z:
         keys = [k for k in z.files if k != "__magic__"]
-        out = {}
+        raw = {}
         for k in keys:
             arr = z[k]
             name = k
             if k.endswith(_BF16_SUFFIX):
                 name = k[: -len(_BF16_SUFFIX)]
                 arr = arr.view(ml_dtypes.bfloat16)
-            out[name] = NDArray(arr)
+            raw[name] = arr
+    sparse_bases = {k[: -len("::stype")] for k in raw if k.endswith("::stype")}
+    out = {}
+    for k, arr in raw.items():
+        base, _, part = k.rpartition("::")
+        if base in sparse_bases and part in ("stype", "shape", "indices",
+                                             "indptr", "values"):
+            continue
+        out[k] = NDArray(arr)
+    if sparse_bases:
+        from ..sparse import CSRNDArray, RowSparseNDArray
+        for base in sparse_bases:
+            stype = str(raw[f"{base}::stype"][0])
+            shape = tuple(int(s) for s in raw[f"{base}::shape"])
+            if stype == "row_sparse":
+                out[base] = RowSparseNDArray(raw[f"{base}::values"],
+                                             raw[f"{base}::indices"], shape)
+            else:
+                out[base] = CSRNDArray(raw[f"{base}::values"],
+                                       raw[f"{base}::indices"],
+                                       raw[f"{base}::indptr"], shape)
     if out and all(k.startswith("__idx__") for k in out):
         return [out[f"__idx__{i}"] for i in range(len(out))]
     return out

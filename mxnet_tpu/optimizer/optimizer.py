@@ -148,6 +148,10 @@ class Optimizer:
         lr = jnp.float32(self._get_lr(index))
         wd = jnp.float32(self._get_wd(index))
         t = jnp.float32(self._index_update_count[index])
+        from ..sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            self._sparse_update(weight, grad, state, lr, wd, t)
+            return
         use_master = (isinstance(state, tuple) and len(state) == 2
                       and isinstance(state[0], NDArray)
                       and state[0].dtype != weight.dtype)
@@ -165,6 +169,71 @@ class Optimizer:
                 weight.data, g, _unwrap_state(state), lr, wd, t)
             weight._set_data(new_w)
             _rewrap_state(state, new_state)
+
+
+    # -- sparse (row_sparse grad) update: the reference's lazy update ---------
+    def _sparse_update(self, weight, grad, state, lr, wd, t):
+        """Row-wise lazy update (optimizer_op.cc sparse sgd/adam variants):
+        gather the touched rows of weight+state, run the same elementwise
+        ``_rule`` on just those rows, scatter back. Untouched rows see neither
+        weight decay nor momentum decay — the reference's lazy_update=True
+        semantics, and the only scalable scheme for big embedding tables.
+
+        Padding rows (index == num_rows, from the static-nnz dedup) gather
+        zeros and their scattered updates are dropped by XLA."""
+        if grad.nnz == 0:
+            return
+        rsp = grad.dedup()  # sorted unique ids, summed duplicate rows
+        g = self._preprocess_grad(rsp._data.astype(weight.data.dtype))
+        use_master = (isinstance(state, tuple) and len(state) == 2
+                      and isinstance(state[0], NDArray)
+                      and state[0].dtype != weight.dtype)
+        if use_master:
+            master, inner = state
+            import jax.numpy as jnp
+            new_m, new_state = self._jitted_sparse_rule()(
+                master.data, g.astype(jnp.float32), rsp._indices,
+                _unwrap_state(inner), lr, wd, t)
+            master._set_data(new_m)
+            weight._set_data(new_m.astype(weight.dtype))
+            _rewrap_state(inner, new_state)
+        else:
+            new_w, new_state = self._jitted_sparse_rule()(
+                weight.data, g, rsp._indices, _unwrap_state(state), lr, wd, t)
+            weight._set_data(new_w)
+            _rewrap_state(state, new_state)
+
+    def _sparse_rule(self, w, g_rows, idx, state, lr, wd, t):
+        import jax.numpy as jnp
+
+        def gather(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(gather(x) for x in s)
+            return s.at[idx].get(mode="fill", fill_value=0)
+
+        def scatter(s, new_rows):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(scatter(x, nr) for x, nr in zip(s, new_rows))
+            return s.at[idx].set(new_rows.astype(s.dtype), mode="drop")
+
+        w_rows = w.at[idx].get(mode="fill", fill_value=0)
+        new_rows, new_state_rows = self._rule(w_rows, g_rows, gather(state),
+                                              lr, wd, t)
+        new_w = w.at[idx].set(new_rows.astype(w.dtype), mode="drop")
+        return new_w, scatter(state, new_state_rows)
+
+    def _jitted_sparse_rule(self):
+        key = (self.__class__.__name__, "sparse")
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            import jax
+            fn = jax.jit(self._sparse_rule, donate_argnums=(0, 3))
+            self._jit_cache[key] = fn
+        return fn
 
 
 def _unwrap_state(state):

@@ -1,0 +1,572 @@
+"""Sparse storage: RowSparseNDArray / CSRNDArray.
+
+Parity surface: python/mxnet/ndarray/sparse.py (RowSparseNDArray, CSRNDArray,
+row_sparse_array, csr_matrix, cast_storage, retain, dot over
+src/operator/tensor/dot-inl.h and cast_storage-inl.h; storage kinds
+include/mxnet/ndarray.h:61-65).
+
+TPU-native design (SURVEY.md §7(d)): XLA has no sparse buffers, so a sparse
+array is a pair/triple of *dense* device arrays with a statically known nnz —
+RowSparse = (indices[nnz], values[nnz, ...cols]), CSR = (data[nnz],
+indices[nnz], indptr[rows+1]). Everything compute-shaped stays jitted:
+  - sparse→dense is a scatter, dense rows→sparse a gather (static nnz);
+  - duplicate-index reduction ("dedup") is sort + segment_sum with the output
+    padded to the input nnz and out-of-range row ids marking padding — XLA
+    scatters drop out-of-bounds updates, so padded rows are inert;
+  - csr·dense / csrᵀ·dense are segment_sum contractions over the (static)
+    nonzero list.
+Only storage casts whose nnz is data-dependent (dense→sparse) inspect values,
+and those run on host at the eager boundary — the same place the reference
+runs its cast_storage CPU kernel.
+
+Row indices are int32 (the int64 reference indices exceed what we enable on
+this stack; vocabularies beyond 2^31 rows are out of scope).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
+           "cast_storage", "retain", "dot", "add_n"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels (cached per shape/dtype by jax.jit)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _dedup_fn():
+    import jax
+
+    def dedup(idx, vals, n_rows):
+        # Sum values of duplicate row ids. Output keeps the input nnz:
+        # unique ids (sorted) padded with n_rows (an out-of-range id that XLA
+        # scatters drop), padded value rows are zero.
+        jnp = _jnp()
+        n = idx.shape[0]
+        uniq, inv = jnp.unique(idx, return_inverse=True, size=n,
+                               fill_value=n_rows)
+        summed = jax.ops.segment_sum(vals, inv.reshape(-1), num_segments=n)
+        return uniq.astype(jnp.int32), summed
+
+    return jax.jit(dedup, static_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=None)
+def _rsp_to_dense_fn():
+    import jax
+
+    def scatter(idx, vals, n_rows):
+        jnp = _jnp()
+        out = jnp.zeros((n_rows,) + vals.shape[1:], vals.dtype)
+        return out.at[idx].add(vals, mode="drop")
+
+    return jax.jit(scatter, static_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=None)
+def _csr_to_dense_fn():
+    import jax
+
+    def scatter(data, col_idx, row_ids, shape):
+        jnp = _jnp()
+        out = jnp.zeros(shape, data.dtype)
+        return out.at[row_ids, col_idx].add(data, mode="drop")
+
+    return jax.jit(scatter, static_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=None)
+def _csr_dot_fn(transpose_a: bool):
+    import jax
+
+    def dot(data, col_idx, row_ids, rhs, n_rows):
+        # csr (m,n) · dense (n,k) -> (m,k):   out[r] += data * rhs[col]
+        # csrᵀ (n,m) · dense (m,k) -> (n,k):  out[col] += data * rhs[r]
+        contrib_idx = col_idx if not transpose_a else row_ids
+        seg_idx = row_ids if not transpose_a else col_idx
+        contrib = data[:, None] * rhs[contrib_idx]
+        return jax.ops.segment_sum(contrib, seg_idx, num_segments=n_rows)
+
+    return jax.jit(dot, static_argnums=(4,))
+
+
+# ---------------------------------------------------------------------------
+# classes
+# ---------------------------------------------------------------------------
+class BaseSparseNDArray(NDArray):
+    """Common surface of the sparse storage types.
+
+    ``self._data`` holds the *values* device array so the inherited engine
+    semantics (wait_to_read, context, dtype) apply; the logical dense shape
+    lives in ``_dense_shape``.
+    """
+
+    __slots__ = ("_dense_shape", "_indices", "_indptr")
+
+    # NDArray.data returns the raw jax.Array; the reference's sparse API
+    # exposes .data as the values *NDArray* — keep that parity here.
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def shape(self):
+        return self._dense_shape
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def size(self):
+        return int(onp.prod(self._dense_shape)) if self._dense_shape else 1
+
+    @property
+    def ndim(self):
+        return len(self._dense_shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._data.shape[0])
+
+    def asnumpy(self):
+        return self.todense_numpy()
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._dense_shape} nnz={self.nnz} "
+                f"@{self._ctx}>")
+
+    # sparse arrays are not tape-traceable tensors themselves
+    def __len__(self):
+        return self._dense_shape[0]
+
+    def copyto(self, other):
+        if isinstance(other, BaseSparseNDArray):
+            if other.stype != self.stype:
+                raise MXNetError(f"copyto: stype mismatch {self.stype} vs "
+                                 f"{other.stype}")
+            other._data = self._data
+            other._indices = self._indices
+            if hasattr(self, "_indptr"):
+                other._indptr = self._indptr
+            other._dense_shape = self._dense_shape
+            return other
+        if isinstance(other, NDArray):
+            other._set_data(self.todense().data.astype(other.dtype))
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise MXNetError(f"copyto: unsupported target {type(other)}")
+
+    def astype(self, dtype, copy=True):
+        out = self._clone()
+        out._data = self._data.astype(NDArray(onp.zeros(1), dtype=dtype).dtype)
+        return out
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def todense_numpy(self) -> onp.ndarray:
+        raise NotImplementedError
+
+    def zeros_like(self):
+        return zeros(self.stype, self._dense_shape, ctx=self._ctx,
+                     dtype=str(self._data.dtype))
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: values for a (sorted, unique) subset of leading-axis
+    slices (ndarray.h kRowSparseStorage; sparse.py RowSparseNDArray).
+
+    Padding convention: indices may contain ids == shape[0] (out of range) to
+    keep nnz static under jit; such rows carry zero values and are dropped by
+    every scatter.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, values, indices, shape, ctx=None):
+        import jax
+        jnp = _jnp()
+        ctx = ctx or current_context()
+        dev = ctx.jax_device()
+        vals = values.data if isinstance(values, NDArray) else jnp.asarray(values)
+        idx = indices.data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        if vals.dtype == onp.float64:
+            vals = vals.astype(jnp.float32)
+        self._data = jax.device_put(vals, dev)
+        self._indices = jax.device_put(idx.astype(jnp.int32), dev)
+        self._dense_shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+        if self._data.ndim != len(self._dense_shape):
+            raise MXNetError(
+                f"row_sparse values rank {self._data.ndim} must match dense "
+                f"rank {len(self._dense_shape)} (values carry the row slices)")
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    def _clone(self):
+        return RowSparseNDArray(self._data, self._indices, self._dense_shape,
+                                ctx=self._ctx)
+
+    def _assign(self, indices, values):
+        """In-place storage swap (grad-buffer reuse across steps)."""
+        self._indices = indices
+        self._data = values
+        return self
+
+    def todense(self) -> NDArray:
+        arr = _rsp_to_dense_fn()(self._indices, self._data,
+                                 self._dense_shape[0])
+        return NDArray(arr, ctx=self._ctx)
+
+    def todense_numpy(self):
+        out = onp.zeros(self._dense_shape,
+                        onp.float32 if str(self._data.dtype) == "bfloat16"
+                        else self._data.dtype)
+        idx = onp.asarray(self._indices)
+        vals = onp.asarray(self._data, dtype=out.dtype)
+        ok = idx < self._dense_shape[0]
+        onp.add.at(out, idx[ok], vals[ok])
+        return out
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def dedup(self) -> "RowSparseNDArray":
+        """Sorted-unique indices with summed values (padded to the same nnz)."""
+        if self.nnz == 0:
+            return self
+        uid, vals = _dedup_fn()(self._indices, self._data, self._dense_shape[0])
+        return RowSparseNDArray(vals, uid, self._dense_shape, ctx=self._ctx)
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float)):
+            return RowSparseNDArray(self._data * other, self._indices,
+                                    self._dense_shape, ctx=self._ctx)
+        return self.todense() * other
+
+    __rmul__ = __mul__
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add_n([self, other])
+        return self.todense() + other
+
+    def __radd__(self, other):
+        return self.todense().__radd__(other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row tensor (ndarray.h kCSRStorage)."""
+
+    __slots__ = ("_row_ids",)
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        import jax
+        jnp = _jnp()
+        ctx = ctx or current_context()
+        dev = ctx.jax_device()
+        vals = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+        if vals.dtype == onp.float64:
+            vals = vals.astype(jnp.float32)
+        idx = indices.data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        ptr = indptr.data if isinstance(indptr, NDArray) else jnp.asarray(indptr)
+        if len(shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+        self._data = jax.device_put(vals.reshape(-1), dev)
+        self._indices = jax.device_put(idx.astype(jnp.int32).reshape(-1), dev)
+        self._indptr = jax.device_put(ptr.astype(jnp.int32).reshape(-1), dev)
+        self._dense_shape = tuple(int(s) for s in shape)
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+        self._tape_index = 0
+        # static per-nonzero row ids (expanded from indptr once, on host)
+        ptr_np = onp.asarray(self._indptr)
+        counts = onp.diff(ptr_np)
+        row_ids = onp.repeat(onp.arange(len(counts), dtype=onp.int32), counts)
+        self._row_ids = jax.device_put(_jnp().asarray(row_ids), dev)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    def _clone(self):
+        return CSRNDArray(self._data, self._indices, self._indptr,
+                          self._dense_shape, ctx=self._ctx)
+
+    def todense(self) -> NDArray:
+        arr = _csr_to_dense_fn()(self._data, self._indices, self._row_ids,
+                                 self._dense_shape)
+        return NDArray(arr, ctx=self._ctx)
+
+    def todense_numpy(self):
+        out = onp.zeros(self._dense_shape,
+                        onp.float32 if str(self._data.dtype) == "bfloat16"
+                        else self._data.dtype)
+        onp.add.at(out, (onp.asarray(self._row_ids), onp.asarray(self._indices)),
+                   onp.asarray(self._data, dtype=out.dtype))
+        return out
+
+    def asscipy(self):
+        import scipy.sparse as sp
+        return sp.csr_matrix((onp.asarray(self._data),
+                              onp.asarray(self._indices),
+                              onp.asarray(self._indptr)),
+                             shape=self._dense_shape)
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """row_sparse_array((data, indices), shape=...) or from dense
+    (sparse.py row_sparse_array parity)."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = onp.asarray(data, dtype=dtype or "float32") \
+            if not isinstance(data, NDArray) else data
+        if shape is None:
+            d = data.shape if not isinstance(data, NDArray) else data.shape
+            idx = onp.asarray(indices)
+            n_rows = int(idx.max()) + 1 if idx.size else 0
+            shape = (n_rows,) + tuple(d[1:])
+        return RowSparseNDArray(data, indices, shape, ctx=ctx)
+    if isinstance(arg1, (NDArray, onp.ndarray, list)):
+        dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+        return cast_storage(dense, "row_sparse")
+    raise MXNetError(f"cannot build row_sparse from {type(arg1)}")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """csr_matrix((data, indices, indptr), shape=...) or from dense/scipy
+    (sparse.py csr_matrix parity)."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            n_rows = len(onp.asarray(indptr)) - 1
+            idx = onp.asarray(indices)
+            n_cols = int(idx.max()) + 1 if idx.size else 0
+            shape = (n_rows, n_cols)
+        data = onp.asarray(data, dtype=dtype or "float32") \
+            if not isinstance(data, NDArray) else data
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx)
+    if hasattr(arg1, "tocsr"):  # scipy matrix
+        m = arg1.tocsr()
+        return CSRNDArray(m.data.astype(dtype or "float32"), m.indices,
+                          m.indptr, m.shape, ctx=ctx)
+    if isinstance(arg1, (NDArray, onp.ndarray, list)):
+        dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+        return cast_storage(dense, "csr")
+    raise MXNetError(f"cannot build csr from {type(arg1)}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    jnp = _jnp()
+    dtype = dtype or "float32"
+    if isinstance(shape, int):
+        shape = (shape,)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            jnp.zeros((0,) + tuple(shape[1:]), DTYPE(dtype)),
+            jnp.zeros((0,), jnp.int32), shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), DTYPE(dtype)),
+                          jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape, ctx=ctx)
+    if stype == "default":
+        from ..ndarray import zeros as dzeros
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def DTYPE(d):
+    from ..base import DTypes
+    return DTypes.jnp(d)
+
+
+empty = zeros
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-aware mx.nd.sparse.array: preserves the input's storage type."""
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    if hasattr(source, "tocsr"):
+        return csr_matrix(source, ctx=ctx, dtype=dtype)
+    return NDArray(source, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts / ops
+# ---------------------------------------------------------------------------
+def cast_storage(arr, stype: str):
+    """Dense↔sparse conversion (operator/tensor/cast_storage-inl.h parity).
+    dense→sparse inspects values, so it runs at the host boundary (nnz is
+    data-dependent — unjittable by design)."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    np_arr = arr.asnumpy()
+    if stype == "row_sparse":
+        flat = np_arr.reshape(np_arr.shape[0], -1) if np_arr.ndim > 1 \
+            else np_arr.reshape(-1, 1)
+        nz_rows = onp.flatnonzero(onp.any(flat != 0, axis=1)).astype(onp.int32)
+        return RowSparseNDArray(np_arr[nz_rows], nz_rows, np_arr.shape,
+                                ctx=arr.context)
+    if stype == "csr":
+        if np_arr.ndim != 2:
+            raise MXNetError("csr storage is 2-D only")
+        rows, cols = onp.nonzero(np_arr)
+        data = np_arr[rows, cols]
+        indptr = onp.zeros(np_arr.shape[0] + 1, onp.int32)
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr).astype(onp.int32)
+        return CSRNDArray(data, cols.astype(onp.int32), indptr, np_arr.shape,
+                          ctx=arr.context)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def retain(rsp: RowSparseNDArray, indices):
+    """Keep only the requested rows (sparse_retain op parity)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = onp.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices).astype(onp.int64)
+    have = onp.asarray(rsp._indices)
+    keep = onp.isin(have, want)
+    jnp = _jnp()
+    return RowSparseNDArray(rsp._data[jnp.asarray(onp.flatnonzero(keep))],
+                            have[keep], rsp._dense_shape, ctx=rsp._ctx)
+
+
+def add_n(arrays):
+    """Sum row-sparse arrays: concatenate parts, then jitted dedup."""
+    jnp = _jnp()
+    arrays = [a for a in arrays if not (isinstance(a, BaseSparseNDArray)
+                                        and a.nnz == 0)]
+    if not arrays:
+        raise MXNetError("add_n of empty/all-zero input needs a shape")
+    if not all(isinstance(a, RowSparseNDArray) for a in arrays):
+        out = arrays[0].todense() if isinstance(arrays[0], BaseSparseNDArray) \
+            else arrays[0]
+        for a in arrays[1:]:
+            out = out + (a.todense() if isinstance(a, BaseSparseNDArray) else a)
+        return out
+    if len(arrays) == 1:
+        return arrays[0]
+    idx = jnp.concatenate([a._indices for a in arrays])
+    vals = jnp.concatenate([a._data for a in arrays])
+    uid, svals = _dedup_fn()(idx, vals, arrays[0]._dense_shape[0])
+    return RowSparseNDArray(svals, uid, arrays[0]._dense_shape,
+                            ctx=arrays[0]._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (dot-inl.h): csr·dense and csrᵀ·dense are segment-sum
+    contractions; other combinations fall back to densified dot."""
+    from ..ndarray import dot as dense_dot
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
+            and not isinstance(rhs, BaseSparseNDArray) and not transpose_b:
+        m, n = lhs._dense_shape
+        out_rows = n if transpose_a else m
+        arr = _csr_dot_fn(transpose_a)(lhs._data, lhs._indices, lhs._row_ids,
+                                       rhs.data, out_rows)
+        return NDArray(arr, ctx=rhs.context)
+    if isinstance(lhs, RowSparseNDArray) and not transpose_a \
+            and isinstance(rhs, NDArray) and not isinstance(rhs, BaseSparseNDArray):
+        # (m,n) row-sparse · (n,k): only stored rows contribute rows of out
+        jnp = _jnp()
+        r = rhs.data.T if transpose_b else rhs.data
+        contrib = lhs._data @ r
+        out = jnp.zeros((lhs._dense_shape[0], r.shape[1]), contrib.dtype)
+        return NDArray(out.at[lhs._indices].add(contrib, mode="drop"),
+                       ctx=rhs.context)
+    lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return dense_dot(lhs_d, rhs_d, transpose_a=transpose_a,
+                     transpose_b=transpose_b)
+
+
+# ---------------------------------------------------------------------------
+# autograd cotangent carrier (Embedding sparse_grad)
+# ---------------------------------------------------------------------------
+class SparseCotangent:
+    """Lazily-merged row-sparse gradient contributions flowing through the
+    tape (the FComputeEx row_sparse gradient path of indexing_op.cc). Parts
+    are (ids, value-rows) pairs; densification only happens if a dense
+    consumer forces it."""
+
+    __slots__ = ("parts", "dense_shape")
+
+    def __init__(self, parts, dense_shape):
+        self.parts = list(parts)
+        self.dense_shape = tuple(dense_shape)
+
+    # -- accumulation protocol (autograd.backward uses `prev + g`) ----------
+    def __add__(self, other):
+        if isinstance(other, SparseCotangent):
+            return SparseCotangent(self.parts + other.parts, self.dense_shape)
+        return self.todense() + other
+
+    def __radd__(self, other):
+        if other is None:
+            return self
+        return other + self.todense()
+
+    def todense(self):
+        jnp = _jnp()
+        out = jnp.zeros(self.dense_shape, self.parts[0][1].dtype)
+        for ids, vals in self.parts:
+            out = out.at[ids].add(vals, mode="drop")
+        return out
+
+    def to_row_sparse(self, ctx=None) -> RowSparseNDArray:
+        jnp = _jnp()
+        idx = jnp.concatenate([p[0] for p in self.parts]) \
+            if len(self.parts) > 1 else self.parts[0][0]
+        vals = jnp.concatenate([p[1] for p in self.parts]) \
+            if len(self.parts) > 1 else self.parts[0][1]
+        uid, svals = _dedup_fn()(idx, vals, self.dense_shape[0])
+        return RowSparseNDArray(svals, uid, self.dense_shape, ctx=ctx)
+
+    def astype(self, dtype):
+        return SparseCotangent([(i, v.astype(dtype)) for i, v in self.parts],
+                               self.dense_shape)

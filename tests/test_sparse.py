@@ -1,0 +1,296 @@
+"""Sparse storage tests (parity patterns: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py; sparse optimizer tests in test_optimizer.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sparse
+from mxnet_tpu.sparse import (CSRNDArray, RowSparseNDArray, cast_storage,
+                              csr_matrix, row_sparse_array)
+
+
+def _rand_dense(shape, density=0.3, seed=0):
+    rng = onp.random.RandomState(seed)
+    arr = rng.randn(*shape).astype("float32")
+    mask = rng.rand(*shape) < density
+    return arr * mask
+
+
+# ---------------------------------------------------------------------------
+# storage round trips
+# ---------------------------------------------------------------------------
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((6, 4), "float32")
+    dense[1] = 1.5
+    dense[4] = -2.0
+    a = nd.array(dense)
+    rsp = a.tostype("row_sparse")
+    assert isinstance(rsp, RowSparseNDArray)
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 2
+    assert rsp.indices.asnumpy().tolist() == [1, 4]
+    onp.testing.assert_allclose(rsp.asnumpy(), dense)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    onp.testing.assert_allclose(back.asnumpy(), dense)
+
+
+def test_csr_roundtrip():
+    dense = _rand_dense((5, 7))
+    csr = nd.array(dense).tostype("csr")
+    assert isinstance(csr, CSRNDArray)
+    assert csr.stype == "csr"
+    onp.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    assert csr.indptr.asnumpy()[-1] == csr.nnz
+    onp.testing.assert_allclose(csr.todense().asnumpy(), dense, rtol=1e-6)
+    # csr <-> row_sparse via dense
+    rsp = csr.tostype("row_sparse")
+    onp.testing.assert_allclose(rsp.asnumpy(), dense, rtol=1e-6)
+
+
+def test_constructors():
+    rsp = row_sparse_array((onp.ones((2, 3), "float32"), [1, 3]), shape=(5, 3))
+    assert rsp.shape == (5, 3)
+    assert rsp.asnumpy()[1].tolist() == [1, 1, 1]
+    assert rsp.asnumpy()[0].tolist() == [0, 0, 0]
+
+    csr = csr_matrix((onp.array([1., 2., 3.], "float32"), [0, 2, 1],
+                      [0, 2, 2, 3]), shape=(3, 4))
+    expect = onp.zeros((3, 4), "float32")
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    onp.testing.assert_allclose(csr.asnumpy(), expect)
+
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.nnz == 0
+    onp.testing.assert_allclose(z.asnumpy(), onp.zeros((4, 2)))
+
+
+def test_save_load_sparse(tmp_path):
+    dense = _rand_dense((6, 3))
+    rsp = nd.array(dense).tostype("row_sparse")
+    csr = nd.array(_rand_dense((4, 5), seed=1)).tostype("csr")
+    f = str(tmp_path / "sp.params")
+    nd.save(f, {"rsp": rsp, "csr": csr, "dense": nd.array(dense)})
+    loaded = nd.load(f)
+    assert isinstance(loaded["rsp"], RowSparseNDArray)
+    assert isinstance(loaded["csr"], CSRNDArray)
+    onp.testing.assert_allclose(loaded["rsp"].asnumpy(), dense, rtol=1e-6)
+    onp.testing.assert_allclose(loaded["csr"].asnumpy(), csr.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(loaded["dense"].asnumpy(), dense, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+def test_csr_dot():
+    lhs = _rand_dense((5, 7), seed=2)
+    rhs = onp.random.RandomState(3).randn(7, 4).astype("float32")
+    csr = nd.array(lhs).tostype("csr")
+    out = sparse.dot(csr, nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-5, atol=1e-5)
+    # transpose_a: (7,5)·(5,4) contributions scatter over columns
+    rhs_t = onp.random.RandomState(4).randn(5, 4).astype("float32")
+    out_t = sparse.dot(csr, nd.array(rhs_t), transpose_a=True)
+    onp.testing.assert_allclose(out_t.asnumpy(), lhs.T @ rhs_t, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_rsp_dot_and_scalar_ops():
+    lhs = onp.zeros((6, 3), "float32")
+    lhs[2] = [1, 2, 3]
+    lhs[5] = [-1, 0, 1]
+    rhs = onp.random.RandomState(5).randn(3, 2).astype("float32")
+    rsp = nd.array(lhs).tostype("row_sparse")
+    out = sparse.dot(rsp, nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), lhs @ rhs, rtol=1e-5, atol=1e-5)
+    scaled = rsp * 2.0
+    assert isinstance(scaled, RowSparseNDArray)
+    onp.testing.assert_allclose(scaled.asnumpy(), lhs * 2, rtol=1e-6)
+    s = rsp + rsp
+    assert isinstance(s, RowSparseNDArray)
+    onp.testing.assert_allclose(s.asnumpy(), lhs * 2, rtol=1e-6)
+
+
+def test_retain():
+    dense = onp.diag(onp.arange(1, 5, dtype="float32"))
+    rsp = nd.array(dense).tostype("row_sparse")
+    kept = sparse.retain(rsp, [0, 2])
+    expect = onp.zeros_like(dense)
+    expect[0], expect[2] = dense[0], dense[2]
+    onp.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_add_n_dedup():
+    a = row_sparse_array((onp.ones((2, 2), "float32"), [0, 2]), shape=(4, 2))
+    b = row_sparse_array((onp.full((2, 2), 2.0, "float32"), [2, 3]), shape=(4, 2))
+    s = sparse.add_n([a, b])
+    expect = onp.zeros((4, 2), "float32")
+    expect[0] = 1
+    expect[2] = 3
+    expect[3] = 2
+    onp.testing.assert_allclose(s.asnumpy(), expect)
+
+
+# ---------------------------------------------------------------------------
+# autograd: Embedding sparse_grad
+# ---------------------------------------------------------------------------
+def test_embedding_sparse_grad_matches_dense():
+    vocab, dim = 10, 4
+    rng = onp.random.RandomState(0)
+    w_np = rng.randn(vocab, dim).astype("float32")
+    tokens = nd.array(onp.array([[1, 3], [3, 7]]), dtype="int32")
+
+    grads = {}
+    for sparse_grad in (False, True):
+        w = nd.array(w_np)
+        w.attach_grad(stype="row_sparse" if sparse_grad else None)
+        with autograd.record():
+            emb = nd.Embedding(tokens, w, input_dim=vocab, output_dim=dim,
+                               sparse_grad=sparse_grad)
+            loss = (emb * emb).sum()
+        loss.backward()
+        grads[sparse_grad] = w.grad
+
+    assert isinstance(grads[True], RowSparseNDArray)
+    # touched rows only: 1, 3, 7 (3 counted twice)
+    idx = grads[True].indices.asnumpy()
+    real = idx[idx < vocab]
+    assert sorted(set(real.tolist())) == [1, 3, 7]
+    onp.testing.assert_allclose(grads[True].asnumpy(), grads[False].asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_embedding_sparse_grad_end_to_end():
+    from mxnet_tpu.gluon import nn
+    net = nn.Embedding(20, 6, sparse_grad=True)
+    net.initialize()
+    x = nd.array(onp.array([[0, 5, 5, 19]]), dtype="int32")
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    g = net.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    gd = g.asnumpy()
+    assert abs(gd[5].sum() - 12.0) < 1e-4  # row 5 hit twice, d(sum)/dy = 1
+    assert abs(gd[1].sum()) < 1e-6         # untouched row
+
+
+# ---------------------------------------------------------------------------
+# sparse (lazy) optimizer updates
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt_cls, kwargs", [
+    (mx.optimizer.SGD, {"learning_rate": 0.1, "momentum": 0.9}),
+    (mx.optimizer.Adam, {"learning_rate": 0.01}),
+])
+def test_sparse_optimizer_lazy_update(opt_cls, kwargs):
+    vocab, dim = 8, 3
+    rng = onp.random.RandomState(1)
+    w_np = rng.randn(vocab, dim).astype("float32")
+    g_rows = rng.randn(2, dim).astype("float32")
+    touched = [2, 5]
+
+    # dense reference: same rule applied to only the touched rows
+    opt_d = opt_cls(**kwargs)
+    w_d = nd.array(w_np[touched])
+    state_d = opt_d.create_state(0, w_d)
+    opt_d.update(0, w_d, nd.array(g_rows), state_d)
+
+    opt_s = opt_cls(**kwargs)
+    w_s = nd.array(w_np)
+    state_s = opt_s.create_state(0, w_s)
+    grad = row_sparse_array((g_rows, touched), shape=(vocab, dim))
+    opt_s.update(0, w_s, grad, state_s)
+
+    out = w_s.asnumpy()
+    onp.testing.assert_allclose(out[touched], w_d.asnumpy(), rtol=1e-5,
+                                atol=1e-6)
+    untouched = [i for i in range(vocab) if i not in touched]
+    onp.testing.assert_allclose(out[untouched], w_np[untouched])  # lazy
+
+
+def test_sparse_optimizer_duplicate_indices_summed():
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    w = nd.array(onp.zeros((4, 2), "float32"))
+    grad = row_sparse_array((onp.ones((2, 2), "float32"), [1, 1]), shape=(4, 2))
+    opt.update(0, w, grad, None)
+    onp.testing.assert_allclose(w.asnumpy()[1], [-2.0, -2.0])
+
+
+# ---------------------------------------------------------------------------
+# kvstore
+# ---------------------------------------------------------------------------
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = nd.array(_rand_dense((6, 4), density=1.0, seed=6))
+    kv.init(3, w)
+    out = sparse.zeros("row_sparse", (6, 4))
+    kv.row_sparse_pull(3, out=out, row_ids=nd.array([1, 4], dtype="int32"))
+    assert isinstance(out, RowSparseNDArray)
+    onp.testing.assert_allclose(out.asnumpy()[[1, 4]], w.asnumpy()[[1, 4]],
+                                rtol=1e-6)
+    onp.testing.assert_allclose(out.asnumpy()[0], onp.zeros(4))
+    # dense out gets the zero-padded dense copy
+    dout = nd.zeros((6, 4))
+    kv.row_sparse_pull(3, out=dout, row_ids=nd.array([2], dtype="int32"))
+    onp.testing.assert_allclose(dout.asnumpy()[2], w.asnumpy()[2], rtol=1e-6)
+    assert abs(dout.asnumpy()[[0, 1, 3, 4, 5]]).sum() == 0
+
+
+def test_kvstore_sparse_push_with_updater():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    w = nd.array(onp.zeros((5, 2), "float32"))
+    kv.init(0, w)
+    g1 = row_sparse_array((onp.ones((1, 2), "float32"), [1]), shape=(5, 2))
+    g2 = row_sparse_array((onp.ones((1, 2), "float32"), [3]), shape=(5, 2))
+    kv.push(0, [g1, g2])
+    out = nd.zeros((5, 2))
+    kv.pull(0, out=out)
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[1], [-1, -1])
+    onp.testing.assert_allclose(got[3], [-1, -1])
+    assert abs(got[[0, 2, 4]]).sum() == 0
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: LSTM language model with sparse embedding grads (BASELINE cfg 5)
+# ---------------------------------------------------------------------------
+def test_lstm_lm_sparse_embedding_trains():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, rnn
+
+    vocab, emb, hid, seq, batch = 50, 16, 32, 8, 4
+
+    class LM(gluon.Block):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, emb, sparse_grad=True)
+                self.lstm = rnn.LSTM(hid, num_layers=1, layout="NTC")
+                self.decoder = nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.decoder(self.lstm(self.embed(x)))
+
+    net = LM()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.RandomState(0)
+    data = rng.randint(0, vocab, (batch, seq + 1))
+    x = nd.array(data[:, :-1], dtype="int32")
+    y = nd.array(data[:, 1:].astype("float32"))
+
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(batch)
+        losses.append(float(loss.mean().asscalar()))
+    g = net.embed.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert losses[-1] < losses[0] * 0.7, losses
