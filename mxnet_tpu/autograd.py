@@ -204,7 +204,18 @@ def _write_grad(x, val):
                 parts.append((x._grad._indices, x._grad._data))
             rsp = SparseCotangent(parts, val.dense_shape).to_row_sparse(
                 ctx=x._grad.context)
-            x._grad._assign(rsp._indices, rsp._data.astype(x._grad.dtype))
+            idx, data = rsp._indices, rsp._data
+            if x._grad_req == "add":
+                # dedup pads to the combined input nnz, so accumulating every
+                # step would grow the buffer (and force a re-jit) each
+                # backward. Trim trailing padding rows (index == shape[0]) at
+                # this eager boundary; nnz is then capped at the number of
+                # distinct touched rows (≤ shape[0]).
+                import numpy as _onp
+                n_valid = int(_onp.sum(_onp.asarray(idx) < val.dense_shape[0]))
+                if n_valid < idx.shape[0]:
+                    idx, data = idx[:n_valid], data[:n_valid]
+            x._grad._assign(idx, data.astype(x._grad.dtype))
             return
         val = val.todense()
     if isinstance(x._grad, BaseSparseNDArray):
@@ -333,7 +344,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     for v in variables:
         if id(v) not in cots:
             raise MXNetError("one of the variables is unreachable from heads")
-        results.append(NDArray(cots[id(v)], ctx=v.context))
+        c = cots[id(v)]
+        if isinstance(c, SparseCotangent):
+            results.append(c.to_row_sparse(ctx=v.context))
+        else:
+            results.append(NDArray(c, ctx=v.context))
     if not retain:
         for node in _STATE.tape:
             for o in node.outputs:

@@ -97,10 +97,30 @@ class KVStore(KVStoreBase):
                 if self._multi_host:
                     # gather (indices, values) parts from every worker, then
                     # one jitted dedup — sparse on the wire, like the
-                    # reference's RowSparsePushPull server path
+                    # reference's RowSparsePushPull server path.
+                    # process_allgather needs identical per-process shapes, so
+                    # first agree on the global max nnz and pad local parts to
+                    # it (padding index = shape[0], a drop sentinel).
                     from jax.experimental import multihost_utils
-                    idx = multihost_utils.process_allgather(agg._indices)
-                    vals = multihost_utils.process_allgather(agg._data)
+                    local_nnz = agg.nnz
+                    all_nnz = multihost_utils.process_allgather(
+                        jnp.asarray([local_nnz], jnp.int32))
+                    max_nnz = int(jnp.max(all_nnz))
+                    if max_nnz == 0:
+                        return agg
+                    pad = max_nnz - local_nnz
+                    idx_local = agg._indices
+                    val_local = agg._data
+                    if pad > 0:
+                        idx_local = jnp.concatenate([
+                            idx_local,
+                            jnp.full((pad,), agg.shape[0], idx_local.dtype)])
+                        val_local = jnp.concatenate([
+                            val_local,
+                            jnp.zeros((pad,) + val_local.shape[1:],
+                                      val_local.dtype)])
+                    idx = multihost_utils.process_allgather(idx_local)
+                    vals = multihost_utils.process_allgather(val_local)
                     agg = add_n([RowSparseNDArray(v, i, agg.shape,
                                                   ctx=agg.context)
                                  for i, v in zip(idx, vals)])
@@ -172,9 +192,10 @@ class KVStore(KVStoreBase):
         outs = _listify(targets)
         if len(keys) == 1 and len(outs) > 1 and not isinstance(outs[0], (list, tuple)):
             outs = [outs]
+        from ..sparse import BaseSparseNDArray
         for k, vlist, olist in zip(keys, values, outs):
             agg = self._reduce(_listify(vlist))
-            if self._compression is not None:
+            if self._compression is not None and not isinstance(agg, BaseSparseNDArray):
                 agg = NDArray(self._compression.compress(k, agg), ctx=agg.context)
             if self._updater is not None and k in self._store:
                 self._updater(_key_int(k), agg, self._store[k])
@@ -199,10 +220,16 @@ class KVStore(KVStoreBase):
             keys = keys * len(outs)
         if len(rids) == 1 and len(outs) > 1:
             rids = rids * len(outs)
+        from ..sparse import BaseSparseNDArray
         for k, o, r in zip(keys, outs, rids):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
             src = self._store[k]
+            if isinstance(src, BaseSparseNDArray):
+                # a sparse push with no updater leaves a RowSparseNDArray in
+                # the store; gather must index logical rows, so densify once
+                src = src.todense()
+                self._store[k] = src
             idx = (r.data if isinstance(r, NDArray)
                    else jnp.asarray(onp_asarray(r))).reshape(-1).astype(jnp.int32)
             rows = src.data.at[idx].get(mode="fill", fill_value=0)
