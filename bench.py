@@ -1,23 +1,49 @@
-"""Benchmark: ResNet-50 training throughput (img/s) on one chip.
+"""Benchmarks of record (BASELINE.json): ResNet-50 training img/s/chip and
+BERT-base pretraining tokens/s/chip, one chip each.
 
-Reference baseline: MXNet-CUDA ResNet-50 training, batch 32, 1x V100 =
-298.51 img/s (docs perf.md:244-255; BASELINE.md). The whole training step —
-forward, backward, SGD-momentum update — is one fused XLA computation
-(ParallelTrainStep on a 1-device mesh), bf16 compute / fp32 params.
+Reference baselines:
+  - ResNet-50 training, batch 32, 1x V100 = 298.51 img/s (docs perf.md:244-255).
+  - BERT-base pretraining: no number is published in the reference tree
+    (BASELINE.md — the fork contributes the fused attention ops,
+    src/operator/contrib/transformer.cc:650-828, but the model lives in
+    GluonNLP), so vs_baseline is null for that row.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Each training step — forward, backward, optimizer update — is ONE fused XLA
+computation (ParallelTrainStep on a 1-device mesh), bf16 compute / fp32 params.
+BERT runs the Pallas flash-attention path (mask-free full-length sequences).
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 import json
+import os
 import sys
 import time
 
 import numpy as onp
 
-BASELINE_IMG_S = 298.51  # MXNet ResNet-50 training, batch 32, V100
+BASELINE_RESNET_IMG_S = 298.51  # MXNet ResNet-50 training, batch 32, V100
 
 
-def main():
-    import os
+def _emit(metric, value, unit, vs_baseline):
+    print(json.dumps({"metric": metric, "value": round(value, 2), "unit": unit,
+                      "vs_baseline": (round(vs_baseline, 3)
+                                      if vs_baseline is not None else None)}),
+          flush=True)
+
+
+def _time_steps(step, args, steps, warmup):
+    for _ in range(warmup):
+        loss = step(*args)
+    loss.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(*args)
+    loss.wait_to_read()
+    return time.perf_counter() - t0
+
+
+def bench_resnet():
     batch = int(os.environ.get("BENCH_BATCH", 32))
     steps = int(os.environ.get("BENCH_STEPS", 20))
     warmup = int(os.environ.get("BENCH_WARMUP", 3))
@@ -42,20 +68,54 @@ def main():
     xn, yn = step.place_batch(rng.rand(batch, 3, 224, 224).astype("float32"),
                               rng.randint(0, 1000, batch).astype("float32"))
 
-    for _ in range(warmup):
-        loss = step(xn, yn)
-    loss.wait_to_read()
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(xn, yn)
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
-
+    dt = _time_steps(step, (xn, yn), steps, warmup)
     img_s = batch * steps / dt
-    print(json.dumps({"metric": "resnet50_train_img_s_per_chip",
-                      "value": round(img_s, 2), "unit": "img/s",
-                      "vs_baseline": round(img_s / BASELINE_IMG_S, 3)}))
+    _emit("resnet50_train_img_s_per_chip", img_s, "img/s",
+          img_s / BASELINE_RESNET_IMG_S)
+
+
+def bench_bert():
+    batch = int(os.environ.get("BENCH_BERT_BATCH", 32))
+    seq = int(os.environ.get("BENCH_BERT_SEQ", 128))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    from jax.sharding import PartitionSpec as P
+
+    backbone = bert.bert_base(max_length=seq)
+    model = bert.BERTForPretraining(backbone)
+    model.initialize(mx.init.Normal(0.02))
+
+    mesh = parallel.make_mesh({"dp": 1})
+    step = parallel.ParallelTrainStep(
+        model, bert.BERTPretrainingLoss(),
+        mx.optimizer.Adam(learning_rate=1e-4), mesh,
+        compute_dtype="bfloat16", extra_specs=(P("dp"),))
+
+    rng = onp.random.RandomState(0)
+    toks = rng.randint(0, 30522, (batch, seq)).astype("int32")
+    tt = onp.zeros((batch, seq), "int32")
+    mlm_lab = onp.where(rng.rand(batch, seq) < 0.15,
+                        rng.randint(0, 30522, (batch, seq)), -1).astype("int32")
+    nsp_lab = rng.randint(0, 2, (batch,)).astype("int32")
+    placed = step.place_batch(toks, (mlm_lab, nsp_lab), tt)
+
+    dt = _time_steps(step, placed, steps, warmup)
+    tok_s = batch * seq * steps / dt
+    _emit("bert_base_pretrain_tok_s_per_chip", tok_s, "tokens/s", None)
+
+
+def main():
+    which = os.environ.get("BENCH_ONLY", "").split(",") if \
+        os.environ.get("BENCH_ONLY") else ["resnet", "bert"]
+    if "resnet" in which:
+        bench_resnet()
+    if "bert" in which:
+        bench_bert()
 
 
 if __name__ == "__main__":

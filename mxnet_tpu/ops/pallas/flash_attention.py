@@ -27,6 +27,7 @@ from ..registry import register
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+_LANES = 128  # TPU lane width; lse is broadcast across it for layout legality
 
 
 def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
@@ -74,7 +75,12 @@ def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
     m_f, l_f, o_f = jax.lax.fori_loop(0, num_k, body, (m0, l0, o0))
     l_safe = jnp.maximum(l_f, 1e-30)
     o_ref[0] = (o_f / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m_f + jnp.log(l_safe)
+    # per-row scalar broadcast across the 128-lane axis: TPU tiling requires
+    # the last two block dims be (8k, 128)-aligned, so a (bq,)-shaped output
+    # is not representable (same layout as pallas.ops.tpu.flash_attention's
+    # l/m residuals)
+    lse = m_f + jnp.log(l_safe)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -107,16 +113,16 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sp, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sp), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Sp, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
     out = out.reshape(B, H, Sp, D)[:, :, :S]
-    lse = lse.reshape(B, H, Sp)[:, :, :S]
+    lse = lse[..., 0].reshape(B, H, Sp)[:, :, :S]
     return out, lse
 
 
