@@ -22,7 +22,9 @@ import time
 
 import numpy as onp
 
-BASELINE_RESNET_IMG_S = 298.51  # MXNet ResNet-50 training, batch 32, V100
+BASELINE_RESNET_IMG_S = 298.51       # MXNet ResNet-50 training, batch 32, V100
+BASELINE_RESNET_B128_IMG_S = 363.69  # training, batch 128, V100 (perf.md:254)
+BASELINE_RESNET_INFER_IMG_S = 1233.15  # inference, batch 128, V100 (perf.md:199)
 
 
 def _emit(metric, value, unit, vs_baseline):
@@ -73,6 +75,56 @@ def bench_resnet():
     _emit("resnet50_train_img_s_per_chip", img_s, "img/s",
           img_s / BASELINE_RESNET_IMG_S)
 
+    # batch-128 training row (perf.md:254 config)
+    b128 = 128
+    xn, yn = step.place_batch(rng.rand(b128, 3, 224, 224).astype("float32"),
+                              rng.randint(0, 1000, b128).astype("float32"))
+    dt = _time_steps(step, (xn, yn), steps, warmup)
+    img_s = b128 * steps / dt
+    _emit("resnet50_train_b128_img_s_per_chip", img_s, "img/s",
+          img_s / BASELINE_RESNET_B128_IMG_S)
+
+
+def bench_resnet_inference():
+    """Forward-only throughput, batch 128 bf16 (the perf.md:188-200
+    benchmark_score.py config)."""
+    batch = int(os.environ.get("BENCH_INFER_BATCH", 128))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.block import pure_apply
+
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net(mx.nd.array(onp.zeros((1, 3, 224, 224), "bfloat16")))
+    plist = list(net.collect_params().values())
+    pvals = [p.data().data for p in plist]
+
+    @jax.jit
+    def fwd(params, x):
+        outs, _, _ = pure_apply(net, plist, params, (x,), None, training=False)
+        return outs[0]
+
+    rng = onp.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.bfloat16)
+    y = fwd(pvals, x)
+    for _ in range(warmup):
+        y = fwd(pvals, x)
+    y.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        y = fwd(pvals, x)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    img_s = batch * steps / dt
+    _emit("resnet50_infer_b128_img_s_per_chip", img_s, "img/s",
+          img_s / BASELINE_RESNET_INFER_IMG_S)
+
 
 def bench_bert():
     batch = int(os.environ.get("BENCH_BERT_BATCH", 32))
@@ -111,9 +163,11 @@ def bench_bert():
 
 def main():
     which = os.environ.get("BENCH_ONLY", "").split(",") if \
-        os.environ.get("BENCH_ONLY") else ["resnet", "bert"]
+        os.environ.get("BENCH_ONLY") else ["resnet", "infer", "bert"]
     if "resnet" in which:
         bench_resnet()
+    if "infer" in which:
+        bench_resnet_inference()
     if "bert" in which:
         bench_bert()
 

@@ -434,6 +434,15 @@ class HybridBlock(Block):
 
     def __call__(self, *args, **kwargs):
         from .. import tracing
+        if tracing.current() is None and args and all(
+                isinstance(a, NDArray) or hasattr(a, "shape") for a in args):
+            # remember the top-level input signature for export()
+            import jax
+            self._last_input_avals = tuple(
+                jax.ShapeDtypeStruct(tuple(a.shape),
+                                     a.data.dtype if isinstance(a, NDArray)
+                                     else a.dtype)
+                for a in args)
         # inside an enclosing trace, children inline into the parent's single
         # computation (op inlining, cached_op.h:248) rather than nesting CachedOps
         if self._active and tracing.current() is None:
@@ -483,20 +492,52 @@ class HybridBlock(Block):
 
     # -- export (block.py:1241) ---------------------------------------------
     def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize compiled model: StableHLO program (the symbol-json analog)
-        + params file. Returns (model_file, params_file)."""
+        """Serialize the compiled model so it can be reloaded and executed
+        WITHOUT the defining Python class (the reference's symbol-json export,
+        block.py:1241): the traced inference computation is exported as a
+        portable StableHLO program (jax.export), embedded base64 in the
+        ``-symbol.json`` file next to the usual ``.params`` file.
+
+        Requires the block to have been called at least once (to know the
+        input signature) — same contract as the reference's export-after-
+        hybridize. Returns (model_file, params_file)."""
+        import base64
         import jax
         from jax import export as jax_export
+
         params = list(self.collect_params().values())
         model_file = f"{path}-symbol.json"
         params_file = f"{path}-{epoch:04d}.params"
         from ..ndarray.utils import save as nd_save
-        arg = {"arg:" + p.name: p.data() for p in params}
-        nd_save(params_file, arg)
+        nd_save(params_file, {"arg:" + p.name: p.data() for p in params})
+
+        in_avals = getattr(self, "_last_input_avals", None)
+        if in_avals is None:
+            raise MXNetError(
+                "export requires the block to have been run at least once "
+                "(call net(x) after hybridize()) so the input signature is known")
+
+        plist = params
+
+        def infer_fn(param_datas, *input_datas):
+            outs, _, _ = pure_apply(self, plist, param_datas, input_datas,
+                                    None, training=False)
+            return outs
+
+        param_avals = tuple(jax.ShapeDtypeStruct(tuple(p.shape),
+                                                 p.data().data.dtype)
+                            for p in params)
+        exported = jax_export.export(jax.jit(infer_fn),
+                                     platforms=("cpu", "tpu"))(
+            param_avals, *in_avals)
         meta = {
             "class": f"{self.__class__.__module__}.{self.__class__.__name__}",
             "format": "mxnet_tpu/stablehlo-v1",
             "params": [p.name for p in params],
+            "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                       for a in in_avals],
+            "stablehlo_b64": base64.b64encode(
+                bytes(exported.serialize())).decode("ascii"),
         }
         with open(model_file, "w") as f:
             json.dump(meta, f)
@@ -518,38 +559,55 @@ def _no_hybrid(block):
 class SymbolBlock(HybridBlock):
     """Run a model exported by HybridBlock.export (block.py:1403).
 
-    The reference rebuilds a Symbol graph from json; here the exported metadata
-    names the originating class — imports() reconstructs it and loads params.
-    """
+    The exported ``-symbol.json`` embeds a serialized StableHLO program;
+    imports() deserializes it and binds the saved parameter values — the
+    defining Python class is NOT needed (nor imported), exactly like the
+    reference executing a symbol graph from json."""
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
         self._fn = outputs
+        self._param_vals = []
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None, **kwargs):
+        import base64
+        import jax
+        from jax import export as jax_export
+
         with open(symbol_file) as f:
             meta = json.load(f)
-        mod_name, cls_name = meta["class"].rsplit(".", 1)
-        import importlib
-        klass = getattr(importlib.import_module(mod_name), cls_name)
-        block = klass(**kwargs) if kwargs else klass()
+        if "stablehlo_b64" not in meta:
+            raise MXNetError(
+                f"{symbol_file} is not a mxnet_tpu/stablehlo-v1 export "
+                "(missing embedded program)")
+        exported = jax_export.deserialize(bytearray(
+            base64.b64decode(meta["stablehlo_b64"])))
+        call = jax.jit(exported.call)
+
+        param_vals = []
         if param_file:
             from ..ndarray.utils import load as nd_load
             loaded = nd_load(param_file)
-            name_map = {p.name: p for p in block.collect_params().values()}
-            ctx_list = [ctx] if isinstance(ctx, Context) else (ctx or [current_context()])
-            for key, val in loaded.items():
-                name = key.replace("arg:", "").replace("aux:", "")
-                if name in name_map:
-                    p = name_map[name]
-                    p.shape = val.shape
-                    if p._data is None:
-                        p._init_impl(None, ctx_list, None, data=val)
-                    else:
-                        p.set_data(val)
-        block.hybridize()
-        return block
+            by_name = {k.replace("arg:", "").replace("aux:", ""): v
+                       for k, v in loaded.items()}
+            missing = [n for n in meta["params"] if n not in by_name]
+            if missing:
+                raise MXNetError(f"params file missing values for {missing}")
+            param_vals = [by_name[n].data for n in meta["params"]]
+        blk = SymbolBlock(call, input_names)
+        blk._param_vals = param_vals
+        blk._meta = meta
+        return blk
 
     def forward(self, *args, **kwargs):
-        return self._fn(*args, **kwargs)
+        datas = tuple(a.data if isinstance(a, NDArray) else a for a in args)
+        ctx = args[0].context if args and isinstance(args[0], NDArray) \
+            else current_context()
+        outs = self._fn(tuple(self._param_vals), *datas)
+        outs = [NDArray(o, ctx=ctx) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def __call__(self, *args, **kwargs):
+        # bypass the CachedOp machinery: the program is already compiled
+        return self.forward(*args, **kwargs)
