@@ -103,7 +103,10 @@ def bench_resnet_inference():
     net.cast("bfloat16")
     net(mx.nd.array(onp.zeros((1, 3, 224, 224), "bfloat16")))
     plist = list(net.collect_params().values())
-    pvals = [p.data().data for p in plist]
+    dev = jax.devices()[0]
+    # cast() re-materializes params on host; pin them (and the batch) to the
+    # accelerator or jax will place the whole computation on CPU
+    pvals = [jax.device_put(p.data().data, dev) for p in plist]
 
     @jax.jit
     def fwd(params, x):
@@ -111,7 +114,8 @@ def bench_resnet_inference():
         return outs[0]
 
     rng = onp.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.bfloat16)
+    x = jax.device_put(jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.bfloat16),
+                       dev)
     y = fwd(pvals, x)
     for _ in range(warmup):
         y = fwd(pvals, x)

@@ -39,6 +39,7 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import contrib
 from .util import np_shape, np_array, is_np_array, set_np, reset_np
 from . import numpy as np
 from . import numpy_extension as npx

@@ -1,0 +1,2 @@
+"""Contrib namespace (python/mxnet/contrib/): experimental / auxiliary APIs."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,239 @@
+"""Model quantization workflow (parity surface:
+python/mxnet/contrib/quantization.py — quantize_net/quantize_net_v2 with
+naive / entropy / percentile calibration over Gluon networks; graph surgery
+analog of src/operator/quantization/quantize_graph_pass.cc).
+
+TPU-native pipeline: calibration runs the fp32 net eagerly with forward
+pre-hooks collecting per-layer input statistics; conversion swaps Dense /
+Conv2D children for Quantized* blocks whose forward quantizes the input with
+the baked calib range, runs the int8 MXU kernel (ops/quantization.py), and
+dequantizes — all inside the same jitted computation, so XLA fuses the
+quantize/dequantize boundaries into the surrounding graph."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["quantize_net", "LayerInputCollector", "QuantizedDense",
+           "QuantizedConv2D"]
+
+_NUM_BINS = 8001  # reference _LayerHistogramCollector default
+
+
+class LayerInputCollector:
+    """Collects per-layer input min/max and histograms during calibration
+    (reference _LayerOutputMinMaxCollector/_LayerHistogramCollector, but
+    attached to quantizable-layer INPUTS via forward pre-hooks)."""
+
+    def __init__(self):
+        self.min_max: Dict[str, List[float]] = {}
+        self.hists: Dict[str, List] = {}
+        self._handles = []
+
+    def hook(self, name):
+        def _pre(block, args):
+            x = args[0]
+            a = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            mn, mx = float(a.min()), float(a.max())
+            if name in self.min_max:
+                self.min_max[name][0] = min(self.min_max[name][0], mn)
+                self.min_max[name][1] = max(self.min_max[name][1], mx)
+            else:
+                self.min_max[name] = [mn, mx]
+            amax = max(abs(mn), abs(mx), 1e-12)
+            hist, edges = onp.histogram(a, bins=_NUM_BINS, range=(-amax, amax))
+            prev = self.hists.get(name)
+            if prev is None:
+                self.hists[name] = [hist.astype(onp.float64), edges]
+            else:
+                # re-bin the old histogram onto the wider range if needed
+                if amax > prev[1][-1]:
+                    old_centers = (prev[1][:-1] + prev[1][1:]) / 2
+                    nh, ne = onp.histogram(old_centers, bins=_NUM_BINS,
+                                           range=(-amax, amax),
+                                           weights=prev[0])
+                    prev = [nh, ne]
+                    hist, edges = onp.histogram(a, bins=_NUM_BINS,
+                                                range=(-amax, amax))
+                self.hists[name] = [prev[0] + hist, prev[1]]
+        return _pre
+
+    def attach(self, block, name):
+        self._handles.append((block, block.register_forward_pre_hook(
+            self.hook(name))))
+
+    def detach(self):
+        for blk, h in self._handles:
+            blk._forward_pre_hooks.remove(h)
+        self._handles = []
+
+
+def _threshold(collector, name, mode, percentile):
+    mn, mx = collector.min_max[name]
+    if mode == "naive":
+        amax = max(abs(mn), abs(mx))
+    elif mode == "percentile":
+        hist, edges = collector.hists[name]
+        total = hist.sum()
+        centers_abs = onp.abs((edges[:-1] + edges[1:]) / 2)
+        order = onp.argsort(centers_abs)
+        cum = onp.cumsum(hist[order]) / max(total, 1)
+        idx = onp.searchsorted(cum, percentile)
+        idx = min(idx, order.size - 1)
+        amax = float(centers_abs[order[idx]])
+    elif mode == "entropy":
+        from ..ops.quantization import calibrate_entropy
+        hist, edges = collector.hists[name]
+        amax, _ = calibrate_entropy(hist, edges)
+    else:
+        raise MXNetError(f"unknown calib_mode {mode!r}")
+    return max(float(amax), 1e-12)
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense sharing the fp32 layer's parameters; input range baked from
+    calibration (quantized_fully_connected.cc + quantize_graph_pass.cc)."""
+
+    def __init__(self, orig: "nn.Dense", calib_amax: float, **kwargs):
+        super().__init__(**kwargs)
+        object.__setattr__(self, "_src", orig)
+        self.weight = orig.weight
+        self.bias = orig.bias
+        self._units = orig._units
+        self._flatten = orig._flatten
+        self._act_type = orig._act_type
+        self._amax = float(calib_amax)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        import jax.numpy as jnp
+        from ..ops import quantization as Q
+        xq, xmn, xmx = Q.quantize_v2(x.data if isinstance(x, NDArray) else x,
+                                     min_calib_range=-self._amax,
+                                     max_calib_range=self._amax)
+        w = weight.data if isinstance(weight, NDArray) else weight
+        wq, wmn, wmx = Q.quantize_v2(w)
+        acc, _, _ = Q.quantized_fully_connected(xq, wq, xmn, xmx, wmn, wmx,
+                                                num_hidden=self._units,
+                                                flatten=self._flatten)
+        out = Q.dequantize_accum(acc, xmn, xmx, wmn, wmx)
+        if bias is not None:
+            b = bias.data if isinstance(bias, NDArray) else bias
+            out = out + b
+        out = NDArray(out)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        return f"QuantizedDense({self._units}, amax={self._amax:.4g})"
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 Conv2D sharing the fp32 layer's parameters (quantized_conv.cc)."""
+
+    def __init__(self, orig, calib_amax: float, **kwargs):
+        super().__init__(**kwargs)
+        object.__setattr__(self, "_src", orig)
+        self.weight = orig.weight
+        self.bias = orig.bias
+        self._conv_kwargs = dict(orig._kwargs)
+        self._act_type = orig._act_type
+        self._amax = float(calib_amax)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        from ..ops import quantization as Q
+        xq, xmn, xmx = Q.quantize_v2(x.data if isinstance(x, NDArray) else x,
+                                     min_calib_range=-self._amax,
+                                     max_calib_range=self._amax)
+        w = weight.data if isinstance(weight, NDArray) else weight
+        wq, wmn, wmx = Q.quantize_v2(w)
+        kw = self._conv_kwargs
+        acc, _, _ = Q.quantized_conv(xq, wq, xmn, xmx, wmn, wmx,
+                                     kernel=kw.get("kernel"),
+                                     stride=kw.get("stride"),
+                                     dilate=kw.get("dilate"),
+                                     pad=kw.get("pad"),
+                                     num_filter=kw.get("num_filter", 0),
+                                     num_group=kw.get("num_group", 1))
+        out = Q.dequantize_accum(acc, xmn, xmx, wmn, wmx)
+        if bias is not None:
+            b = bias.data if isinstance(bias, NDArray) else bias
+            out = out + b.reshape((1, -1) + (1,) * (out.ndim - 2))
+        out = NDArray(out)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        return f"QuantizedConv2D(amax={self._amax:.4g})"
+
+
+def _quantizable(blk):
+    from ..gluon.nn.conv_layers import Conv2D
+    return isinstance(blk, (nn.Dense, Conv2D))
+
+
+def quantize_net(network, quantized_dtype="int8", calib_data=None,
+                 calib_mode="entropy", percentile=0.9999,
+                 exclude_layers=None, exclude_layers_match=None, logger=None):
+    """Calibrate + convert a Gluon net to int8 inference
+    (reference quantize_net, contrib/quantization.py:1006).
+
+    Mutates and returns ``network``: quantizable Dense/Conv2D children are
+    replaced in-place by Quantized* blocks sharing the same Parameters (so a
+    later ``save_parameters`` still works). ``calib_data`` is an iterable of
+    input batches (NDArray or tuples)."""
+    if quantized_dtype != "int8":
+        raise MXNetError("TPU quantization supports int8 (MXU-native); "
+                         f"got {quantized_dtype!r}")
+    if calib_data is None:
+        raise MXNetError("calib_data is required (naive/entropy/percentile "
+                         "calibration all observe real activations)")
+    exclude_layers = set(exclude_layers or ())
+    patterns = list(exclude_layers_match or ())
+
+    # enumerate quantizable leaf blocks with their parent and attr name
+    targets = []
+
+    def walk(parent):
+        for name, child in list(parent._children.items()):
+            if _quantizable(child):
+                full = child.name
+                if full in exclude_layers or any(p in full for p in patterns):
+                    continue
+                targets.append((parent, name, child))
+            else:
+                walk(child)
+
+    walk(network)
+    if not targets:
+        return network
+
+    collector = LayerInputCollector()
+    for parent, name, child in targets:
+        collector.attach(child, child.name)
+    was_active = getattr(network, "_active", False)
+    if was_active:
+        network.hybridize(False)
+    for batch in calib_data:
+        args = batch if isinstance(batch, (tuple, list)) else (batch,)
+        network(*args)
+    collector.detach()
+
+    for parent, name, child in targets:
+        amax = _threshold(collector, child.name, calib_mode, percentile)
+        from ..gluon.nn.conv_layers import Conv2D
+        q = QuantizedConv2D(child, amax) if isinstance(child, Conv2D) \
+            else QuantizedDense(child, amax)
+        parent._children[name] = q
+        if getattr(parent, name, None) is child:
+            object.__setattr__(parent, name, q)
+    if was_active:
+        network.hybridize(True)
+    return network

@@ -1,0 +1,200 @@
+"""Quantization ops (parity surface: src/operator/quantization/ — quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_fully_connected.cc, quantized_conv.cc,
+calibrate.cc).
+
+TPU-native design: int8 lives as a first-class XLA dtype — the MXU multiplies
+int8×int8 into int32 natively (dot_general / conv_general_dilated with
+preferred_element_type=int32), so the quantized compute ops are thin jitted
+lowerings rather than hand kernels. Ranges travel as (min, max) scalar arrays
+exactly like the reference's extra outputs, and int8 uses the reference's
+zero-centered convention (scale = 127 / max|range|, quantize_v2-inl.h
+quantize_v2_zero_centered)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_QMAX = 127.0  # MinAbs(MaxValue<int8>, MinValue<int8>) — zero-centered
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize / requantize (quantize_v2.cc, dequantize.cc,
+# requantize.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_quantize_v2", jit=True, differentiable=False)
+def quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """fp32 -> int8/uint8 with (q, min_range, max_range) outputs.
+
+    int8 is zero-centered: scale = 127/max(|min|,|max|); uint8 is affine over
+    [min, max] (quantize_v2-inl.h:150-210). Without calib ranges the data's
+    own min/max is used (the uncalibrated path)."""
+    x = data.astype(jnp.float32)
+    if min_calib_range is None or max_calib_range is None:
+        mn = jnp.min(x)
+        mx = jnp.max(x)
+    else:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    if out_type == "int8":
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        amax = jnp.maximum(amax, 1e-12)
+        scale = INT8_QMAX / amax
+        q = jnp.clip(jnp.round(x * scale), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+        return q, -amax, amax
+    elif out_type == "uint8":
+        rng = jnp.maximum(mx - mn, 1e-12)
+        scale = 255.0 / rng
+        q = jnp.clip(jnp.round((x - mn) * scale), 0, 255).astype(jnp.uint8)
+        return q, mn, mx
+    raise ValueError(f"unsupported out_type {out_type}")
+
+
+@register("_contrib_dequantize", jit=True, differentiable=False)
+def dequantize(data, min_range, max_range, *, out_type="float32"):
+    """int8/uint8 -> fp32 using the stored ranges (dequantize-inl.h)."""
+    mn = jnp.asarray(min_range, jnp.float32)
+    mx = jnp.asarray(max_range, jnp.float32)
+    if data.dtype == jnp.int8:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return data.astype(jnp.float32) * (amax / INT8_QMAX)
+    if data.dtype == jnp.uint8:
+        return data.astype(jnp.float32) * ((mx - mn) / 255.0) + mn
+    if data.dtype == jnp.int32:
+        # accumulator dequant: range maps the int32 span back to real values
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        return data.astype(jnp.float32) * (amax / 2147483647.0)
+    raise ValueError(f"dequantize: unsupported input dtype {data.dtype}")
+
+
+@register("_contrib_requantize", jit=True, differentiable=False)
+def requantize(data, min_range, max_range, *, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 (requantize-inl.h). With calib ranges the
+    output scale is fixed; otherwise it derives from the actual extrema."""
+    real = dequantize(data, min_range, max_range)
+    return quantize_v2(real, out_type="int8",
+                       min_calib_range=min_calib_range,
+                       max_calib_range=max_calib_range)
+
+
+# ---------------------------------------------------------------------------
+# quantized compute ops (quantized_fully_connected.cc, quantized_conv.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_quantized_fully_connected", jit=True, differentiable=False)
+def quantized_fully_connected(x, weight, min_x, max_x, min_w, max_w, *,
+                              num_hidden=0, flatten=True):
+    """int8 x (N,K) · int8 w (M,K) -> int32 (N,M) on the MXU, plus the output
+    ranges. Bias handling happens at the dequantized boundary (the gluon
+    wrapper), matching the reference's float-bias re-quantization path."""
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    # out_real = acc * (sx_inv * sw_inv); ranges propagate multiplicatively
+    amax_x = jnp.maximum(jnp.abs(min_x), jnp.abs(max_x))
+    amax_w = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
+    k = x.shape[-1]
+    out_amax = amax_x * amax_w * k / INT8_QMAX  # |acc| <= 127*127*k
+    return acc, -out_amax, out_amax
+
+
+@register("_contrib_quantized_conv", jit=True, differentiable=False)
+def quantized_conv(x, weight, min_x, max_x, min_w, max_w, *, kernel=None,
+                   stride=None, dilate=None, pad=None, num_filter=0,
+                   num_group=1, layout=None):
+    """int8 NCHW conv -> int32 accumulator + ranges (quantized_conv.cc)."""
+    from .nn import _CONV_DN, _tup
+    nd = x.ndim - 2
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _CONV_DN[nd])
+    acc = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group, preferred_element_type=jnp.int32)
+    amax_x = jnp.maximum(jnp.abs(min_x), jnp.abs(max_x))
+    amax_w = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
+    import numpy as onp
+    k = int(onp.prod(weight.shape[1:]))  # C_in/g * prod(kernel)
+    out_amax = amax_x * amax_w * k / INT8_QMAX
+    return acc, -out_amax, out_amax
+
+
+def dequantize_accum(acc, min_x, max_x, min_w, max_w):
+    """int32 accumulator -> fp32 real values: acc / (scale_x * scale_w)."""
+    amax_x = jnp.maximum(jnp.abs(jnp.asarray(min_x, jnp.float32)),
+                         jnp.abs(jnp.asarray(max_x, jnp.float32)))
+    amax_w = jnp.maximum(jnp.abs(jnp.asarray(min_w, jnp.float32)),
+                         jnp.abs(jnp.asarray(max_w, jnp.float32)))
+    inv = (amax_x / INT8_QMAX) * (amax_w / INT8_QMAX)
+    return acc.astype(jnp.float32) * inv
+
+
+# ---------------------------------------------------------------------------
+# entropy calibration (calibrate.cc CalibrateEntropy)
+# ---------------------------------------------------------------------------
+@register("_contrib_calibrate_entropy", jit=False, differentiable=False)
+def calibrate_entropy(hist, hist_edges, *, num_quantized_bins=255):
+    """KL-divergence-optimal threshold from an activation histogram
+    (calibrate.cc:60-150; the TensorRT-style algorithm). Host-side numpy —
+    calibration is an offline pass, not a jitted hot path."""
+    import numpy as onp
+    hist = onp.asarray(hist, onp.float32)
+    hist_edges = onp.asarray(hist_edges, onp.float32)
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    num_half_quantized_bins = num_quantized_bins // 2
+
+    best_div = onp.inf
+    best_thresh = float(hist_edges[-1])
+    for i in range(num_half_quantized_bins, zero_bin + 1):
+        p_start, p_stop = zero_bin - i, zero_bin + i + 1
+        thresh = float(hist_edges[p_stop]) if p_stop < hist_edges.size \
+            else float(hist_edges[-1])
+        sliced = hist[p_start:p_stop].copy()
+        p = sliced.copy()
+        # outliers clip into the edge bins
+        p[0] += hist[:p_start].sum()
+        p[-1] += hist[p_stop:].sum()
+        is_nonzero = (p != 0).astype(onp.float32)
+
+        # quantize p's support into num_quantized_bins, then expand back
+        factor = p.size / num_quantized_bins
+        q = onp.zeros_like(p)
+        for j in range(num_quantized_bins):
+            lo = int(round(j * factor))
+            hi = int(round((j + 1) * factor))
+            norm = is_nonzero[lo:hi].sum()
+            if norm:
+                q[lo:hi] = is_nonzero[lo:hi] * sliced[lo:hi].sum() / norm
+        p = _smooth_distribution(p)
+        q_sum = q.sum()
+        if q_sum == 0:
+            continue
+        q = _smooth_distribution(q)
+        p = p / p.sum()
+        q = q / q.sum()
+        div = float((p * onp.log(p / q)).sum())
+        if div < best_div:
+            best_div = div
+            best_thresh = thresh
+    return best_thresh, best_div
+
+
+def _smooth_distribution(p, eps=0.0001):
+    """Replace zeros with eps, removing the mass from non-zeros
+    (quantization.py:299 reference algorithm)."""
+    import numpy as onp
+    is_zeros = (p == 0).astype(onp.float32)
+    is_nonzeros = (p != 0).astype(onp.float32)
+    n_zeros = is_zeros.sum()
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        raise ValueError("all-zero distribution")
+    eps1 = eps * float(n_zeros) / float(n_nonzeros)
+    return p.astype(onp.float32) + eps * is_zeros - eps1 * is_nonzeros

@@ -1,0 +1,195 @@
+"""Quantization tests (parity patterns: tests/python/quantization/
+test_quantization.py — quantize/dequantize/requantize ops, quantized FC/conv,
+calibration, end-to-end quantize_net accuracy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.quantization import quantize_net
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ops import quantization as Q
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(64, 32).astype("float32") * 3
+    q, mn, mx_ = Q.quantize_v2(x)
+    assert str(q.dtype) == "int8"
+    back = onp.asarray(Q.dequantize(q, mn, mx_))
+    amax = onp.abs(x).max()
+    onp.testing.assert_allclose(back, x, atol=amax / 127 * 0.51 + 1e-6)
+
+
+def test_quantize_calibrated_clips():
+    x = onp.array([[-10.0, -1.0, 0.5, 1.0, 10.0]], "float32")
+    q, mn, mx_ = Q.quantize_v2(x, min_calib_range=-2.0, max_calib_range=2.0)
+    back = onp.asarray(Q.dequantize(q, mn, mx_))
+    onp.testing.assert_allclose(back[0, 1:4], x[0, 1:4], atol=2 / 127 * 0.51)
+    assert back[0, 0] == pytest.approx(-2.0, abs=1e-6)  # clipped
+    assert back[0, 4] == pytest.approx(2.0, abs=1e-6)
+
+
+def test_quantize_uint8():
+    x = onp.linspace(0, 5, 16, dtype="float32").reshape(4, 4)
+    q, mn, mx_ = Q.quantize_v2(x, out_type="uint8")
+    assert str(q.dtype) == "uint8"
+    back = onp.asarray(Q.dequantize(q, mn, mx_))
+    onp.testing.assert_allclose(back, x, atol=5 / 255 * 0.51 + 1e-6)
+
+
+def test_requantize():
+    rng = onp.random.RandomState(1)
+    x = rng.randn(8, 8).astype("float32")
+    q, mn, mx_ = Q.quantize_v2(x)
+    import jax.numpy as jnp
+    acc = q.astype(jnp.int32) * 1000
+    amax = float(onp.abs(x).max()) * 1000 / 127 * 2147483647 / 2147483647
+    q2, mn2, mx2 = Q.requantize(acc, -amax * 127, amax * 127)
+    assert str(q2.dtype) == "int8"
+
+
+def test_quantized_fully_connected_matches_fp32():
+    rng = onp.random.RandomState(2)
+    x = rng.randn(16, 32).astype("float32")
+    w = rng.randn(24, 32).astype("float32")
+    xq, xmn, xmx = Q.quantize_v2(x)
+    wq, wmn, wmx = Q.quantize_v2(w)
+    acc, _, _ = Q.quantized_fully_connected(xq, wq, xmn, xmx, wmn, wmx,
+                                            num_hidden=24)
+    got = onp.asarray(Q.dequantize_accum(acc, xmn, xmx, wmn, wmx))
+    want = x @ w.T
+    # int8 quantization error ~ 1/127 per operand
+    err = onp.abs(got - want) / (onp.abs(want).max() + 1e-6)
+    assert err.max() < 0.05, err.max()
+
+
+def test_quantized_conv_matches_fp32():
+    import jax
+    rng = onp.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    xq, xmn, xmx = Q.quantize_v2(x)
+    wq, wmn, wmx = Q.quantize_v2(w)
+    acc, _, _ = Q.quantized_conv(xq, wq, xmn, xmx, wmn, wmx,
+                                 kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    got = onp.asarray(Q.dequantize_accum(acc, xmn, xmx, wmn, wmx))
+    from mxnet_tpu.ops.nn import convolution
+    want = onp.asarray(convolution(x, w, None, kernel=(3, 3), stride=(1, 1),
+                                   pad=(1, 1), no_bias=True))
+    err = onp.abs(got - want) / (onp.abs(want).max() + 1e-6)
+    assert err.max() < 0.05, err.max()
+
+
+def test_entropy_calibration_prefers_bulk_over_outlier():
+    """KL threshold should land well inside a heavy-tailed distribution."""
+    rng = onp.random.RandomState(4)
+    a = rng.randn(100000).astype("float32")
+    a[0] = 40.0  # single extreme outlier
+    hist, edges = onp.histogram(a, bins=8001, range=(-40, 40))
+    th, div = Q.calibrate_entropy(hist, edges)
+    assert th < 20.0, th  # naive would pick 40
+    assert div < float("inf")
+
+
+@pytest.mark.parametrize("mode", ["naive", "percentile"])
+def test_quantize_net_mlp_accuracy(mode):
+    """Quantized MLP logits stay within a few percent of fp32 on a test batch
+    (the reference's accuracy-preservation bar for LeNet/ResNet)."""
+    rng = onp.random.RandomState(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu", in_units=20),
+            nn.Dense(32, activation="relu", in_units=64),
+            nn.Dense(10, in_units=32))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.array(rng.randn(32, 20).astype("float32")) for _ in range(4)]
+    x = nd.array(rng.randn(64, 20).astype("float32"))
+    want = net(x).asnumpy()
+
+    qnet = quantize_net(net, calib_data=calib, calib_mode=mode)
+    got = qnet(x).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-6)
+    assert rel < 0.05, (mode, rel)
+    # hybridized path produces the same result
+    qnet.hybridize()
+    got_h = qnet(x).asnumpy()
+    onp.testing.assert_allclose(got_h, got, rtol=1e-4, atol=1e-4)
+
+
+def test_entropy_beats_naive_on_heavy_tailed_data():
+    """Entropy (KL) calibration clips rare outliers, preserving resolution for
+    the bulk — its int8 reconstruction error on the bulk must beat naive
+    min/max (the scenario calibrate.cc exists for)."""
+    rng = onp.random.RandomState(8)
+    a = rng.randn(200000).astype("float32")
+    mask = rng.rand(200000) < 0.001
+    a = a + mask * rng.randn(200000).astype("float32") * 60
+    bulk = a[~mask]
+    amax_naive = float(onp.abs(a).max())
+    hist, edges = onp.histogram(a, bins=8001, range=(-amax_naive, amax_naive))
+    th_entropy, _ = Q.calibrate_entropy(hist, edges)
+    assert th_entropy < amax_naive / 3
+
+    def roundtrip_err(amax):
+        q, mn, mx_ = Q.quantize_v2(bulk, min_calib_range=-amax,
+                                   max_calib_range=amax)
+        back = onp.asarray(Q.dequantize(q, mn, mx_))
+        return onp.abs(back - bulk).mean()
+
+    assert roundtrip_err(th_entropy) < roundtrip_err(amax_naive) / 3
+
+
+def test_quantize_net_entropy_mode_end_to_end():
+    """entropy calib mode drives the full quantize_net pipeline."""
+    rng = onp.random.RandomState(9)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.array(rng.randn(32, 16).astype("float32")) for _ in range(3)]
+    x = nd.array(rng.randn(16, 16).astype("float32"))
+    want = net(x).asnumpy()
+    qnet = quantize_net(net, calib_data=calib, calib_mode="entropy")
+    got = qnet(x).asnumpy()
+    # entropy clipping on gaussian data costs accuracy but must stay sane
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-6)
+    assert rel < 0.5, rel
+    assert "QuantizedDense" in str(qnet)
+
+
+def test_quantize_net_lenet_conv():
+    """Conv net (LeNet-style) end-to-end quantization."""
+    rng = onp.random.RandomState(6)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 5, padding=2, activation="relu", in_channels=1),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu", in_channels=8),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    calib = [nd.array(rng.rand(8, 1, 28, 28).astype("float32"))
+             for _ in range(3)]
+    x = nd.array(rng.rand(16, 1, 28, 28).astype("float32"))
+    net(x)  # materialize deferred dense shape
+    want = net(x).asnumpy()
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive")
+    got = qnet(x).asnumpy()
+    rel = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-6)
+    assert rel < 0.06, rel
+    # conversion actually happened
+    reprs = str(qnet)
+    assert "QuantizedConv2D" in reprs and "QuantizedDense" in reprs
+
+
+def test_quantize_net_excludes_layers():
+    rng = onp.random.RandomState(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    first_name = net._children["0"].name
+    calib = [nd.array(rng.randn(4, 8).astype("float32"))]
+    qnet = quantize_net(net, calib_data=calib, calib_mode="naive",
+                        exclude_layers=[first_name])
+    assert type(qnet._children["0"]).__name__ == "Dense"
+    assert type(qnet._children["1"]).__name__ == "QuantizedDense"
