@@ -113,6 +113,14 @@ def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
     return fn
 
 
+def _profiler_running() -> bool:
+    """Cheap check for an active profiler session (imported lazily so the
+    profiler module never loads on the fast path unless the user started it)."""
+    import sys
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof is not None and prof._STATE["running"]
+
+
 def _colocate(jax_inputs, ctx):
     """Move raw auxiliary arrays (e.g. PRNG keys) onto the op's device so mixed
     placements never reach the compiler (eager only; tracers pass through)."""
@@ -136,7 +144,14 @@ def _colocate(jax_inputs, ctx):
 
 
 def invoke(op: Op, inputs: Sequence, attrs: Dict[str, Any]):
-    """Imperative::Invoke analog. `inputs` are NDArrays; returns NDArray or tuple."""
+    """Imperative::Invoke analog. `inputs` are NDArrays; returns NDArray or tuple.
+
+    When the profiler is running, every dispatch is recorded as a per-op event
+    (the ProfileOperator-on-every-engine-op analog, src/profiler/profiler.h:251
+    via src/engine/threaded_engine.h:85): host-side dispatch duration lands in
+    the chrome-trace/aggregate table, and a TraceAnnotation scopes the device
+    work so XPlane traces attribute device time to the op name.
+    """
     from ..ndarray.ndarray import NDArray, _wrap_output
     from .. import autograd
 
@@ -148,20 +163,37 @@ def invoke(op: Op, inputs: Sequence, attrs: Dict[str, Any]):
             break
     if ctx is not None:
         jax_inputs = _colocate(jax_inputs, ctx)
-    if ctx is None:
+
+    profiling = _profiler_running()
+
+    def _run():
+        if ctx is not None:
+            return _executor(op, attrs)(*jax_inputs)
         # no array input pins a device (e.g. samplers): honor the default context
-        from ..base import current_context
         from .. import tracing
-        ctx = current_context()
         if tracing.current() is None:
             import jax
-            with jax.default_device(ctx.jax_device()):
-                out = _executor(op, attrs)(*jax_inputs)
-        else:
-            out = _executor(op, attrs)(*jax_inputs)
+            with jax.default_device(run_ctx.jax_device()):
+                return _executor(op, attrs)(*jax_inputs)
+        return _executor(op, attrs)(*jax_inputs)
+
+    if ctx is None:
+        from ..base import current_context
+        run_ctx = current_context()
     else:
-        out = _executor(op, attrs)(*jax_inputs)
-    outputs = _wrap_output(out, ctx)
+        run_ctx = ctx
+    if profiling:
+        import time
+        import jax.profiler
+        from .. import profiler
+        t0 = time.perf_counter_ns() // 1000
+        with jax.profiler.TraceAnnotation(op.name):
+            out = _run()
+        profiler._record(op.name, "operator", t0,
+                         time.perf_counter_ns() // 1000 - t0)
+    else:
+        out = _run()
+    outputs = _wrap_output(out, run_ctx)
 
     if op.differentiable and autograd.is_recording():
         autograd._record_op(op, attrs, list(inputs), outputs)

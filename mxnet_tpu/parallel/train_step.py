@@ -189,6 +189,20 @@ class ParallelTrainStep:
     # ------------------------------------------------------------------
     def step(self, x, y, *extras):
         """Run one fused training step; returns the (scalar) loss NDArray."""
+        from ..ops.registry import _profiler_running
+        if _profiler_running():
+            import time
+            import jax.profiler as jprof
+            from .. import profiler
+            t0 = time.perf_counter_ns() // 1000
+            with jprof.TraceAnnotation("ParallelTrainStep"):
+                out = self._step_impl(x, y, *extras)
+            profiler._record("ParallelTrainStep", "operator", t0,
+                             time.perf_counter_ns() // 1000 - t0)
+            return out
+        return self._step_impl(x, y, *extras)
+
+    def _step_impl(self, x, y, *extras):
         import jax
         import jax.numpy as jnp
         if self._step_fn is None:
