@@ -33,6 +33,7 @@ from . import callback
 from . import visualization
 from . import util
 from . import amp
+from . import operator
 from . import parallel
 from . import sparse
 from . import symbol

@@ -355,16 +355,10 @@ class CachedOp:
     def __call__(self, *inputs):
         from ..ops.registry import _profiler_running
         if _profiler_running():
-            import time
             from .. import profiler
-            name = f"CachedOp[{type(self.block).__name__}]"
-            t0 = time.perf_counter_ns() // 1000
-            import jax.profiler as jprof
-            with jprof.TraceAnnotation(name):
-                out = self._invoke(*inputs)
-            profiler._record(name, "operator", t0,
-                             time.perf_counter_ns() // 1000 - t0)
-            return out
+            return profiler._dispatch_profiled(
+                f"CachedOp[{type(self.block).__name__}]",
+                lambda: self._invoke(*inputs))
         return self._invoke(*inputs)
 
     def _invoke(self, *inputs):

@@ -1,0 +1,280 @@
+"""Python custom operator API (parity: python/mxnet/operator.py:434 ``CustomOp``,
+:487 ``CustomOpProp``, :710 ``register``, over src/operator/custom/custom-inl.h:52).
+
+TPU-native design
+-----------------
+The reference bridges user Python into the C++ engine through ctypes callback
+lists (``MXCustomOpRegister``) and runs the Python body on a dedicated custom-op
+worker thread.  Here a registered custom op becomes a ``jax.custom_vjp`` function
+whose forward/backward bodies are *host callbacks* (``jax.pure_callback``) into
+the user's ``CustomOp.forward`` / ``CustomOp.backward``.  Consequences:
+
+  - custom ops run under ``jax.jit`` (hybridize / CachedOp / ParallelTrainStep):
+    XLA inserts device↔host transfers around the callback, the analog of the
+    reference engine syncing custom-op inputs to the CPU context;
+  - autograd works through the standard tape: ``jax.vjp`` of the dispatched op
+    hits the custom vjp, which calls the user's ``backward``;
+  - shape/dtype inference still goes through ``CustomOpProp.infer_shape`` /
+    ``infer_type`` — pure_callback needs result shapes before the host runs.
+
+Limitations vs the reference: auxiliary states are passed to ``forward`` but
+in-place aux mutation does not propagate back to the caller's buffer under jit
+(functional semantics); sparse (csr/row_sparse) custom ops are not supported —
+``infer_storage_type`` exists for API parity and asserts 'default'.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, Tuple
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+
+class CustomOp:
+    """Base class for operators implemented in Python (operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Forward interface: write results into ``out_data`` (use ``assign``)."""
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Backward interface: write input gradients into ``in_grad``."""
+
+    def assign(self, dst, req, src):
+        """Helper honouring the write request type ('null'/'write'/'add')."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"invalid req {req!r}")
+
+
+class CustomOpProp:
+    """Base class for custom operator property classes (operator.py:487)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [in_shape[0]] * len(self.list_auxiliary_states())
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def infer_storage_type(self, in_stype):
+        for i, stype in enumerate(in_stype):
+            assert stype == "default", (
+                "custom ops on TPU support only dense storage; got stype "
+                f"{stype!r} for input {i}")
+        return in_stype, ["default"] * len(self.list_outputs()), \
+            ["default"] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_REGISTRY: Dict[str, type] = {}
+_VERSIONS: Dict[str, int] = {}
+_LOCK = threading.Lock()
+
+
+def register(reg_name):
+    """Register a ``CustomOpProp`` subclass under ``reg_name`` (operator.py:710).
+
+    After registration the op is callable as ``mx.nd.Custom(*data,
+    op_type=reg_name, **kwargs)`` (and from symbols / hybridized blocks).
+    Re-registering an existing name replaces the implementation for
+    subsequent calls, as in the reference."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        with _LOCK:
+            _REGISTRY[reg_name] = prop_cls
+            _VERSIONS[reg_name] = _VERSIONS.get(reg_name, 0) + 1
+        return prop_cls
+    return do_register
+
+
+def get_all_registered_operators():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch: build a jax.custom_vjp callable per (op_type, kwargs, is_train)
+# ---------------------------------------------------------------------------
+_FN_CACHE: Dict[Tuple, object] = {}
+
+
+def _make_prop(op_type, kwargs):
+    if op_type not in _REGISTRY:
+        raise MXNetError(f"custom op {op_type!r} is not registered")
+    # the reference C bridge delivers all attrs as strings (operator.py creator);
+    # keep that contract so props written against it port unchanged
+    return _REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+
+
+def _as_ndarrays(host_arrays):
+    from .base import cpu
+    from .ndarray.ndarray import NDArray
+    import jax
+    cdev = jax.devices("cpu")[0]
+    return [NDArray(jax.device_put(onp.asarray(a), cdev), ctx=cpu())
+            for a in host_arrays]
+
+
+def _make_custom_fn(op_type, frozen_kwargs, is_train):
+    import jax
+    import jax.numpy as jnp
+
+    prop = _make_prop(op_type, dict(frozen_kwargs))
+    n_in = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    n_out = len(prop.list_outputs())
+    op_cache: Dict[Tuple, CustomOp] = {}
+
+    def _shapes_types(arrays):
+        in_shapes = [list(a.shape) for a in arrays[:n_in]]
+        in_types = [onp.dtype(a.dtype) for a in arrays[:n_in]]
+        shp = prop.infer_shape(in_shapes)
+        out_shapes = shp[1]
+        typ = prop.infer_type(list(in_types))
+        out_types = typ[1]
+        return in_shapes, in_types, out_shapes, out_types
+
+    def _operator(in_shapes, in_types):
+        key = tuple((tuple(s), onp.dtype(t).name) for s, t in zip(in_shapes, in_types))
+        inst = op_cache.get(key)
+        if inst is None:
+            from .base import current_context
+            inst = prop.create_operator(str(current_context()), in_shapes, in_types)
+            op_cache[key] = inst
+        return inst
+
+    def _forward_cb(*host_arrays):
+        in_nd = _as_ndarrays(host_arrays[:n_in])
+        aux_nd = _as_ndarrays(host_arrays[n_in:])
+        in_shapes = [list(a.shape) for a in in_nd]
+        in_types = [onp.dtype(a.dtype) for a in in_nd]
+        _, _, out_shapes, out_types = _shapes_types(in_nd)
+        from .ndarray import zeros
+        from .base import cpu
+        out_nd = [zeros(tuple(s), ctx=cpu(), dtype=onp.dtype(t).name)
+                  for s, t in zip(out_shapes, out_types)]
+        inst = _operator(in_shapes, in_types)
+        inst.forward(is_train=is_train, req=["write"] * n_out,
+                     in_data=in_nd, out_data=out_nd, aux=aux_nd)
+        return tuple(o.asnumpy() for o in out_nd)
+
+    def _backward_cb(*host_arrays):
+        # layout: out_grad (n_out) + in_data (n_in) + aux (n_aux) + out_data (n_out)
+        og = _as_ndarrays(host_arrays[:n_out])
+        ind = _as_ndarrays(host_arrays[n_out:n_out + n_in])
+        aux = _as_ndarrays(host_arrays[n_out + n_in:n_out + n_in + n_aux])
+        outd = _as_ndarrays(host_arrays[n_out + n_in + n_aux:])
+        from .ndarray import zeros
+        from .base import cpu
+        in_grad = [zeros(a.shape, ctx=cpu(), dtype=str(a.dtype)) for a in ind]
+        inst = _operator([list(a.shape) for a in ind],
+                         [onp.dtype(a.dtype) for a in ind])
+        inst.backward(req=["write"] * n_in, out_grad=og, in_data=ind,
+                      out_data=outd, in_grad=in_grad, aux=aux)
+        return tuple(g.asnumpy() for g in in_grad)
+
+    def _result_structs(arrays):
+        _, _, out_shapes, out_types = _shapes_types(arrays)
+        return tuple(jax.ShapeDtypeStruct(tuple(s), onp.dtype(t))
+                     for s, t in zip(out_shapes, out_types))
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        out = jax.pure_callback(_forward_cb, _result_structs(arrays), *arrays,
+                                vmap_method="sequential")
+        return out if n_out > 1 else out[0]
+
+    def fn_fwd(*arrays):
+        out = fn(*arrays)
+        return out, (arrays, out if n_out > 1 else (out,))
+
+    def fn_bwd(res, cots):
+        arrays, outs = res
+        cots = tuple(cots) if n_out > 1 else (cots,)
+        in_structs = tuple(jax.ShapeDtypeStruct(a.shape, onp.dtype(a.dtype))
+                           for a in arrays[:n_in])
+        grads = jax.pure_callback(_backward_cb, in_structs,
+                                  *(cots + tuple(arrays) + tuple(outs)),
+                                  vmap_method="sequential")
+        if not isinstance(grads, tuple):
+            grads = (grads,)
+        aux_zeros = tuple(jnp.zeros(a.shape, a.dtype) for a in arrays[n_in:])
+        return tuple(grads) + aux_zeros
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+def _get_custom_fn(op_type, kwargs, is_train):
+    from .ops.registry import _freeze
+    # version tag invalidates cached fns when an op name is re-registered
+    key = (op_type, _VERSIONS.get(op_type, 0), _freeze(kwargs), bool(is_train))
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        with _LOCK:
+            fn = _FN_CACHE.get(key)
+            if fn is None:
+                fn = _make_custom_fn(op_type, _freeze(kwargs), bool(is_train))
+                _FN_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Registry hookup: mx.nd.Custom / mx.sym.Custom (custom.cc "Custom" op analog)
+# ---------------------------------------------------------------------------
+def _install():
+    from .ops import registry as _reg
+
+    @_reg.register("Custom")
+    def Custom(*data, op_type, **kwargs):
+        """Apply a registered Python custom operator (``mx.operator.register``)."""
+        from . import autograd
+        kwargs.pop("name", None)
+        fn = _get_custom_fn(op_type, kwargs, autograd.is_training())
+        return fn(*data)
+
+    # regenerate frontend wrappers so nd.Custom / sym.Custom exist even though
+    # this module imports after the namespaces were built
+    from . import ndarray as _nd
+    from . import symbol as _sym
+    _nd._install_wrappers()
+    _sym._install_wrappers()
+
+
+_install()

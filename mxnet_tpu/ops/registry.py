@@ -183,14 +183,8 @@ def invoke(op: Op, inputs: Sequence, attrs: Dict[str, Any]):
     else:
         run_ctx = ctx
     if profiling:
-        import time
-        import jax.profiler
         from .. import profiler
-        t0 = time.perf_counter_ns() // 1000
-        with jax.profiler.TraceAnnotation(op.name):
-            out = _run()
-        profiler._record(op.name, "operator", t0,
-                         time.perf_counter_ns() // 1000 - t0)
+        out = profiler._dispatch_profiled(op.name, _run)
     else:
         out = _run()
     outputs = _wrap_output(out, run_ctx)

@@ -191,15 +191,9 @@ class ParallelTrainStep:
         """Run one fused training step; returns the (scalar) loss NDArray."""
         from ..ops.registry import _profiler_running
         if _profiler_running():
-            import time
-            import jax.profiler as jprof
             from .. import profiler
-            t0 = time.perf_counter_ns() // 1000
-            with jprof.TraceAnnotation("ParallelTrainStep"):
-                out = self._step_impl(x, y, *extras)
-            profiler._record("ParallelTrainStep", "operator", t0,
-                             time.perf_counter_ns() // 1000 - t0)
-            return out
+            return profiler._dispatch_profiled(
+                "ParallelTrainStep", lambda: self._step_impl(x, y, *extras))
         return self._step_impl(x, y, *extras)
 
     def _step_impl(self, x, y, *extras):

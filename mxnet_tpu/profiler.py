@@ -104,6 +104,19 @@ def _record(name, cat, t0_us, dur_us):
         agg[3] = max(agg[3], dur_us)
 
 
+def _dispatch_profiled(name, thunk, cat="operator"):
+    """Run ``thunk`` as one recorded per-op event (shared by op dispatch,
+    CachedOp and ParallelTrainStep — the ProfileOperator-per-engine-op analog,
+    src/profiler/profiler.h:251). Records host dispatch duration and scopes the
+    device work with a TraceAnnotation so XPlane attributes device time."""
+    import jax.profiler
+    t0 = time.perf_counter_ns() // 1000
+    with jax.profiler.TraceAnnotation(name):
+        out = thunk()
+    _record(name, cat, t0, time.perf_counter_ns() // 1000 - t0)
+    return out
+
+
 @contextmanager
 def scope(name: str, cat: str = "operator"):
     """Profile a code region; also emits a jax named-scope annotation so the region
