@@ -105,23 +105,30 @@ class Context:
 
     # -- JAX mapping -------------------------------------------------------
     def jax_device(self):
-        """Resolve to a concrete PJRT device."""
+        """Resolve to a concrete PJRT device. device_id indexes this process's
+        *addressable* devices — under multi-process (jax.distributed) each
+        worker addresses its own chips, like each reference worker its own
+        GPUs; global devices are reachable only through sharded computations."""
         import jax
         if self._canonical_type() == "cpu":
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
         else:
             devs = _accelerator_devices()
             if not devs:  # CPU-only host: transparently fall back (tests, CI)
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
         if self.device_id >= len(devs):
             raise MXNetError(f"{self}: only {len(devs)} device(s) available")
         return devs[self.device_id]
 
     @classmethod
     def from_jax_device(cls, dev) -> "Context":
+        import jax
         if dev.platform == "cpu":
-            return Context("cpu", dev.id)
-        return Context("tpu", _accelerator_devices().index(dev))
+            local = jax.local_devices(backend="cpu")
+            # device ids are global under multi-process; Context ids are local
+            return Context("cpu", local.index(dev) if dev in local else dev.id)
+        accel = _accelerator_devices()
+        return Context("tpu", accel.index(dev) if dev in accel else dev.id)
 
     # -- default-context scoping (python/mxnet/context.py Context.__enter__) --
     def __enter__(self):
@@ -143,7 +150,7 @@ def _accelerator_devices() -> List:
     import jax
     for platform in ("tpu", None):
         try:
-            devs = jax.devices(platform)
+            devs = jax.local_devices(backend=platform)
         except RuntimeError:
             continue
         non_cpu = [d for d in devs if d.platform != "cpu"]

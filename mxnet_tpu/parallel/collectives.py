@@ -93,12 +93,22 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     make a later initialize() raise).
     """
     import jax
+    import os
     try:
         from jax._src.distributed import global_state
         if global_state.client is not None:
             return  # already initialized by the launcher
     except ImportError:
         pass  # private API moved: fall through, tolerate double-init below
+    if coordinator_address is None and "MXNET_TPU_COORDINATOR" in os.environ:
+        # env bootstrapping written by tools/launch.py (the DMLC_PS_ROOT_URI/
+        # DMLC_NUM_WORKER/DMLC_ROLE analog); missing count/id fall through as
+        # None so jax.distributed auto-detection still applies
+        coordinator_address = os.environ["MXNET_TPU_COORDINATOR"]
+        if num_processes is None and "MXNET_TPU_NUM_WORKERS" in os.environ:
+            num_processes = int(os.environ["MXNET_TPU_NUM_WORKERS"])
+        if process_id is None and "MXNET_TPU_WORKER_ID" in os.environ:
+            process_id = int(os.environ["MXNET_TPU_WORKER_ID"])
     if coordinator_address is not None:
         try:
             jax.distributed.initialize(coordinator_address=coordinator_address,

@@ -1,0 +1,85 @@
+"""Worker body for the 2-process dist_sync kvstore test (parity pattern:
+tests/nightly/dist_sync_kvstore.py run via tools/launch.py --launcher local).
+
+Launched by tests/test_dist_kvstore.py through tools/launch.py, which provides
+the MXNET_TPU_* coordinator env. Exercises, with real cross-process
+collectives: dense push/pull (allreduce path), fused pushpull, row_sparse push
+with *different per-worker nnz* (padded allgather path), row_sparse_pull, and
+2-bit gradient compression with error feedback — asserting the wire tensor is
+packed uint8 at 1/16 the fp32 bytes.
+"""
+import os
+import sys
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.sparse import RowSparseNDArray
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, size = kv.rank, kv.num_workers
+    assert size == 2, f"expected 2 workers, got {size}"
+
+    # --- dense push/pull over the allreduce path ---------------------------
+    shape = (8, 4)
+    kv.init("dense", nd.zeros(shape))
+    kv.push("dense", nd.ones(shape) * (rank + 1))
+    out = nd.zeros(shape)
+    kv.pull("dense", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(shape, 3.0), rtol=1e-6)
+
+    # --- fused pushpull ----------------------------------------------------
+    val = nd.ones(shape) * (10 + rank)  # 10 + 11 = 21
+    kv.pushpull("pp", val, out=val)
+    onp.testing.assert_allclose(val.asnumpy(), onp.full(shape, 21.0), rtol=1e-6)
+
+    # --- row_sparse with different per-worker nnz --------------------------
+    dense_shape = (10, 3)
+    kv.init("rsp", nd.zeros(dense_shape))
+    if rank == 0:
+        idx, vals = [1, 4], [[1.0] * 3, [2.0] * 3]
+    else:
+        idx, vals = [4, 7, 9], [[10.0] * 3, [20.0] * 3, [30.0] * 3]
+    rsp = RowSparseNDArray(onp.array(vals, "float32"),
+                           onp.array(idx, "int32"), dense_shape)
+    kv.push("rsp", rsp)
+    out = nd.zeros(dense_shape)
+    kv.pull("rsp", out=out, ignore_sparse=False)
+    expect = onp.zeros(dense_shape, "float32")
+    expect[1], expect[4], expect[7], expect[9] = 1.0, 12.0, 20.0, 30.0
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+    # row_sparse_pull of selected rows only
+    sub = nd.zeros(dense_shape)
+    kv.row_sparse_pull("rsp", out=sub, row_ids=nd.array([4, 9]))
+    expect_sub = onp.zeros(dense_shape, "float32")
+    expect_sub[4], expect_sub[9] = 12.0, 30.0
+    onp.testing.assert_allclose(sub.asnumpy(), expect_sub, rtol=1e-6)
+
+    # --- 2-bit compression: packed wire + error feedback -------------------
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    # wire-size check: the allgathered tensor must be packed uint8, 4 codes/B
+    probe = nd.ones((64, 4)) * 0.3
+    packed, _scale = kv._compression.quantize(("probe", "wire"), probe.data)
+    assert str(packed.dtype) == "uint8" and packed.nbytes == 64 * 4 // 4, \
+        f"wire not packed: {packed.dtype} {packed.nbytes}B for {probe.data.nbytes}B"
+
+    kv.init("comp", nd.zeros(shape))
+    g = nd.ones(shape) * 0.3  # below threshold: quantizes to 0, residual 0.3
+    kv.push("comp", g)
+    out = nd.zeros(shape)
+    kv.pull("comp", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros(shape), atol=1e-7)
+    kv.push("comp", g)  # residual 0.3 + 0.3 = 0.6 >= 0.5 → each sends +0.5
+    kv.pull("comp", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(shape, 1.0), rtol=1e-6)
+
+    kv.barrier()
+    print(f"worker {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+"""Multi-process dist_sync kvstore test: 2 real processes over jax.distributed
+CPU (gloo collectives), launched through tools/launch.py --launcher local
+(parity: tests/nightly/dist_sync_kvstore.py via tools/launch.py:1-135)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore_two_processes():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO  # drop any accelerator-plugin site path
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--",
+         sys.executable, os.path.join(REPO, "tests",
+                                      "dist_sync_kvstore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"dist workers failed:\n{out}"
+    assert "worker 0: OK" in out and "worker 1: OK" in out, out
