@@ -34,15 +34,24 @@ def _emit(metric, value, unit, vs_baseline):
           flush=True)
 
 
-def _time_steps(step, args, steps, warmup):
+def _time_steps(step, args, steps, warmup, reps=3,
+                fetch=lambda out: float(out.asscalar())):
+    """Median of `reps` timing windows of `steps` steps each. Every window is
+    closed by fetching an output VALUE (not just a ready-flag sync), so a
+    glitchy runtime sync can't yield a fake-fast window; the median rejects a
+    remaining outlier window."""
+    import statistics
     for _ in range(warmup):
-        loss = step(*args)
-    loss.wait_to_read()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(*args)
-    loss.wait_to_read()
-    return time.perf_counter() - t0
+        out = step(*args)
+    fetch(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step(*args)
+        fetch(out)
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
 
 
 def bench_resnet():
@@ -116,15 +125,9 @@ def bench_resnet_inference():
     rng = onp.random.RandomState(0)
     x = jax.device_put(jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.bfloat16),
                        dev)
-    y = fwd(pvals, x)
-    for _ in range(warmup):
-        y = fwd(pvals, x)
-    y.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        y = fwd(pvals, x)
-    y.block_until_ready()
-    dt = time.perf_counter() - t0
+    fwd(pvals, x)  # compile
+    dt = _time_steps(lambda: fwd(pvals, x), (), steps, warmup,
+                     fetch=lambda y: float(y[0, 0]))
     img_s = batch * steps / dt
     _emit("resnet50_infer_b128_img_s_per_chip", img_s, "img/s",
           img_s / BASELINE_RESNET_INFER_IMG_S)

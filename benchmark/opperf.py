@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Operator micro-benchmark harness (parity: benchmark/opperf/ —
+run_performance_test + the category runners + the opperf.py CLI, collapsed
+into one TPU-native module).
+
+Times eager dispatch of registered ops (forward, and backward where the op is
+differentiable) with proper device sync, reporting avg/p50/max µs per op —
+the tool that exposes dispatch overhead and slow kernels. The category suites
+mirror the reference's nd_operations/* groupings with TPU-relevant default
+shapes (batched, MXU-aligned).
+
+Usage:
+    python benchmark/opperf.py                      # standard suite
+    python benchmark/opperf.py --ops dot,exp,sum    # specific ops
+    python benchmark/opperf.py --json results.json
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as onp
+
+
+# op -> (input shapes, attrs); shapes chosen MXU/VPU-friendly (128-multiples)
+_SUITES = {
+    "unary": {
+        "exp": ([(1024, 1024)], {}),
+        "log": ([(1024, 1024)], {}),
+        "sqrt": ([(1024, 1024)], {}),
+        "negative": ([(1024, 1024)], {}),
+        "sigmoid": ([(1024, 1024)], {}),
+        "tanh": ([(1024, 1024)], {}),
+        "relu": ([(1024, 1024)], {}),
+    },
+    "binary": {
+        "broadcast_add": ([(1024, 1024), (1024, 1024)], {}),
+        "broadcast_mul": ([(1024, 1024), (1024, 1024)], {}),
+        "broadcast_div": ([(1024, 1024), (1, 1024)], {}),
+        "elemwise_add": ([(1024, 1024), (1024, 1024)], {}),
+    },
+    "gemm": {
+        "dot": ([(1024, 1024), (1024, 1024)], {}),
+        "batch_dot": ([(32, 256, 256), (32, 256, 256)], {}),
+        "FullyConnected": ([(128, 1024), (1024, 1024), (1024,)],
+                           {"num_hidden": 1024}),
+    },
+    "reduction": {
+        "sum": ([(1024, 1024)], {}),
+        "mean": ([(1024, 1024)], {}),
+        "max": ([(1024, 1024)], {}),
+        "norm": ([(1024, 1024)], {}),
+    },
+    "nn": {
+        "Convolution": ([(32, 64, 56, 56), (64, 64, 3, 3), (64,)],
+                        {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)}),
+        "Pooling": ([(32, 64, 56, 56)],
+                    {"kernel": (2, 2), "pool_type": "max", "stride": (2, 2)}),
+        "BatchNorm": ([(32, 64, 56, 56), (64,), (64,), (64,), (64,)], {}),
+        "softmax": ([(128, 1024)], {}),
+        "Dropout": ([(128, 1024)], {"p": 0.5}),
+    },
+    "indexing": {
+        "take": ([(1024, 512), (256,)], {}),
+        "Embedding": ([(128, 64), (30000, 256)],
+                      {"input_dim": 30000, "output_dim": 256}),
+        "one_hot": ([(1024,)], {"depth": 1000}),
+    },
+    "sorting": {
+        "sort": ([(1024, 1024)], {}),
+        "argsort": ([(1024, 1024)], {}),
+        "topk": ([(1024, 1024)], {"k": 10}),
+    },
+}
+
+
+def _make_inputs(op_name, shapes, rng):
+    from mxnet_tpu import nd
+    arrays = []
+    for i, s in enumerate(shapes):
+        if op_name in ("take",) and i == 1:
+            a = nd.array(rng.randint(0, 1024, s).astype("int32"))
+        elif op_name == "Embedding" and i == 0:
+            a = nd.array(rng.randint(0, 30000, s).astype("int32"))
+        elif op_name == "one_hot":
+            a = nd.array(rng.randint(0, 1000, s).astype("int32"))
+        else:
+            a = nd.array(rng.rand(*s).astype("float32"))
+        arrays.append(a)
+    return arrays
+
+
+def run_performance_test(op_names=None, warmup=5, runs=25, backward=True):
+    """Benchmark ops by name; returns a list of result dicts
+    (run_performance_test analog, benchmark/opperf/utils/benchmark_utils.py)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops import registry
+
+    flat = {}
+    for suite in _SUITES.values():
+        flat.update(suite)
+    if op_names:
+        sel = {}
+        for name in op_names:
+            if name not in flat:
+                raise KeyError(f"no benchmark config for op {name!r}; "
+                               f"known: {sorted(flat)}")
+            sel[name] = flat[name]
+        flat = sel
+
+    rng = onp.random.RandomState(7)
+    results = []
+    for name, (shapes, attrs) in flat.items():
+        op = registry.get_op(name)
+        arrays = _make_inputs(name, shapes, rng)
+        times_f, times_b = [], []
+
+        def fwd():
+            out = registry.invoke(op, arrays, dict(attrs))
+            (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
+            return out
+
+        for _ in range(warmup):
+            fwd()
+        for _ in range(runs):
+            t0 = time.perf_counter_ns()
+            fwd()
+            times_f.append((time.perf_counter_ns() - t0) / 1e3)
+
+        if backward and op.differentiable:
+            for a in arrays:
+                if str(a.dtype).startswith("float"):
+                    a.attach_grad()
+            grads = [a for a in arrays if a.grad is not None]
+
+            def bwd():
+                with autograd.record():
+                    out = registry.invoke(op, arrays, dict(attrs))
+                    head = out[0] if isinstance(out, (list, tuple)) else out
+                head.backward()
+                for g in grads:  # sync: async dispatch must not fake the time
+                    g.grad.wait_to_read()
+
+            for _ in range(warmup):
+                bwd()
+            for _ in range(runs):
+                t0 = time.perf_counter_ns()
+                bwd()
+                times_b.append((time.perf_counter_ns() - t0) / 1e3)
+
+        row = {"operator": name,
+               "avg_time_forward_us": round(onp.mean(times_f), 2),
+               "p50_time_forward_us": round(onp.percentile(times_f, 50), 2),
+               "max_time_forward_us": round(onp.max(times_f), 2),
+               "inputs": [list(s) for s in shapes]}
+        if times_b:
+            row["avg_time_backward_us"] = round(onp.mean(times_b), 2)
+        results.append(row)
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser(description="mxnet_tpu operator perf")
+    parser.add_argument("--ops", default=None,
+                        help="comma-separated op names (default: full suite)")
+    parser.add_argument("--runs", type=int, default=25)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--no-backward", action="store_true")
+    parser.add_argument("--json", default=None, help="write results to file")
+    args = parser.parse_args()
+    ops = args.ops.split(",") if args.ops else None
+    res = run_performance_test(ops, warmup=args.warmup, runs=args.runs,
+                               backward=not args.no_backward)
+    widths = (24, 14, 14, 14, 14)
+    hdr = ("operator", "fwd avg(us)", "fwd p50(us)", "fwd max(us)", "bwd avg(us)")
+    print("".join(h.ljust(w) for h, w in zip(hdr, widths)))
+    for r in res:
+        print("".join([
+            r["operator"].ljust(widths[0]),
+            str(r["avg_time_forward_us"]).ljust(widths[1]),
+            str(r["p50_time_forward_us"]).ljust(widths[2]),
+            str(r["max_time_forward_us"]).ljust(widths[3]),
+            str(r.get("avg_time_backward_us", "-")).ljust(widths[4])]))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

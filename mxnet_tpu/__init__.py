@@ -34,6 +34,8 @@ from . import visualization
 from . import util
 from . import amp
 from . import operator
+from . import monitor
+from .monitor import Monitor
 from . import parallel
 from . import sparse
 from . import symbol

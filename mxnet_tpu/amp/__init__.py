@@ -20,6 +20,44 @@ __all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
 _AMP_STATE = {"on": False, "target_dtype": "bfloat16", "scaler": None}
 
 
+import threading as _threading
+
+_CFG_TLS = _threading.local()
+
+
+class _AmpConfig:
+    """Resolved cast policy: low/high op sets, conditional-fp32 rules, dtype."""
+
+    __slots__ = ("low", "high", "cond", "jdt")
+
+    def __init__(self, low, high, cond, target_dtype):
+        self.low = set(low)
+        self.high = set(high)
+        # {op: (attr_name, set(values))} — fp32 only when attr value matches
+        self.cond = {op: (attr, set(vals)) for op, attr, vals in cond}
+        self.jdt = DTypes.jnp(DTypes.canonical(target_dtype))
+
+
+def _push_cfg(cfg):
+    stack = getattr(_CFG_TLS, "stack", None)
+    if stack is None:
+        stack = _CFG_TLS.stack = []
+    stack.append(cfg)
+
+
+def _pop_cfg():
+    _CFG_TLS.stack.pop()
+
+
+def _active_cfg(reg):
+    stack = getattr(_CFG_TLS, "stack", None)
+    if stack:
+        return stack[-1]  # block-scoped conversion takes precedence
+    if _AMP_STATE["on"]:
+        return getattr(reg, "_amp_config", None)
+    return None
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None, conditional_fp32_ops=None,
          fp32_ops=None):
     """Enable AMP: wrap op invocation so TARGET_DTYPE_OPS run in reduced precision
@@ -29,50 +67,51 @@ def init(target_dtype="bfloat16", target_precision_ops=None, conditional_fp32_op
         raise MXNetError("target_dtype must be float16 or bfloat16")
     _AMP_STATE["on"] = True
     _AMP_STATE["target_dtype"] = target_dtype
-    _install_dispatch_hook(
-        set(target_precision_ops or lists.TARGET_DTYPE_OPS),
-        set(fp32_ops or lists.FP32_OPS), target_dtype)
+    cfg = _AmpConfig(target_precision_ops or lists.TARGET_DTYPE_OPS,
+                     fp32_ops or lists.FP32_OPS,
+                     conditional_fp32_ops or lists.CONDITIONAL_FP32_OPS,
+                     target_dtype)
+    _install_dispatch_hook(cfg)
 
 
-def _install_dispatch_hook(low_ops, fp32_ops, target_dtype):
+def _cast_all(inputs, jdt):
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    out = []
+    for x in inputs:
+        if isinstance(x, NDArray) and jnp.issubdtype(x.data.dtype, jnp.floating) \
+                and x.data.dtype != jdt:
+            out.append(NDArray(x.data.astype(jdt), ctx=x.context))
+        else:
+            out.append(x)
+    return out
+
+
+def _install_dispatch_hook(cfg):
     from ..ops import registry as reg
     import jax.numpy as jnp
+    reg._amp_config = cfg
     if getattr(reg, "_amp_wrapped", False):
-        reg._amp_config = (low_ops, fp32_ops, DTypes.jnp(target_dtype))
         return
     original_invoke = reg.invoke
 
     def amp_invoke(op, inputs, attrs):
-        cfg = getattr(reg, "_amp_config", None)
-        if cfg is None or not _AMP_STATE["on"]:
+        c = _active_cfg(reg)
+        if c is None:
             return original_invoke(op, inputs, attrs)
-        low, high, jdt = cfg
-        from ..ndarray.ndarray import NDArray
-        if op.name in low:
-            cast_inputs = []
-            for x in inputs:
-                if isinstance(x, NDArray) and jnp.issubdtype(x.data.dtype,
-                                                             jnp.floating):
-                    cast_inputs.append(NDArray(x.data.astype(jdt), ctx=x.context)
-                                       if x.data.dtype != jdt else x)
-                else:
-                    cast_inputs.append(x)
-            return original_invoke(op, cast_inputs, attrs)
-        if op.name in high:
-            cast_inputs = []
-            for x in inputs:
-                if isinstance(x, NDArray) and x.data.dtype in (jnp.bfloat16,
-                                                               jnp.float16):
-                    cast_inputs.append(NDArray(x.data.astype(jnp.float32),
-                                               ctx=x.context))
-                else:
-                    cast_inputs.append(x)
-            return original_invoke(op, cast_inputs, attrs)
+        name = op.name
+        if name in c.cond:
+            attr, vals = c.cond[name]
+            if str(attrs.get(attr)) in vals:
+                return original_invoke(op, _cast_all(inputs, jnp.float32), attrs)
+        if name in c.low:
+            return original_invoke(op, _cast_all(inputs, c.jdt), attrs)
+        if name in c.high:
+            return original_invoke(op, _cast_all(inputs, jnp.float32), attrs)
         return original_invoke(op, inputs, attrs)
 
     reg.invoke = amp_invoke
     reg._amp_wrapped = True
-    reg._amp_config = (low_ops, fp32_ops, DTypes.jnp(target_dtype))
     # rebind the already-imported references in the nd frontend
     from .. import ndarray as nd_mod
     nd_mod._apply_op = reg.apply_op
@@ -124,7 +163,30 @@ def convert_model(net, target_dtype="bfloat16"):
 def convert_hybrid_block(block, target_dtype="bfloat16", target_dtype_ops=None,
                          fp32_ops=None, conditional_fp32_ops=None,
                          excluded_sym_names=None, ctx=None):
-    """Cast MXU-bound layers to target dtype (amp.py:634 over ReducePrecision
-    pass). Norm layers stay fp32 (see gluon.nn.BatchNorm.cast guard)."""
+    """Convert a block to mixed precision (amp.py:634 over the nnvm
+    ReducePrecision pass, src/nnvm/low_precision_pass.cc).
+
+    The graph-rewrite analog: parameters of MXU-bound layers are cast to the
+    target dtype (norm stats stay fp32 via BatchNorm.cast's guard), and a
+    per-block cast policy — TARGET_DTYPE_OPS to the reduced dtype, FP32_OPS
+    back to fp32, CONDITIONAL_FP32_OPS by attribute value — is attached to the
+    block and applied at op dispatch during its forward. Under ``hybridize``
+    the policy is active while the trace is built, so the casts are baked into
+    the compiled XLA program exactly like the reference pass rewrites the
+    symbol graph."""
+    target_dtype = DTypes.canonical(target_dtype)
+    if target_dtype not in ("float16", "bfloat16"):
+        raise MXNetError("target_dtype must be float16 or bfloat16")
     block.cast(target_dtype)
+    cfg = _AmpConfig(target_dtype_ops or lists.TARGET_DTYPE_OPS,
+                     fp32_ops or lists.FP32_OPS,
+                     conditional_fp32_ops or lists.CONDITIONAL_FP32_OPS,
+                     target_dtype)
+    block._amp_cfg = cfg
+    # ensure the dispatch wrapper is installed without clobbering a global
+    # amp.init() policy (the block-scoped cfg rides the TLS stack instead);
+    # block.cast() above already dropped any CachedOp, so the next call
+    # re-traces with the policy active
+    from ..ops import registry as reg
+    _install_dispatch_hook(getattr(reg, "_amp_config", None) or cfg)
     return block

@@ -233,7 +233,15 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args, **kwargs)
+        amp_cfg = getattr(self, "_amp_cfg", None)
+        if amp_cfg is not None:  # amp.convert_hybrid_block cast policy (eager)
+            from ..amp import _push_cfg, _pop_cfg
+            _push_cfg(amp_cfg)
+        try:
+            out = self.forward(*args, **kwargs)
+        finally:
+            if amp_cfg is not None:
+                _pop_cfg()
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
@@ -305,13 +313,21 @@ def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
     param_map = {id(p): _trace_nd(d) for p, d in zip(param_list, param_datas)}
     inputs = [d if isinstance(d, NDArray) else _trace_nd(d) for d in input_datas]
     tctx = _TraceContext(param_map, key)
-    with tracing.activate(tctx):
-        _rng.push_key_source(tctx.take_key)
-        try:
-            with autograd._RecordingStateScope(False, training):
-                out = block._eager_forward(*inputs)
-        finally:
-            _rng.pop_key_source()
+    amp_cfg = getattr(block, "_amp_cfg", None)
+    if amp_cfg is not None:  # amp.convert_hybrid_block: casts bake into the trace
+        from ..amp import _push_cfg, _pop_cfg
+        _push_cfg(amp_cfg)
+    try:
+        with tracing.activate(tctx):
+            _rng.push_key_source(tctx.take_key)
+            try:
+                with autograd._RecordingStateScope(False, training):
+                    out = block._eager_forward(*inputs)
+            finally:
+                _rng.pop_key_source()
+    finally:
+        if amp_cfg is not None:
+            _pop_cfg()
     outs = out if isinstance(out, (list, tuple)) else (out,)
     out_datas = tuple(o.data if isinstance(o, NDArray) else o for o in outs)
     return out_datas, tuple(tctx.aux_updates.values()), tuple(tctx.aux_updates)

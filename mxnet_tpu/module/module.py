@@ -45,13 +45,19 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        if monitor is not None:
+            self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             train_data.reset()
             for nbatch, data_batch in enumerate(train_data):
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, data_batch.label)
                 if batch_end_callback is not None:
                     from ..callback import BatchEndParam
@@ -99,6 +105,13 @@ class BaseModule:
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def install_monitor(self, mon):
+        """Install a mx.monitor.Monitor on the bound executor
+        (base_module.py install_monitor)."""
+        if getattr(self, "_exec", None) is None:
+            raise MXNetError("install_monitor requires a bound module")
+        mon.install(self._exec)
 
     # abstract
     def bind(self, *a, **k):

@@ -1,0 +1,108 @@
+"""Monitor outputs, weights, and gradients for debugging (parity:
+python/mxnet/monitor.py:32 Monitor — interval/stat_func/pattern/sort/
+monitor_all surface, install → tic → forward → toc(_print) workflow).
+
+TPU-native: the reference registers a ctypes callback the C++ executor fires
+per op; here the graph Executor calls the monitor callback as it walks the
+symbol DAG (symbol/executor.py:_eval_graph), with the same name convention
+(``<node>_output``, plus ``<node>_input<i>`` under ``monitor_all``). Stats
+stay lazy jax values until ``toc`` syncs them, mirroring the reference's
+async stat computation.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Monitor inputs, outputs, weights and gradients of bound executors.
+
+    Parameters
+    ----------
+    interval : int
+        Number of batches between collections.
+    stat_func : callable(NDArray) -> NDArray, optional
+        Statistic; default mean absolute value ``norm(x)/sqrt(size)``.
+    pattern : str
+        Regex selecting tensor names to monitor.
+    sort : bool
+        Sort results by name in ``toc``.
+    monitor_all : bool
+        Also monitor op inputs, not just outputs.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        if stat_func is None:
+            def asum_stat(x):
+                from . import ndarray as nd_mod
+                return nd_mod.norm(x) / sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            from . import autograd
+            with autograd.pause():  # stats must not land on the gradient tape
+                self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the callback into an Executor (symbol.bind result)."""
+        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for the current batch; call before forward."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish collecting; returns list of (step, name, value-string)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_auxiliary_states(),
+                                   exe.aux_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = ""
+            for v in v_list:
+                s += (str(v.asscalar()) if v.size == 1 else str(v.asnumpy())) \
+                    + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Finish collecting and log the results."""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

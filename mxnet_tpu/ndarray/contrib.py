@@ -130,6 +130,25 @@ def cond(pred, then_func: Callable, else_func: Callable, inputs=None):
     return NDArray(out)
 
 
+def boolean_mask(data, index, axis=0):
+    """contrib.boolean_mask (src/operator/contrib/boolean_mask.cc).
+
+    The output shape depends on the mask *values*, so it cannot live inside a
+    compiled TPU program (XLA requires static shapes) — like the reference's
+    CPU-only implementation this op is imperative-only. The mask syncs to host
+    to compute the kept indices; the gather itself (and its gradient, a
+    scatter-add) runs on device through the regular ``take`` op. Inside
+    ``hybridize``/jit use ``boolean_mask_dense`` (same semantics, masked rows
+    zeroed in place, shape-static)."""
+    import numpy as onp
+    from ..ops.registry import apply_op
+    from .ndarray import NDArray, array
+    mask = index.asnumpy() if isinstance(index, NDArray) else onp.asarray(index)
+    idx = onp.nonzero(mask.reshape(-1) != 0)[0].astype("int32")
+    idx_nd = array(idx, ctx=data.context)
+    return apply_op("take", data, idx_nd, axis=axis)
+
+
 def _install_aliases():
     """Expose _contrib_* registered ops under nd.contrib without the prefix."""
     from ..ops import registry as _registry

@@ -188,6 +188,16 @@ def _make_np_wrapper(name):
     return wrapper
 
 
+def einsum(subscripts, *operands, **kwargs):
+    """Equation-first einsum (numpy/np_einsum_op.cc) over the registry op so
+    autograd records it and the contraction lowers to MXU dot_generals."""
+    if kwargs:
+        raise MXNetError(f"np.einsum: unsupported keyword arguments "
+                         f"{sorted(kwargs)} (out/dtype/casting not supported)")
+    ops_nd = [o if isinstance(o, NDArray) else NDArray(o) for o in operands]
+    return _apply_op("einsum", *ops_nd, subscripts=subscripts)
+
+
 import warnings as _warnings
 
 for _name in _NP_FUNCS:

@@ -404,6 +404,13 @@ def arange_like(x, *, start=0.0, step=1.0, repeat=1, axis=None):
 # ---------------------------------------------------------------------------
 # linalg (reference: tensor/dot-inl.h, la_op.cc via LAPACK → XLA linalg)
 # ---------------------------------------------------------------------------
+@register("einsum", jit=True)
+def einsum(*operands, subscripts):
+    """einsum (numpy/np_einsum_op.cc): contraction by equation; lowers to XLA
+    dot_general chains so multi-operand contractions ride the MXU."""
+    return jnp.einsum(subscripts, *operands)
+
+
 @register("dot", jit=True)
 def dot(a, b, *, transpose_a=False, transpose_b=False):
     """dot (tensor/dot-inl.h): 2-D matmul contract last/first axes; MXU-native."""

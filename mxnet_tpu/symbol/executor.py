@@ -181,6 +181,15 @@ class Executor:
         self._aux_names = aux_names
         self.outputs: List[NDArray] = []
         self._out_heads = None
+        self._monitor_callback = None
+        self._monitor_all = False
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Fire ``callback(name, NDArray)`` for every op output (and input,
+        when monitor_all) during graph evaluation (MXExecutorSetMonitorCallbackEX
+        analog; consumed by mx.monitor.Monitor)."""
+        self._monitor_callback = callback
+        self._monitor_all = monitor_all
 
     # -- factory used by Symbol.simple_bind ---------------------------------
     @staticmethod
@@ -222,6 +231,14 @@ class Executor:
             outs = tuple(out) if isinstance(out, (list, tuple)) else (out,)
             n.num_outputs = len(outs)
             values[id(n)] = outs
+            if self._monitor_callback is not None:
+                if self._monitor_all:
+                    for i, a in enumerate(ins):
+                        if a is not None:
+                            self._monitor_callback(f"{n.name}_input{i}", a)
+                for i, o in enumerate(outs):
+                    suffix = "_output" if len(outs) == 1 else f"_output{i}"
+                    self._monitor_callback(f"{n.name}{suffix}", o)
         return [values[id(s._node)][s._index] for s in self._symbol._outputs()]
 
     def forward(self, is_train=False, **kwargs):

@@ -1,0 +1,74 @@
+"""AMP tests (parity patterns: tests/python/unittest/test_amp.py — list
+consistency, convert_hybrid_block dtype behavior, conditional fp32)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, nd
+
+
+def _small_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_convert_hybrid_block_casts_params_not_norm():
+    net = _small_net()
+    x = nd.array(onp.random.RandomState(0).rand(4, 16).astype("float32"))
+    y0 = net(x).asnumpy()
+    net = amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    assert str(net[0].weight.data().dtype) == "bfloat16"
+    assert str(net[1].gamma.data().dtype) == "float32"  # norm stats pinned fp32
+    y1 = net(x)
+    assert str(y1.dtype) == "bfloat16"  # FullyConnected in TARGET_DTYPE_OPS
+    onp.testing.assert_allclose(y1.asnumpy().astype("float32"), y0,
+                                rtol=0.1, atol=0.1)
+
+
+def test_convert_hybrid_block_hybridized_parity():
+    net = _small_net()
+    x = nd.array(onp.random.RandomState(1).rand(4, 16).astype("float32"))
+    net = amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    y_eager = net(x).asnumpy().astype("float32")
+    net.hybridize()
+    y_jit = net(x).asnumpy().astype("float32")
+    onp.testing.assert_allclose(y_jit, y_eager, rtol=2e-2, atol=2e-2)
+
+
+def test_conditional_fp32_ops():
+    class CondNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Activation(x, act_type="softrelu")
+
+    cnet = amp.convert_hybrid_block(CondNet(), "bfloat16")
+    xb = nd.array(onp.random.RandomState(2).rand(4, 4).astype("bfloat16"))
+    # softrelu is in CONDITIONAL_FP32_OPS: runs fp32 despite bf16 input
+    assert str(cnet(xb).dtype) == "float32"
+
+    class ReluNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.Activation(x, act_type="relu")
+
+    rnet = amp.convert_hybrid_block(ReluNet(), "bfloat16")
+    # relu is not conditional: dtype passes through
+    assert str(rnet(xb).dtype) == "bfloat16"
+
+
+def test_fp32_ops_upcast_inside_converted_block():
+    class SumNet(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.sum(x)
+
+    net = amp.convert_hybrid_block(SumNet(), "bfloat16")
+    xb = nd.array(onp.random.RandomState(3).rand(64, 64).astype("bfloat16"))
+    out = net(xb)
+    assert str(out.dtype) == "float32"  # sum is in FP32_OPS
+
+
+def test_amp_lists_disjoint():
+    low = set(amp.lists.TARGET_DTYPE_OPS)
+    high = set(amp.lists.FP32_OPS)
+    assert not (low & high)

@@ -1,0 +1,65 @@
+"""Monitor tests (parity pattern: tests/python/unittest/test_monitor.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bound_exe():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=4)
+    out = mx.sym.Activation(fc, name="act1", act_type="relu")
+    exe = out.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict["data"][:] = nd.array(onp.ones((2, 3), "float32"))
+    return exe
+
+
+def test_monitor_collects_outputs_and_args():
+    mon = mx.Monitor(interval=1)
+    exe = _bound_exe()
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert any(n.endswith("_output") for n in names), names
+    assert "data" in names  # arguments collected too
+    assert all(isinstance(v, str) and v.strip() for _, _, v in res)
+
+
+def test_monitor_pattern_and_interval():
+    mon = mx.Monitor(interval=2, pattern=".*output")
+    exe = _bound_exe()
+    mon.install(exe)
+    mon.tic()            # step 0: active
+    exe.forward()
+    res = mon.toc()
+    assert res and all(k.endswith("_output") for _, k, _ in res)
+    mon.tic()            # step 1: inactive (interval 2)
+    exe.forward()
+    assert mon.toc() == []
+
+
+def test_monitor_monitor_all_inputs():
+    mon = mx.Monitor(interval=1, monitor_all=True, pattern=".*input.*")
+    exe = _bound_exe()
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    assert any("_input" in k for _, k, _ in res), res
+
+
+def test_opperf_harness():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "opperf", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "benchmark", "opperf.py"))
+    opperf = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(opperf)
+    res = opperf.run_performance_test(["exp", "dot"], warmup=1, runs=2)
+    assert {r["operator"] for r in res} == {"exp", "dot"}
+    for r in res:
+        assert r["avg_time_forward_us"] > 0
+        assert "avg_time_backward_us" in r

@@ -1,0 +1,81 @@
+"""Sequence ops / boolean_mask / einsum coverage (parity patterns:
+tests/python/unittest/test_operator.py test_sequence_mask/test_sequence_last/
+test_sequence_reverse, test_contrib_boolean_mask, test_np_einsum)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+import mxnet_tpu.numpy as np
+
+
+def test_einsum_nd_and_np():
+    rng = onp.random.RandomState(0)
+    a = nd.array(rng.rand(3, 4).astype("float32"))
+    b = nd.array(rng.rand(4, 5).astype("float32"))
+    want = a.asnumpy() @ b.asnumpy()
+    onp.testing.assert_allclose(
+        nd.einsum(a, b, subscripts="ij,jk->ik").asnumpy(), want, rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,jk->ik", a, b).asnumpy(), want, rtol=1e-5)
+
+
+def test_einsum_grad():
+    rng = onp.random.RandomState(1)
+    a = nd.array(rng.rand(2, 3).astype("float32"))
+    b = nd.array(rng.rand(3, 4).astype("float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = np.einsum("ij,jk->ik", a, b)
+        out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                onp.ones((2, 4)) @ b.asnumpy().T, rtol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(),
+                                a.asnumpy().T @ onp.ones((2, 4)), rtol=1e-5)
+
+
+def test_boolean_mask_forward_backward():
+    d = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    m = nd.array(onp.array([1, 0, 1, 0], "float32"))
+    d.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.boolean_mask(d, m)
+        out.backward()
+    assert out.shape == (2, 3)
+    onp.testing.assert_allclose(out.asnumpy(), d.asnumpy()[[0, 2]])
+    expg = onp.zeros((4, 3), "float32")
+    expg[[0, 2]] = 1
+    onp.testing.assert_allclose(d.grad.asnumpy(), expg)
+
+
+def test_sequence_last():
+    rng = onp.random.RandomState(2)
+    x = rng.rand(5, 3, 2).astype("float32")  # (seq, batch, feat)
+    sl = onp.array([2, 5, 1], "float32")
+    out = nd.SequenceLast(nd.array(x), nd.array(sl), use_sequence_length=True)
+    want = onp.stack([x[1, 0], x[4, 1], x[0, 2]])
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    # without lengths: plain last step
+    out2 = nd.SequenceLast(nd.array(x))
+    onp.testing.assert_allclose(out2.asnumpy(), x[-1], rtol=1e-6)
+
+
+def test_sequence_reverse():
+    rng = onp.random.RandomState(3)
+    x = rng.rand(4, 2, 3).astype("float32")
+    sl = onp.array([2, 4], "float32")
+    out = nd.SequenceReverse(nd.array(x), nd.array(sl), use_sequence_length=True)
+    want = x.copy()
+    want[:2, 0] = x[:2, 0][::-1]
+    want[:4, 1] = x[:4, 1][::-1]
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_sequence_mask_axis1():
+    x = onp.ones((2, 5, 3), "float32")  # (batch, seq, feat)
+    sl = onp.array([3, 1], "float32")
+    out = nd.SequenceMask(nd.array(x), nd.array(sl), use_sequence_length=True,
+                          value=-1.0, axis=1)
+    o = out.asnumpy()
+    assert (o[0, :3] == 1).all() and (o[0, 3:] == -1).all()
+    assert (o[1, :1] == 1).all() and (o[1, 1:] == -1).all()
