@@ -41,7 +41,7 @@ def _time_steps(step, args, steps, warmup, reps=3,
     glitchy runtime sync can't yield a fake-fast window; the median rejects a
     remaining outlier window."""
     import statistics
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # ≥1: `out` must exist for the fetch
         out = step(*args)
     fetch(out)
     times = []
@@ -56,8 +56,9 @@ def _time_steps(step, args, steps, warmup, reps=3,
 
 def bench_resnet():
     batch = int(os.environ.get("BENCH_BATCH", 32))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
-    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 20))
+    calls = int(os.environ.get("BENCH_CALLS", 2))
+    warmup = int(os.environ.get("BENCH_WARMUP", 1))
     model = os.environ.get("BENCH_MODEL", "resnet50_v1")
 
     import mxnet_tpu as mx
@@ -75,21 +76,26 @@ def bench_resnet():
         mx.optimizer.SGD(learning_rate=0.05, momentum=0.9), mesh,
         compute_dtype="bfloat16")
 
-    rng = onp.random.RandomState(0)
-    xn, yn = step.place_batch(rng.rand(batch, 3, 224, 224).astype("float32"),
-                              rng.randint(0, 1000, batch).astype("float32"))
+    # k distinct microbatches trained per dispatch (device-side scan loop);
+    # every step's forward+backward+update executes — the (k,) losses prove it
+    rng = onp.random.default_rng(0)
+    fetch = lambda out: float(out.asnumpy()[-1])
 
-    dt = _time_steps(step, (xn, yn), steps, warmup)
-    img_s = batch * steps / dt
+    def run(b):
+        # float32 generation: a float64 intermediate at (k,b,3,224,224) would
+        # be ~3 GB of host RAM for nothing
+        placed = step.place_batch_n(
+            rng.random((k, b, 3, 224, 224), dtype="float32").astype("bfloat16"),
+            rng.integers(0, 1000, (k, b)).astype("float32"))
+        dt = _time_steps(step.step_n, placed, calls, warmup, fetch=fetch)
+        return b * k * calls / dt
+
+    img_s = run(batch)
     _emit("resnet50_train_img_s_per_chip", img_s, "img/s",
           img_s / BASELINE_RESNET_IMG_S)
 
     # batch-128 training row (perf.md:254 config)
-    b128 = 128
-    xn, yn = step.place_batch(rng.rand(b128, 3, 224, 224).astype("float32"),
-                              rng.randint(0, 1000, b128).astype("float32"))
-    dt = _time_steps(step, (xn, yn), steps, warmup)
-    img_s = b128 * steps / dt
+    img_s = run(128)
     _emit("resnet50_train_b128_img_s_per_chip", img_s, "img/s",
           img_s / BASELINE_RESNET_B128_IMG_S)
 
@@ -136,8 +142,9 @@ def bench_resnet_inference():
 def bench_bert():
     batch = int(os.environ.get("BENCH_BERT_BATCH", 32))
     seq = int(os.environ.get("BENCH_BERT_SEQ", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
-    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 20))
+    calls = int(os.environ.get("BENCH_CALLS", 2))
+    warmup = int(os.environ.get("BENCH_WARMUP", 1))
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
@@ -156,15 +163,17 @@ def bench_bert():
         compute_dtype="bfloat16", extra_specs=(P("dp"),))
 
     rng = onp.random.RandomState(0)
-    toks = rng.randint(0, 30522, (batch, seq)).astype("int32")
-    tt = onp.zeros((batch, seq), "int32")
-    mlm_lab = onp.where(rng.rand(batch, seq) < 0.15,
-                        rng.randint(0, 30522, (batch, seq)), -1).astype("int32")
-    nsp_lab = rng.randint(0, 2, (batch,)).astype("int32")
-    placed = step.place_batch(toks, (mlm_lab, nsp_lab), tt)
+    toks = rng.randint(0, 30522, (k, batch, seq)).astype("int32")
+    tt = onp.zeros((k, batch, seq), "int32")
+    mlm_lab = onp.where(rng.rand(k, batch, seq) < 0.15,
+                        rng.randint(0, 30522, (k, batch, seq)),
+                        -1).astype("int32")
+    nsp_lab = rng.randint(0, 2, (k, batch)).astype("int32")
+    placed = step.place_batch_n(toks, (mlm_lab, nsp_lab), tt)
 
-    dt = _time_steps(step, placed, steps, warmup)
-    tok_s = batch * seq * steps / dt
+    dt = _time_steps(step.step_n, placed, calls, warmup,
+                     fetch=lambda out: float(out.asnumpy()[-1]))
+    tok_s = batch * seq * k * calls / dt
     _emit("bert_base_pretrain_tok_s_per_chip", tok_s, "tokens/s", None)
 
 

@@ -1,2 +1,3 @@
 """Contrib namespace (python/mxnet/contrib/): experimental / auxiliary APIs."""
 from . import quantization  # noqa: F401
+from . import onnx          # noqa: F401

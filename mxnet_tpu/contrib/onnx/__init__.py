@@ -1,0 +1,458 @@
+"""ONNX interchange (parity: python/mxnet/contrib/onnx/ — mx2onnx/export_model
+over _export_helper.py + _op_translations.py, onnx2mx/import_model over
+import_onnx.py; ~4.2k LoC collapsed to the TPU-relevant subset).
+
+Real ONNX protobuf wire format: the schema subset in ``onnx.proto`` uses the
+official field numbers, so exported models load in onnxruntime/netron and
+models produced elsewhere import here. Covered ops: Conv, Gemm/MatMul,
+BatchNormalization, Relu/Sigmoid/Tanh/Softplus/LeakyRelu, MaxPool/AveragePool
+(+Global), Flatten, Softmax, Add/Sub/Mul/Div, Concat, Reshape, Transpose,
+Dropout, Gather (Embedding).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ...base import MXNetError
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:  # protoc output imports absolutely
+    sys.path.insert(0, _HERE)
+from . import onnx_pb2 as _pb  # noqa: E402
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+_OPSET = 13
+_DT = {"float32": _pb.TensorProto.FLOAT, "float64": _pb.TensorProto.DOUBLE,
+       "float16": _pb.TensorProto.FLOAT16, "int32": _pb.TensorProto.INT32,
+       "int64": _pb.TensorProto.INT64, "int8": _pb.TensorProto.INT8,
+       "uint8": _pb.TensorProto.UINT8, "bool": _pb.TensorProto.BOOL,
+       "bfloat16": _pb.TensorProto.BFLOAT16}
+_DT_INV = {v: k for k, v in _DT.items()}
+
+
+def _np_to_tensorproto(name, arr):
+    t = _pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = _DT[str(arr.dtype)]
+    t.raw_data = onp.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _tensorproto_to_np(t):
+    dtype = onp.dtype(_DT_INV.get(t.data_type, "float32"))
+    if t.raw_data:
+        arr = onp.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = onp.asarray(list(t.float_data), dtype=dtype)
+    elif t.int64_data:
+        arr = onp.asarray(list(t.int64_data), dtype=dtype)
+    elif t.int32_data:
+        arr = onp.asarray(list(t.int32_data), dtype=dtype)
+    else:
+        arr = onp.zeros(0, dtype)
+    return arr.reshape(tuple(t.dims))
+
+
+def _attr(node, name, default=None):
+    for a in node.attribute:
+        if a.name == name:
+            if a.type == _pb.AttributeProto.INT:
+                return int(a.i)
+            if a.type == _pb.AttributeProto.FLOAT:
+                return float(a.f)
+            if a.type == _pb.AttributeProto.STRING:
+                return a.s.decode()
+            if a.type == _pb.AttributeProto.INTS:
+                return tuple(int(v) for v in a.ints)
+            if a.type == _pb.AttributeProto.FLOATS:
+                return tuple(float(v) for v in a.floats)
+            if a.type == _pb.AttributeProto.TENSOR:
+                return _tensorproto_to_np(a.t)
+    return default
+
+
+def _mk_attr(name, value):
+    a = _pb.AttributeProto()
+    a.name = name
+    if isinstance(value, bool):
+        a.type = _pb.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = _pb.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = _pb.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = _pb.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (tuple, list)):
+        if all(isinstance(v, int) for v in value):
+            a.type = _pb.AttributeProto.INTS
+            a.ints.extend(value)
+        else:
+            a.type = _pb.AttributeProto.FLOATS
+            a.floats.extend(float(v) for v in value)
+    else:
+        raise MXNetError(f"unsupported onnx attribute value {value!r}")
+    return a
+
+
+def _mk_node(op_type, inputs, outputs, name, **attrs):
+    n = _pb.NodeProto()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.name = name
+    for k, v in attrs.items():
+        if v is not None:
+            n.attribute.append(_mk_attr(k, v))
+    return n
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n if n == 2 else (0,) * n
+    v = tuple(int(x) for x in (v if isinstance(v, (tuple, list)) else (v,) * n))
+    return v
+
+
+# ---------------------------------------------------------------------------
+# export: Symbol graph -> ONNX (mx2onnx/_op_translations.py analog)
+# ---------------------------------------------------------------------------
+def _export_node(node, ins, extra_init):
+    """Translate one symbol node. Returns list of NodeProto; last one's first
+    output must be named ``node.name``."""
+    name = node.name
+    op = node.op
+    attrs = node.attrs or {}
+
+    if op == "Convolution":
+        k = _pair(attrs.get("kernel"))
+        pads = _pair(attrs.get("pad"), 2) if attrs.get("pad") else (0, 0)
+        return [_mk_node("Conv", ins, [name], name,
+                         kernel_shape=k,
+                         strides=_pair(attrs.get("stride")),
+                         dilations=_pair(attrs.get("dilate")),
+                         pads=tuple(pads) + tuple(pads),
+                         group=int(attrs.get("num_group", 1)))]
+    if op == "FullyConnected":
+        flat = name + "_flat"
+        # Gemm's C input is optional since opset 11, so no_bias maps directly
+        return [_mk_node("Flatten", [ins[0]], [flat], flat, axis=1),
+                _mk_node("Gemm", [flat] + list(ins[1:]), [name], name,
+                         alpha=1.0, beta=1.0, transB=1)]
+    if op == "Activation":
+        act = attrs.get("act_type", "relu")
+        m = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+        if act not in m:
+            raise MXNetError(f"onnx export: unsupported activation {act}")
+        return [_mk_node(m[act], ins, [name], name)]
+    if op == "LeakyReLU":
+        return [_mk_node("LeakyRelu", ins[:1], [name], name,
+                         alpha=float(attrs.get("slope", 0.25)))]
+    if op == "BatchNorm":
+        bn_ins = list(ins)
+        fix_gamma = attrs.get("fix_gamma", True)
+        if fix_gamma in (True, "True", "true", 1):
+            # mxnet semantics: gamma forced to ones; ONNX has no such flag,
+            # so bake a ones scale initializer (shape deferred to finalize)
+            ones_name = name + "_fixed_gamma"
+            extra_init.append(("__ones_like__", ones_name, ins[1]))
+            bn_ins[1] = ones_name
+        return [_mk_node("BatchNormalization", bn_ins, [name], name,
+                         epsilon=float(attrs.get("eps", 1e-5)),
+                         momentum=float(attrs.get("momentum", 0.9)))]
+    if op == "Pooling":
+        ptype = attrs.get("pool_type", "max")
+        glob = attrs.get("global_pool", False)
+        if glob:
+            return [_mk_node("GlobalMaxPool" if ptype == "max"
+                             else "GlobalAveragePool", ins, [name], name)]
+        k = _pair(attrs.get("kernel"))
+        pads = _pair(attrs.get("pad"), 2) if attrs.get("pad") else (0, 0)
+        onnx_attrs = dict(
+            kernel_shape=k, strides=_pair(attrs.get("stride")),
+            pads=tuple(pads) + tuple(pads),
+            ceil_mode=1 if attrs.get("pooling_convention") == "full" else 0)
+        if ptype != "max":
+            onnx_attrs["count_include_pad"] = \
+                1 if attrs.get("count_include_pad", True) else 0
+        return [_mk_node("MaxPool" if ptype == "max" else "AveragePool",
+                         ins, [name], name, **onnx_attrs)]
+    if op in ("Flatten", "flatten"):
+        return [_mk_node("Flatten", ins, [name], name, axis=1)]
+    if op in ("softmax", "Softmax"):
+        return [_mk_node("Softmax", ins, [name], name,
+                         axis=int(attrs.get("axis", -1)))]
+    if op in ("elemwise_add", "broadcast_add", "_plus", "_Plus"):
+        return [_mk_node("Add", ins, [name], name)]
+    if op in ("elemwise_sub", "broadcast_sub"):
+        return [_mk_node("Sub", ins, [name], name)]
+    if op in ("elemwise_mul", "broadcast_mul"):
+        return [_mk_node("Mul", ins, [name], name)]
+    if op in ("elemwise_div", "broadcast_div"):
+        return [_mk_node("Div", ins, [name], name)]
+    if op in ("concat", "Concat"):
+        return [_mk_node("Concat", ins, [name], name,
+                         axis=int(attrs.get("dim", 1)))]
+    if op == "Dropout":
+        return [_mk_node("Dropout", ins[:1], [name], name)]
+    if op in ("reshape", "Reshape"):
+        shape = tuple(int(v) for v in attrs.get("shape", ()))
+        sh_name = name + "_shape"
+        extra_init.append(_np_to_tensorproto(
+            sh_name, onp.asarray(shape, "int64")))
+        return [_mk_node("Reshape", [ins[0], sh_name], [name], name)]
+    if op in ("transpose",):
+        axes = attrs.get("axes")
+        return [_mk_node("Transpose", ins, [name], name,
+                         perm=tuple(int(a) for a in axes) if axes else None)]
+    if op == "Embedding":
+        # ONNX Gather(weight, indices); our Embedding(data, weight)
+        return [_mk_node("Gather", [ins[1], ins[0]], [name], name, axis=0)]
+    if op == "dot":
+        return [_mk_node("MatMul", ins, [name], name)]
+    raise MXNetError(f"onnx export: operator {op!r} not supported")
+
+
+def export_model(sym, params, input_shape=None, input_type="float32",
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export (Symbol, params) to an ONNX file (mx2onnx/export_model parity:
+    contrib/onnx/mx2onnx/export_model.py). ``params`` merges arg+aux NDArrays;
+    ``input_shape`` is a list of shapes for the data inputs."""
+    from ...ndarray.ndarray import NDArray
+
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    model = _pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "0.1"
+    op_set = model.opset_import.add()
+    op_set.domain = ""
+    op_set.version = _OPSET
+    g = model.graph
+    g.name = getattr(sym, "name", "mxnet_tpu_graph") or "graph"
+
+    topo = sym._topo()
+    data_inputs = [n for n in topo if n.is_var and n.name not in params]
+    in_shapes = list(input_shape or [])
+    for i, n in enumerate(data_inputs):
+        vi = g.input.add()
+        vi.name = n.name
+        vi.type.tensor_type.elem_type = _DT[input_type]
+        if i < len(in_shapes) and in_shapes[i] is not None:
+            for d in in_shapes[i]:
+                vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+    for pname, arr in params.items():
+        a = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
+        g.initializer.append(_np_to_tensorproto(pname, a))
+        vi = g.input.add()
+        vi.name = pname
+        vi.type.tensor_type.elem_type = _DT.get(str(a.dtype),
+                                                _pb.TensorProto.FLOAT)
+        for d in a.shape:
+            vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+
+    extra_init: List = []
+    for node in topo:
+        if node.is_var:
+            continue
+        ins = []
+        for slot in node.inputs:
+            if slot is None:
+                continue
+            src, idx = slot
+            ins.append(src.name if src.num_outputs == 1 or src.is_var
+                       else f"{src.name}_output{idx}")
+        for nd_proto in _export_node(node, ins, extra_init):
+            g.node.append(nd_proto)
+    for item in extra_init:
+        if isinstance(item, tuple) and item[0] == "__ones_like__":
+            _, ones_name, ref_name = item
+            ref = params[ref_name]
+            ref = ref.asnumpy() if isinstance(ref, NDArray) else onp.asarray(ref)
+            g.initializer.append(_np_to_tensorproto(ones_name,
+                                                    onp.ones_like(ref)))
+        else:
+            g.initializer.append(item)
+
+    for out_name in sym.list_outputs():
+        base = out_name[:-len("_output")] if out_name.endswith("_output") \
+            else out_name
+        vi = g.output.add()
+        vi.name = base
+        vi.type.tensor_type.elem_type = _DT[input_type]
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print(f"exported {len(g.node)} nodes -> {onnx_file_path}")
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> (Symbol, arg_params, aux_params)  (onnx2mx/import_onnx.py)
+# ---------------------------------------------------------------------------
+def _import_node(node, sym_mod, tensors, inits):
+    ins = [tensors[i] for i in node.input if i in tensors]
+    op = node.op_type
+    name = node.name or (node.output[0] + "_op")
+
+    if op == "Conv":
+        k = _attr(node, "kernel_shape")
+        pads = _attr(node, "pads", (0, 0, 0, 0))
+        half = len(pads) // 2
+        if tuple(pads[:half]) != tuple(pads[half:]):
+            raise MXNetError(f"onnx import: asymmetric Conv pads {pads} not "
+                             "supported (symmetric begin/end only)")
+        out = sym_mod.Convolution(
+            *ins, kernel=tuple(k), num_filter=int(inits[node.input[1]].shape[0]),
+            stride=tuple(_attr(node, "strides", (1, 1))),
+            dilate=tuple(_attr(node, "dilations", (1, 1))),
+            pad=tuple(pads[:half]), num_group=int(_attr(node, "group", 1)),
+            no_bias=len(ins) == 2, name=name)
+    elif op == "Gemm":
+        if (_attr(node, "transA", 0), _attr(node, "transB", 0)) != (0, 1) or \
+                _attr(node, "alpha", 1.0) != 1.0 or \
+                _attr(node, "beta", 1.0) != 1.0:
+            raise MXNetError(
+                "onnx import: Gemm with transA/transB/alpha/beta other than "
+                "(0,1,1,1) not supported (would be silently wrong numerics)")
+        w = inits[node.input[1]]
+        out = sym_mod.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                                     no_bias=len(ins) == 2, name=name)
+    elif op == "MatMul":
+        out = sym_mod.dot(*ins, name=name)
+    elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign"):
+        act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+               "Softplus": "softrelu", "Softsign": "softsign"}[op]
+        out = sym_mod.Activation(*ins, act_type=act, name=name)
+    elif op == "LeakyRelu":
+        out = sym_mod.LeakyReLU(*ins, slope=_attr(node, "alpha", 0.01),
+                                name=name)
+    elif op == "BatchNormalization":
+        # ONNX always applies the stored scale: disable mxnet's fix_gamma
+        out = sym_mod.BatchNorm(*ins, eps=_attr(node, "epsilon", 1e-5),
+                                momentum=_attr(node, "momentum", 0.9),
+                                fix_gamma=False, name=name)
+    elif op in ("MaxPool", "AveragePool"):
+        pads = _attr(node, "pads", (0, 0, 0, 0))
+        half = len(pads) // 2
+        if tuple(pads[:half]) != tuple(pads[half:]):
+            raise MXNetError(f"onnx import: asymmetric pool pads {pads} not "
+                             "supported (symmetric begin/end only)")
+        pool_kwargs = {}
+        if op == "AveragePool":
+            pool_kwargs["count_include_pad"] = \
+                bool(_attr(node, "count_include_pad", 0))
+        out = sym_mod.Pooling(
+            *ins, kernel=tuple(_attr(node, "kernel_shape")),
+            pool_type="max" if op == "MaxPool" else "avg",
+            stride=tuple(_attr(node, "strides", (1, 1))),
+            pad=tuple(pads[:half]),
+            pooling_convention="full" if _attr(node, "ceil_mode", 0)
+            else "valid", name=name, **pool_kwargs)
+    elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+        out = sym_mod.Pooling(*ins, kernel=(1, 1), global_pool=True,
+                              pool_type="max" if op == "GlobalMaxPool"
+                              else "avg", name=name)
+    elif op == "Flatten":
+        out = sym_mod.Flatten(*ins, name=name)
+    elif op == "Softmax":
+        out = sym_mod.softmax(*ins, axis=_attr(node, "axis", -1), name=name)
+    elif op in ("Add", "Sub", "Mul", "Div"):
+        fn = {"Add": sym_mod.broadcast_add, "Sub": sym_mod.broadcast_sub,
+              "Mul": sym_mod.broadcast_mul, "Div": sym_mod.broadcast_div}[op]
+        out = fn(*ins, name=name)
+    elif op == "Concat":
+        out = sym_mod.concat(*ins, dim=_attr(node, "axis", 1), name=name)
+    elif op == "Dropout":
+        out = sym_mod.Dropout(ins[0], name=name)
+    elif op == "Reshape":
+        shape = inits.get(node.input[1])
+        if shape is None:
+            raise MXNetError("onnx import: dynamic Reshape shape unsupported")
+        out = sym_mod.reshape(ins[0], shape=tuple(int(v) for v in shape),
+                              name=name)
+    elif op == "Transpose":
+        out = sym_mod.transpose(*ins, axes=_attr(node, "perm"), name=name)
+    elif op == "Gather":
+        # Gather(weight, indices) -> Embedding(indices, weight)
+        w = inits[node.input[0]]
+        out = sym_mod.Embedding(tensors[node.input[1]], tensors[node.input[0]],
+                                input_dim=int(w.shape[0]),
+                                output_dim=int(w.shape[1]), name=name)
+    else:
+        raise MXNetError(f"onnx import: operator {op!r} not supported")
+    tensors[node.output[0]] = out
+    return out
+
+
+def import_model(model_file):
+    """Load an ONNX file -> (sym, arg_params, aux_params)
+    (onnx2mx/import_model.py parity)."""
+    from ... import symbol as sym_mod
+    from ... import nd
+
+    model = _pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    inits = {t.name: _tensorproto_to_np(t) for t in g.initializer}
+    tensors: Dict[str, object] = {}
+    for vi in g.input:
+        if vi.name not in inits:
+            tensors[vi.name] = sym_mod.Variable(vi.name)
+    for name in inits:
+        tensors[name] = sym_mod.Variable(name)
+
+    out = None
+    for node in g.node:
+        # skip shape/weight-transform helper nodes that feed initializers only
+        out = _import_node(node, sym_mod, tensors, inits)
+    outputs = [tensors[o.name] for o in g.output if o.name in tensors]
+    final = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs) \
+        if outputs else out
+
+    aux_names = set()
+    for node in g.node:  # BatchNorm running stats are aux in mxnet terms
+        if node.op_type == "BatchNormalization" and len(node.input) >= 5:
+            aux_names.update(node.input[3:5])
+    # only initializers the final graph actually consumes as variables
+    # (shape helpers etc. were folded into attrs)
+    reachable = set(final.list_arguments()) | \
+        set(final.list_auxiliary_states())
+    arg_params = {k: nd.array(v) for k, v in inits.items()
+                  if k in reachable and k not in aux_names}
+    aux_params = {k: nd.array(v) for k, v in inits.items()
+                  if k in reachable and k in aux_names}
+    return final, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output names+shapes of an ONNX file (parity helper)."""
+    model = _pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def info(vs):
+        out = []
+        for vi in vs:
+            if vi.name in inits:
+                continue
+            shape = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, shape))
+        return out
+    return {"input_tensor_data": info(g.input),
+            "output_tensor_data": info(g.output)}

@@ -4,13 +4,15 @@ squeezenet, inception-v3, mobilenet v1/v2)."""
 from .resnet import *   # noqa: F401,F403
 from .simple_nets import *  # noqa: F401,F403
 from .dense_nets import *   # noqa: F401,F403
+from .ssd import *          # noqa: F401,F403
 from .resnet import __all__ as _resnet_all
 from .simple_nets import __all__ as _simple_all
 from .dense_nets import __all__ as _dense_all
+from .ssd import __all__ as _ssd_all
 from ....base import MXNetError
 
 _models = {}
-for _name in _resnet_all + _simple_all + _dense_all:
+for _name in _resnet_all + _simple_all + _dense_all + _ssd_all:
     _obj = globals()[_name]
     if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
         _models[_name] = _obj

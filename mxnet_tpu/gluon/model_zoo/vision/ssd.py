@@ -1,0 +1,251 @@
+"""SSD-300 single-shot detector (parity: example/ssd/ — symbol/symbol_builder.py
+get_symbol_train/get_symbol over symbol/vgg16_reduced.py, train/train_net.py
+multibox pipeline; BASELINE config 4).
+
+TPU-native assembly: the whole detector — VGG16-reduced backbone, multi-scale
+heads, anchor generation (MultiBoxPrior), target encoding (MultiBoxTarget) and
+decode+NMS (MultiBoxDetection) — is jit-friendly with static shapes (8732
+anchors for 300x300), so train steps fuse into one XLA computation and NMS
+runs on-device (ops/contrib.py box_nms) instead of the reference's CPU/CUDA
+kernels.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...block import HybridBlock
+from ...loss import Loss
+from ....initializer import Constant
+
+__all__ = ["SSD", "ssd_300_vgg16", "SSDMultiBoxLoss", "MApMetric"]
+
+# per-scale anchor config (example/ssd/symbol/symbol_factory.py get_config('vgg16_reduced', 300))
+_SIZES = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+          (0.71, 0.79), (0.88, 0.961)]
+_RATIOS = [(1.0, 2.0, 0.5), (1.0, 2.0, 0.5, 3.0, 1.0 / 3),
+           (1.0, 2.0, 0.5, 3.0, 1.0 / 3), (1.0, 2.0, 0.5, 3.0, 1.0 / 3),
+           (1.0, 2.0, 0.5), (1.0, 2.0, 0.5)]
+
+
+def _vgg_block(out, n, channels, pool=True, pool_stride=2):
+    for i in range(n):
+        out.add(nn.Conv2D(channels, 3, padding=1, activation="relu"))
+    if pool:
+        out.add(nn.MaxPool2D(2, strides=pool_stride, ceil_mode=True))
+    return out
+
+
+class _VGG16Reduced(HybridBlock):
+    """VGG16 with fc6/fc7 as dilated convs (symbol/vgg16_reduced.py)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stage1 = nn.HybridSequential()          # -> conv4_3 (38x38)
+            _vgg_block(self.stage1, 2, 64)
+            _vgg_block(self.stage1, 2, 128)
+            _vgg_block(self.stage1, 3, 256)
+            for _ in range(3):
+                self.stage1.add(nn.Conv2D(512, 3, padding=1, activation="relu"))
+            self.stage2 = nn.HybridSequential()          # -> conv7 (19x19)
+            self.stage2.add(nn.MaxPool2D(2, strides=2, ceil_mode=True))
+            _vgg_block(self.stage2, 3, 512, pool=False)
+            self.stage2.add(nn.MaxPool2D(3, strides=1, padding=1))
+            self.stage2.add(nn.Conv2D(1024, 3, padding=6, dilation=6,
+                                      activation="relu"))   # fc6
+            self.stage2.add(nn.Conv2D(1024, 1, activation="relu"))  # fc7
+
+    def hybrid_forward(self, F, x):
+        c4 = self.stage1(x)
+        c7 = self.stage2(c4)
+        return c4, c7
+
+
+class _ExtraLayer(HybridBlock):
+    def __init__(self, mid, out, stride, padding, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(mid, 1, activation="relu"),
+                          nn.Conv2D(out, 3, strides=stride, padding=padding,
+                                    activation="relu"))
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class SSD(HybridBlock):
+    """SSD detector head over multi-scale features.
+
+    forward(x) -> (anchors (1, N, 4), cls_preds (B, num_classes+1, N),
+    loc_preds (B, N*4)) — the triple MultiBoxTarget/MultiBoxDetection consume.
+    N = 8732 for 300x300 input.
+    """
+
+    def __init__(self, num_classes=20, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        with self.name_scope():
+            self.backbone = _VGG16Reduced()
+            self.extras = nn.HybridSequential()
+            self.extras.add(_ExtraLayer(256, 512, 2, 1),   # 10x10
+                            _ExtraLayer(128, 256, 2, 1),   # 5x5
+                            _ExtraLayer(128, 256, 1, 0),   # 3x3
+                            _ExtraLayer(128, 256, 1, 0))   # 1x1
+            self.cls_heads = nn.HybridSequential()
+            self.loc_heads = nn.HybridSequential()
+            for sizes, ratios in zip(_SIZES, _RATIOS):
+                na = len(sizes) + len(ratios) - 1
+                self.cls_heads.add(nn.Conv2D(na * (num_classes + 1), 3,
+                                             padding=1))
+                self.loc_heads.add(nn.Conv2D(na * 4, 3, padding=1))
+            # conv4_3 feature scale (symbol_builder.py L2Normalization scale=20)
+            self.conv4_3_scale = self.params.get(
+                "conv4_3_scale", shape=(1, 512, 1, 1), init=Constant(20.0))
+
+    def hybrid_forward(self, F, x, conv4_3_scale):
+        c4, c7 = self.backbone(x)
+        c4 = F.L2Normalization(c4, mode="channel") * conv4_3_scale
+        feats = [c4, c7]
+        f = c7
+        for blk in self.extras:
+            f = blk(f)
+            feats.append(f)
+        anchors, cls_preds, loc_preds = [], [], []
+        for i, (f, (sizes, ratios)) in enumerate(zip(feats,
+                                                     zip(_SIZES, _RATIOS))):
+            anchors.append(F.contrib.MultiBoxPrior(f, sizes=sizes,
+                                                   ratios=ratios, clip=False))
+            c = self.cls_heads[i](f)
+            l = self.loc_heads[i](f)
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1)
+            c = F.reshape(F.transpose(c, axes=(0, 2, 3, 1)),
+                          shape=(0, -1, self.num_classes + 1))
+            l = F.reshape(F.transpose(l, axes=(0, 2, 3, 1)), shape=(0, -1))
+            cls_preds.append(c)
+            loc_preds.append(l)
+        anchors = F.concat(*anchors, dim=1)
+        cls_preds = F.transpose(F.concat(*cls_preds, dim=1), axes=(0, 2, 1))
+        loc_preds = F.concat(*loc_preds, dim=1)
+        return anchors, cls_preds, loc_preds
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=400):
+        """Forward + decode + NMS -> (B, N, 6) [cls, score, x1, y1, x2, y2]."""
+        from .... import ndarray as nd_mod
+        anchors, cls_preds, loc_preds = self(x)
+        cls_prob = nd_mod.softmax(cls_preds, axis=1)
+        return nd_mod.contrib.MultiBoxDetection(
+            cls_prob, loc_preds, anchors, threshold=threshold,
+            nms_threshold=nms_threshold, nms_topk=nms_topk)
+
+
+class SSDMultiBoxLoss(Loss):
+    """Joint cls (CE with hard-negative mining 3:1) + loc (SmoothL1) loss
+    (example/ssd train pipeline: MultiBoxTarget + softmax/smooth_l1)."""
+
+    def __init__(self, negative_mining_ratio=3.0, lambd=1.0, **kwargs):
+        super().__init__(None, 0, **kwargs)
+        self._ratio = negative_mining_ratio
+        self._lambd = lambd
+
+    def hybrid_forward(self, F, anchors, cls_preds, loc_preds, label):
+        box_t, box_m, cls_t = F.contrib.MultiBoxTarget(anchors, label,
+                                                       cls_preds)
+        # classification: log softmax over classes axis (B, C+1, N)
+        logp = F.log_softmax(cls_preds, axis=1)
+        cls_t_i = cls_t.astype("int32")
+        pos = cls_t > 0
+        p_target = F.pick(logp, cls_t_i, axis=1)
+        ce = -p_target                                   # (B, N)
+        # hard negative mining: top (ratio * n_pos) negatives by loss
+        posf = pos.astype("float32")
+        neg_loss = F.where(pos, F.zeros_like(ce), ce)
+        n_pos = F.sum(posf, axis=1)                      # (B,)
+        rank = F.argsort(F.argsort(neg_loss, axis=1, is_ascend=False), axis=1,
+                         is_ascend=True)
+        n_neg = F.minimum(n_pos * self._ratio + 1,
+                          F.ones_like(n_pos) * ce.shape[1])
+        negf = (rank < F.reshape(n_neg, shape=(-1, 1))).astype("float32")
+        keep = F.maximum(posf, negf)
+        cls_loss = F.sum(ce * keep, axis=1)
+        # localization smooth-l1 on matched anchors
+        diff = (loc_preds - box_t) * box_m
+        ad = F.abs(diff)
+        loc_loss = F.sum(F.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5),
+                         axis=1)
+        denom = F.maximum(n_pos, F.ones_like(n_pos))
+        return (cls_loss + self._lambd * loc_loss) / denom
+
+
+class MApMetric:
+    """VOC-style mean average precision over detection rows
+    (example/ssd/evaluate/eval_metric.py MApMetric, 11-point VOC07 AP)."""
+
+    def __init__(self, ovp_thresh=0.5, class_names=None):
+        self.ovp_thresh = ovp_thresh
+        self.class_names = class_names
+        self.reset()
+
+    def reset(self):
+        self._records = {}   # cls -> list of (score, tp)
+        self._npos = {}
+
+    @staticmethod
+    def _iou(a, b):
+        import numpy as onp
+        ix1 = onp.maximum(a[0], b[:, 0]); iy1 = onp.maximum(a[1], b[:, 1])
+        ix2 = onp.minimum(a[2], b[:, 2]); iy2 = onp.minimum(a[3], b[:, 3])
+        iw = onp.maximum(ix2 - ix1, 0); ih = onp.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        ua = (a[2] - a[0]) * (a[3] - a[1]) + \
+            (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]) - inter
+        return inter / onp.maximum(ua, 1e-12)
+
+    def update(self, det, labels):
+        """det: (B, N, 6) rows [cls, score, x1..y2] (-1 = suppressed);
+        labels: (B, M, 5) [cls, x1, y1, x2, y2] (-1 padding)."""
+        import numpy as onp
+        det = det.asnumpy() if hasattr(det, "asnumpy") else onp.asarray(det)
+        labels = labels.asnumpy() if hasattr(labels, "asnumpy") \
+            else onp.asarray(labels)
+        for b in range(det.shape[0]):
+            gts = labels[b][labels[b][:, 0] >= 0]
+            for c in set(gts[:, 0].astype(int)):
+                self._npos[c] = self._npos.get(c, 0) + int(
+                    (gts[:, 0] == c).sum())
+            rows = det[b][det[b][:, 0] >= 0]
+            used = onp.zeros(len(gts), bool)
+            for row in rows[onp.argsort(-rows[:, 1])]:
+                c = int(row[0])
+                cand = onp.where((gts[:, 0] == c) & ~used)[0]
+                tp = 0
+                if len(cand):
+                    ious = self._iou(row[2:6], gts[cand][:, 1:5])
+                    j = int(onp.argmax(ious))
+                    if ious[j] >= self.ovp_thresh:
+                        used[cand[j]] = True
+                        tp = 1
+                self._records.setdefault(c, []).append((float(row[1]), tp))
+
+    def get(self):
+        import numpy as onp
+        aps = []
+        for c, npos in self._npos.items():
+            recs = sorted(self._records.get(c, []), reverse=True)
+            if not recs or npos == 0:
+                aps.append(0.0)
+                continue
+            tps = onp.cumsum([tp for _, tp in recs])
+            fps = onp.cumsum([1 - tp for _, tp in recs])
+            rec = tps / npos
+            prec = tps / onp.maximum(tps + fps, 1e-12)
+            ap = 0.0
+            for t in onp.arange(0.0, 1.01, 0.1):   # VOC07 11-point
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11
+            aps.append(ap)
+        return "mAP", float(onp.mean(aps)) if aps else 0.0
+
+
+def ssd_300_vgg16(classes=20, **kwargs):
+    """SSD-300 with VGG16-reduced (BASELINE config 4)."""
+    return SSD(num_classes=classes, **kwargs)

@@ -57,6 +57,7 @@ class ParallelTrainStep:
         self._mesh = mesh
         self._donate = donate
         self._step_fn = None
+        self._step_n_fns: Dict[int, Callable] = {}
         self._t = 0
 
         params = list(block.collect_params().values())
@@ -114,7 +115,9 @@ class ParallelTrainStep:
         self._aux_ids_cell: List = []
 
     # ------------------------------------------------------------------
-    def _build(self):
+    def _make_raw_step(self):
+        """The pure one-step function shared by the single-step jit and the
+        scan-based multi-step jit."""
         import jax
         import jax.numpy as jnp
 
@@ -174,9 +177,18 @@ class ParallelTrainStep:
                 new_aux.append(upd if upd is not None else aux_params[j])
             return loss_val, new_train, new_aux, new_states
 
-        t_sh = [self._param_shardings[i] for i in tidx]
-        a_sh = [self._param_shardings[i] for i in aidx]
+        return step
+
+    def _shardings(self):
+        t_sh = [self._param_shardings[i] for i in self._trainable_idx]
+        a_sh = [self._param_shardings[i] for i in self._aux_idx]
         rep = self._mesh.replicated()
+        return t_sh, a_sh, rep
+
+    def _build(self):
+        import jax
+        step = self._make_raw_step()
+        t_sh, a_sh, rep = self._shardings()
         in_shardings = (t_sh, a_sh, self._state_shardings,
                         self._data_sharding, self._label_sharding,
                         tuple(self._extra_shardings), rep, rep, rep, rep)
@@ -185,6 +197,55 @@ class ParallelTrainStep:
         self._step_fn = jax.jit(step, in_shardings=in_shardings,
                                 out_shardings=out_shardings,
                                 donate_argnums=donate)
+
+    def _stacked(self, sh):
+        """Sharding for an input with a leading per-step (scan) axis."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self._mesh.mesh, P(None, *sh.spec))
+
+    def _build_n(self, n):
+        """jit(scan(step)) over n stacked microbatches: the training loop runs
+        on-device, amortizing host dispatch across n steps (the standard
+        'train loop inside jit' TPU pattern — compare the reference looping
+        MXImperativeInvoke per op per step)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        step = self._make_raw_step()
+
+        def step_n(train_params, aux_params, opt_states, xs, ys, extras_s,
+                   key, lrs_k, wds_k, t0):
+            # lrs_k/wds_k are (n, n_trainable): per-inner-step schedules, so a
+            # lr_scheduler sees the same update counts as n separate step()s
+            keys = jax.random.split(key, n)
+
+            def body(carry, inp):
+                train, aux, states, t = carry
+                x, y, extras, k, lrs, wds = inp
+                loss, nt, na, ns = step(train, aux, states, x, y, extras, k,
+                                        lrs, wds, t)
+                return (nt, na, ns, t + 1.0), loss
+
+            (train, aux, states, _), losses = lax.scan(
+                body,
+                (list(train_params), list(aux_params), list(opt_states), t0),
+                (xs, ys, extras_s, keys, lrs_k, wds_k))
+            return losses, train, aux, states
+
+        t_sh, a_sh, rep = self._shardings()
+        in_shardings = (t_sh, a_sh, self._state_shardings,
+                        self._stacked(self._data_sharding),
+                        self._stacked(self._label_sharding),
+                        tuple(self._stacked(s) for s in self._extra_shardings),
+                        rep, rep, rep, rep)
+        out_shardings = (rep, t_sh, a_sh, self._state_shardings)
+        donate = (0, 1, 2) if self._donate else ()
+        fn = jax.jit(step_n, in_shardings=in_shardings,
+                     out_shardings=out_shardings, donate_argnums=donate)
+        self._step_n_fns[n] = fn
+        return fn
 
     # ------------------------------------------------------------------
     def step(self, x, y, *extras):
@@ -237,6 +298,86 @@ class ParallelTrainStep:
         return _mk_nd(loss)
 
     __call__ = step
+
+    def step_n(self, xs, ys, *extras_s):
+        """Run K fused training steps as ONE XLA computation (lax.scan over
+        the step body, carrying params/optimizer state on device).
+
+        Inputs carry a leading K axis (K stacked microbatches); returns the
+        per-step losses as a (K,) NDArray. Use for latency-sensitive loops:
+        one host dispatch per K steps instead of per step.
+
+        Matches K separate ``step()`` calls exactly for deterministic models
+        (incl. lr schedules and Adam's t); models with in-graph randomness
+        (Dropout) consume split subkeys of one key instead of K session keys,
+        so the random streams differ (both are valid dropout masks)."""
+        from ..ops.registry import _profiler_running
+        if _profiler_running():
+            from .. import profiler
+            return profiler._dispatch_profiled(
+                "ParallelTrainStep.step_n",
+                lambda: self._step_n_impl(xs, ys, *extras_s))
+        return self._step_n_impl(xs, ys, *extras_s)
+
+    def _step_n_impl(self, xs, ys, *extras_s):
+        import jax
+        import jax.numpy as jnp
+        xs = xs.data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        n = int(xs.shape[0])
+        fn = self._step_n_fns.get(n) or self._build_n(n)
+        ys = jax.tree_util.tree_map(
+            lambda a: a.data if isinstance(a, NDArray) else jnp.asarray(a), ys,
+            is_leaf=lambda a: isinstance(a, NDArray))
+        extras_s = tuple(e.data if isinstance(e, NDArray) else jnp.asarray(e)
+                         for e in extras_s)
+        xs = jax.device_put(xs, self._stacked(self._data_sharding))
+        ys = jax.device_put(ys, self._stacked(self._label_sharding))
+        extras_s = tuple(jax.device_put(e, self._stacked(sh))
+                         for e, sh in zip(extras_s, self._extra_shardings))
+        t0 = self._t
+        self._t += n
+        # per-inner-step lr/wd schedule rows, exactly as step() would see them
+        lrs_rows, wds_rows = [], []
+        for t in range(t0 + 1, t0 + n + 1):
+            if self._optimizer.lr_scheduler is not None:
+                self._optimizer.num_update = t
+            lrs_rows.append([self._optimizer._get_lr(i)
+                             for i in self._trainable_idx])
+            wds_rows.append([self._optimizer._get_wd(i)
+                             for i in self._trainable_idx])
+        lrs_k = jnp.asarray(lrs_rows, dtype=jnp.float32)
+        wds_k = jnp.asarray(wds_rows, dtype=jnp.float32)
+        from .. import random as _rng
+        key = _rng.take_key()
+        train = [self._params[i] for i in self._trainable_idx]
+        aux = [self._params[i] for i in self._aux_idx]
+        losses, new_train, new_aux, new_states = fn(
+            train, aux, self._opt_states, xs, ys, extras_s, key, lrs_k, wds_k,
+            jnp.float32(t0 + 1))
+        for j, i in enumerate(self._trainable_idx):
+            self._params[i] = new_train[j]
+        for j, i in enumerate(self._aux_idx):
+            self._params[i] = new_aux[j]
+        self._opt_states = new_states
+        return _mk_nd(losses)
+
+    def place_batch_n(self, xs, ys, *extras_s):
+        """place_batch for stacked (K, ...) multi-step inputs."""
+        import jax
+        import jax.numpy as jnp
+        xs = jax.device_put(
+            jnp.asarray(xs.data if isinstance(xs, NDArray) else xs),
+            self._stacked(self._data_sharding))
+        ys = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                jnp.asarray(a.data if isinstance(a, NDArray) else a),
+                self._stacked(self._label_sharding)), ys,
+            is_leaf=lambda a: isinstance(a, NDArray))
+        extras_s = tuple(
+            jax.device_put(jnp.asarray(e.data if isinstance(e, NDArray) else e),
+                           self._stacked(sh))
+            for e, sh in zip(extras_s, self._extra_shardings))
+        return (xs, ys) + extras_s
 
     def place_batch(self, x, y, *extras):
         """Pre-place a batch on the mesh with the step's input shardings (for

@@ -162,3 +162,47 @@ def test_ring_attention_causal():
     out_ref = jnp.einsum("bhqk,bhkd->bhqd", p, v)
     onp.testing.assert_allclose(onp.asarray(out_ring), onp.asarray(out_ref),
                                 rtol=2e-4, atol=2e-4)
+
+
+def test_step_n_matches_step():
+    """K fused steps via lax.scan == K separate step() calls, including an lr
+    schedule and Adam's per-step t (deterministic model, no dropout)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.optimizer import lr_scheduler
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((1, 8), "float32")))
+        import jax
+        mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        sched = lr_scheduler.FactorScheduler(step=2, factor=0.5)
+        opt = mx.optimizer.Adam(learning_rate=0.05, lr_scheduler=sched)
+        return net, parallel.ParallelTrainStep(
+            net, gloss.SoftmaxCrossEntropyLoss(), opt, mesh)
+
+    rng = onp.random.RandomState(5)
+    X = rng.rand(6, 8, 8).astype("float32")
+    Y = rng.randint(0, 4, (6, 8)).astype("float32")
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    net1, s1 = build()
+    losses1 = [float(s1(X[i], Y[i]).asscalar()) for i in range(6)]
+
+    mx.random.seed(11)
+    onp.random.seed(11)
+    net2, s2 = build()
+    losses2 = list(s2.step_n(X[:3], Y[:3]).asnumpy()) + \
+        list(s2.step_n(X[3:], Y[3:]).asnumpy())
+    onp.testing.assert_allclose(losses1, losses2, rtol=1e-4, atol=1e-5)
+
+    s1.sync_to_block()
+    s2.sync_to_block()
+    for (n1, p1), (n2, p2) in zip(sorted(net1.collect_params().items()),
+                                  sorted(net2.collect_params().items())):
+        onp.testing.assert_allclose(p1.data().asnumpy(), p2.data().asnumpy(),
+                                    rtol=1e-4, atol=1e-5)
