@@ -45,7 +45,11 @@ def _np_to_tensorproto(name, arr):
 
 
 def _tensorproto_to_np(t):
-    dtype = onp.dtype(_DT_INV.get(t.data_type, "float32"))
+    if t.data_type not in _DT_INV:
+        raise MXNetError(f"onnx import: tensor {t.name!r} has unsupported "
+                         f"data_type {t.data_type} (decoding it as another "
+                         "dtype would be silently wrong)")
+    dtype = onp.dtype(_DT_INV[t.data_type])
     if t.raw_data:
         arr = onp.frombuffer(t.raw_data, dtype=dtype)
     elif t.float_data:

@@ -8,9 +8,8 @@ batching with async device transfer (PJRT DMA).
 """
 from __future__ import annotations
 
+import collections
 import os
-import queue
-import threading
 from collections import namedtuple
 
 import numpy as onp
@@ -293,39 +292,74 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetcher (io.py PrefetchingIter / iter_prefetcher.h)."""
+    """Prefetcher scheduled on the dependency engine (io.py PrefetchingIter /
+    iter_prefetcher.h over the threaded engine).
+
+    Each batch fetch is a host task pushed to the engine (native/engine.cc
+    worker pool when built, Python fallback otherwise) with two write vars:
+    a per-slot var that ``next()`` waits on, and a shared iterator var whose
+    per-var FIFO write discipline serializes the underlying iterator across
+    the pool — the same ordering mechanism the reference engine uses for
+    mutable NDArray writes."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, prefetch=2):
         super().__init__()
+        from . import engine as engine_mod
         self._iter = iters if not isinstance(iters, list) else iters[0]
-        self._prefetch = prefetch
-        self._queue = None
-        self._thread = None
+        self._prefetch = max(1, prefetch)
+        self._engine = engine_mod.get_engine()
+        self._slots = None
+        self._iter_var = self._engine.new_var()
+        # fixed ring of slot vars, reused round-robin: engine vars live for
+        # the engine's lifetime, so per-batch allocation would leak over long
+        # runs; a slot var is only rescheduled after next() waited on it
+        self._slot_vars = [self._engine.new_var()
+                           for _ in range(self._prefetch)]
+        self._next_slot = 0
+        self._done = False
         self.reset()
 
-    def _work(self):
-        try:
-            for batch in self._iter:
-                self._queue.put(("data", batch))
-        except StopIteration:
-            pass
-        except Exception as e:
-            self._queue.put(("error", e))
-        self._queue.put(("end", None))
+    def _schedule(self):
+        if self._done:
+            return
+        var = self._slot_vars[self._next_slot]
+        self._next_slot = (self._next_slot + 1) % len(self._slot_vars)
+        cell = {}
+
+        def task(cell=cell):
+            try:
+                cell["batch"] = self._iter.next()
+            except StopIteration:
+                cell["end"] = True
+            except Exception as e:  # noqa: BLE001 — delivered at next()
+                cell["error"] = e
+
+        self._engine.push(task, write_vars=(var, self._iter_var))
+        self._slots.append((var, cell))
 
     def reset(self):
+        if self._slots:
+            # drain in-flight tasks before touching the inner iterator
+            self._engine.wait_for_var(self._iter_var)
         self._iter.reset()
-        self._queue = queue.Queue(maxsize=self._prefetch)
-        self._thread = threading.Thread(target=self._work, daemon=True)
-        self._thread.start()
+        self._done = False
+        self._slots = collections.deque()
+        for _ in range(self._prefetch):
+            self._schedule()
 
     def next(self):
-        kind, item = self._queue.get()
-        if kind == "data":
-            return item
-        if kind == "error":
-            raise item
-        raise StopIteration
+        if not self._slots:
+            raise StopIteration
+        var, cell = self._slots.popleft()
+        self._engine.wait_for_var(var)
+        if "error" in cell:
+            self._done = True
+            raise cell["error"]
+        if "end" in cell:
+            self._done = True
+            raise StopIteration
+        self._schedule()
+        return cell["batch"]
 
 
 class ResizeIter(DataIter):

@@ -183,6 +183,9 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
         import cv2
     except ImportError as e:
         raise MXNetError("pack_img requires opencv (cv2)") from e
+    if hasattr(img, "asnumpy"):  # accept framework NDArrays like the nd img ops return
+        img = img.asnumpy()
+    img = onp.ascontiguousarray(img)
     encode_params = None
     if img_fmt in (".jpg", ".jpeg"):
         encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]

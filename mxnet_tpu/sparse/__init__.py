@@ -35,7 +35,8 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
-           "cast_storage", "retain", "dot", "add_n"]
+           "cast_storage", "retain", "dot", "add_n", "elemwise_add",
+           "elemwise_mul"]
 
 
 def _jnp():
@@ -172,8 +173,9 @@ class BaseSparseNDArray(NDArray):
         raise MXNetError(f"copyto: unsupported target {type(other)}")
 
     def astype(self, dtype, copy=True):
+        from ..base import DTypes
         out = self._clone()
-        out._data = self._data.astype(NDArray(onp.zeros(1), dtype=dtype).dtype)
+        out._data = self._data.astype(DTypes.jnp(dtype))
         return out
 
     def tostype(self, stype):
@@ -267,7 +269,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         return RowSparseNDArray(vals, uid, self._dense_shape, ctx=self._ctx)
 
     def __mul__(self, other):
-        if isinstance(other, (int, float)):
+        if isinstance(other, (int, float, onp.number)):
             return RowSparseNDArray(self._data * other, self._indices,
                                     self._dense_shape, ctx=self._ctx)
         return self.todense() * other
@@ -346,6 +348,36 @@ class CSRNDArray(BaseSparseNDArray):
                               onp.asarray(self._indices),
                               onp.asarray(self._indptr)),
                              shape=self._dense_shape)
+
+    def _same_pattern(self, other) -> bool:
+        jnp = _jnp()
+        return self._data.shape == other._data.shape and \
+            bool(jnp.array_equal(self._indptr, other._indptr)) and \
+            bool(jnp.array_equal(self._indices, other._indices))
+
+    def __mul__(self, other):
+        if isinstance(other, (int, float, onp.number)):
+            return CSRNDArray(self._data * other, self._indices, self._indptr,
+                              self._dense_shape, ctx=self._ctx)
+        if isinstance(other, CSRNDArray) and self._same_pattern(other):
+            return CSRNDArray(self._data * other._data, self._indices,
+                              self._indptr, self._dense_shape, ctx=self._ctx)
+        if isinstance(other, CSRNDArray):
+            return cast_storage(self.todense() * other.todense(), "csr")
+        return self.todense() * other
+
+    __rmul__ = __mul__
+
+    def __add__(self, other):
+        if isinstance(other, CSRNDArray) and self._same_pattern(other):
+            return CSRNDArray(self._data + other._data, self._indices,
+                              self._indptr, self._dense_shape, ctx=self._ctx)
+        if isinstance(other, CSRNDArray):
+            return cast_storage(self.todense() + other.todense(), "csr")
+        return self.todense() + other
+
+    def __radd__(self, other):
+        return self.todense().__radd__(other)
 
 
 # ---------------------------------------------------------------------------
@@ -464,17 +496,36 @@ def cast_storage(arr, stype: str):
     raise MXNetError(f"unknown stype {stype!r}")
 
 
+@functools.lru_cache(maxsize=None)
+def _retain_fn():
+    import jax
+    jnp = _jnp()
+
+    def f(have, data, want, n_rows):
+        keep = jnp.isin(have, want)
+        new_idx = jnp.where(keep, have, n_rows)  # dropped rows -> pad sentinel
+        bshape = (-1,) + (1,) * (data.ndim - 1)
+        new_val = jnp.where(keep.reshape(bshape), data,
+                            jnp.zeros((), data.dtype))
+        return new_idx, new_val
+    return jax.jit(f, static_argnums=3)
+
+
 def retain(rsp: RowSparseNDArray, indices):
-    """Keep only the requested rows (sparse_retain op parity)."""
+    """Keep only the requested rows (sparse_retain op parity).
+
+    Fully jitted under the static-nnz design: dropped rows become padding
+    (index = shape[0] sentinel, zero values) so nnz — and therefore the
+    compiled shapes — never change; ``dedup()`` compacts if needed."""
     if not isinstance(rsp, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
-    want = onp.asarray(indices.asnumpy() if isinstance(indices, NDArray)
-                       else indices).astype(onp.int64)
-    have = onp.asarray(rsp._indices)
-    keep = onp.isin(have, want)
     jnp = _jnp()
-    return RowSparseNDArray(rsp._data[jnp.asarray(onp.flatnonzero(keep))],
-                            have[keep], rsp._dense_shape, ctx=rsp._ctx)
+    want = (indices.data if isinstance(indices, NDArray)
+            else jnp.asarray(onp.asarray(indices))).reshape(-1).astype(
+        rsp._indices.dtype)
+    new_idx, new_val = _retain_fn()(rsp._indices, rsp._data, want,
+                                    rsp._dense_shape[0])
+    return RowSparseNDArray(new_val, new_idx, rsp._dense_shape, ctx=rsp._ctx)
 
 
 def add_n(arrays):
@@ -499,6 +550,26 @@ def add_n(arrays):
                             ctx=arrays[0]._ctx)
 
 
+def elemwise_add(lhs, rhs):
+    """Elementwise add supporting sparse operands (elemwise_binary_op.cc
+    sparse dispatch): same-pattern csr/rsp stay sparse, else densify."""
+    if isinstance(lhs, BaseSparseNDArray):
+        return lhs + rhs
+    if isinstance(rhs, BaseSparseNDArray):
+        return rhs + lhs
+    return lhs + rhs
+
+
+def elemwise_mul(lhs, rhs):
+    """Elementwise mul supporting sparse operands; scalar·sparse and
+    same-pattern csr·csr keep the sparse format."""
+    if isinstance(lhs, BaseSparseNDArray):
+        return lhs * rhs
+    if isinstance(rhs, BaseSparseNDArray):
+        return rhs * lhs
+    return lhs * rhs
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse dot (dot-inl.h): csr·dense and csrᵀ·dense are segment-sum
     contractions; other combinations fall back to densified dot."""
@@ -519,6 +590,12 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         out = jnp.zeros((lhs._dense_shape[0], r.shape[1]), contrib.dtype)
         return NDArray(out.at[lhs._indices].add(contrib, mode="drop"),
                        ctx=rhs.context)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, BaseSparseNDArray) \
+            and not transpose_b:
+        # csr·csr: keep the lhs segment-sum contraction, densify only rhs
+        # (sparse-sparse matmul has no MXU-friendly form; reference also
+        # routes through a dense side here, dot-inl.h dispatch)
+        return dot(lhs, rhs.todense(), transpose_a=transpose_a)
     lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return dense_dot(lhs_d, rhs_d, transpose_a=transpose_a,

@@ -388,3 +388,61 @@ def test_row_sparse_pull_from_sparse_store():
     exp[1] = [0, 1]
     exp[3] = [2, 3]
     onp.testing.assert_allclose(got, exp)
+
+
+def test_retain_jitted_padding():
+    """retain keeps static nnz: dropped rows become shape[0] sentinels."""
+    rsp = sparse.row_sparse_array((onp.array([[1., 1], [2, 2], [3, 3]]),
+                                   [1, 4, 7]), shape=(10, 2))
+    out = rsp.retain(nd.array([4, 9]))
+    assert out.nnz == rsp.nnz  # static-nnz: no shape change, no recompile
+    dense = out.todense().asnumpy()
+    want = onp.zeros((10, 2), "float32")
+    want[4] = 2.0
+    onp.testing.assert_allclose(dense, want)
+    # the kept row survives, dropped indices became the padding sentinel
+    idx = onp.asarray(out._indices)
+    assert (idx == 10).sum() == 2 and (idx == 4).sum() == 1
+
+
+def test_csr_elemwise_same_pattern():
+    d = onp.array([[0, 1., 0], [2., 0, 3.]], "float32")
+    a = sparse.csr_matrix(d)
+    b = sparse.csr_matrix(2 * d)
+    s = sparse.elemwise_add(a, b)
+    assert s.stype == "csr"
+    onp.testing.assert_allclose(s.todense().asnumpy(), 3 * d)
+    m = sparse.elemwise_mul(a, b)
+    assert m.stype == "csr"
+    onp.testing.assert_allclose(m.todense().asnumpy(), 2 * d * d)
+    sc = a * 4.0
+    assert sc.stype == "csr"
+    onp.testing.assert_allclose(sc.todense().asnumpy(), 4 * d)
+
+
+def test_csr_elemwise_different_pattern_densifies_correctly():
+    d1 = onp.array([[0, 1., 0], [2., 0, 0]], "float32")
+    d2 = onp.array([[5., 0, 0], [0, 0, 7.]], "float32")
+    a, b = sparse.csr_matrix(d1), sparse.csr_matrix(d2)
+    s = a + b
+    onp.testing.assert_allclose(s.todense().asnumpy(), d1 + d2)
+    m = a * b
+    onp.testing.assert_allclose(m.todense().asnumpy(), d1 * d2)
+
+
+def test_csr_csr_dot():
+    rng = onp.random.RandomState(0)
+    d1 = rng.rand(4, 6) * (rng.rand(4, 6) > 0.5)
+    d2 = rng.rand(6, 3) * (rng.rand(6, 3) > 0.5)
+    a = sparse.csr_matrix(d1.astype("float32"))
+    b = sparse.csr_matrix(d2.astype("float32"))
+    out = sparse.dot(a, b)
+    onp.testing.assert_allclose(out.asnumpy(), d1 @ d2, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_astype():
+    rsp = sparse.row_sparse_array((onp.array([[1., 2]]), [3]), shape=(5, 2))
+    out = rsp.astype("bfloat16")
+    assert str(out.dtype) == "bfloat16"
+    onp.testing.assert_allclose(out.todense().asnumpy().astype("float32"),
+                                rsp.todense().asnumpy(), rtol=1e-2)

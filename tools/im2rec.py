@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Build RecordIO datasets from image folders (parity: tools/im2rec.py —
+``--list`` mode scans a directory into .lst files with train/test splits;
+pack mode reads .lst and writes indexed .rec/.idx via pack_img).
+
+TPU-native notes: the output .rec is byte-compatible with the reference
+(mxnet_tpu.recordio writes the same magic/framing), so datasets built here
+feed either framework's iterators. Encoding parallelism uses a thread pool
+(the work is in the image codec, which releases the GIL) instead of the
+reference's multiprocessing queues.
+
+Usage:
+    python tools/im2rec.py --list prefix image_root      # make .lst
+    python tools/im2rec.py prefix image_root             # pack .rec/.idx
+"""
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking ``root``; one label per subdir
+    when recursive (im2rec.py list_image semantics)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, rel, label in image_list:
+            fout.write(f"{idx}\t{label}\t{rel}\n")
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    n_test = int(n * args.test_ratio)
+    n_train = int(n * args.train_ratio)
+    chunks = {"": image_list}
+    if args.test_ratio > 0 or args.train_ratio < 1:
+        chunks = {"_test": image_list[:n_test],
+                  "_train": image_list[n_test:n_test + n_train]}
+        if args.test_ratio + args.train_ratio < 1:
+            chunks["_val"] = image_list[n_test + n_train:]
+    for suffix, chunk in chunks.items():
+        write_list(f"{args.prefix}{suffix}.lst", chunk)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(v) for v in parts[1:-1]])
+
+
+def pack(args, lst_path):
+    from mxnet_tpu import recordio
+    prefix = os.path.splitext(lst_path)[0]
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    items = list(read_list(lst_path))
+
+    def encode(item):
+        idx, rel, labels = item
+        fpath = os.path.join(args.root, rel)
+        with open(fpath, "rb") as f:
+            raw = f.read()
+        header = recordio.IRHeader(0, labels[0] if len(labels) == 1
+                                   else labels, idx, 0)
+        if args.pass_through:
+            return idx, recordio.pack(header, raw)
+        from mxnet_tpu import image as img_mod
+        img = img_mod.imdecode(raw, to_rgb=False)
+        if args.resize:
+            img = img_mod.resize_short(img, args.resize)
+        return idx, recordio.pack_img(header, img, quality=args.quality,
+                                      img_fmt=args.encoding)
+
+    count = 0
+    with ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for idx, rec in pool.map(encode, items):
+            writer.write_idx(idx, rec)
+            count += 1
+            if count % 1000 == 0:
+                print(f"packed {count} images", file=sys.stderr)
+    writer.close()
+    print(f"{prefix}.rec: {count} records")
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO dataset",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create image list instead of .rec")
+    parser.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    parser.add_argument("--recursive", action="store_true",
+                        help="one label per subdirectory")
+    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0.0)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge to this size")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    parser.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding, pack raw bytes")
+    parser.add_argument("--num-thread", type=int, default=4)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        make_list(args)
+        return 0
+    lsts = [f for f in os.listdir(os.path.dirname(args.prefix) or ".")
+            if f.startswith(os.path.basename(args.prefix))
+            and f.endswith(".lst")]
+    if not lsts:
+        print(f"no .lst files matching {args.prefix}*; run --list first",
+              file=sys.stderr)
+        return 1
+    for lst in sorted(lsts):
+        pack(args, os.path.join(os.path.dirname(args.prefix) or ".", lst))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

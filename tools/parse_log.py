@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown table (parity: tools/parse_log.py —
+Epoch[N] Train-metric / Validation-metric / Time cost lines, the format
+Module.fit and callback.Speedometer emit).
+
+Usage: python tools/parse_log.py train.log [--format markdown|none]
+                                 [--metric-names accuracy ...]
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    """Returns rows of (epoch, train_metrics..., val_metrics..., time)."""
+    train_re = [re.compile(r".*Epoch\[(\d+)\] Train-" + re.escape(m) +
+                           r".*=([.\d]+)") for m in metric_names]
+    val_re = [re.compile(r".*Epoch\[(\d+)\] Validation-" + re.escape(m) +
+                         r".*=([.\d]+)") for m in metric_names]
+    time_re = re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)")
+    data = {}
+    for line in lines:
+        for i, r in enumerate(train_re):
+            m = r.match(line)
+            if m:
+                data.setdefault(int(m.group(1)), {})[f"train-{metric_names[i]}"] = \
+                    float(m.group(2))
+        for i, r in enumerate(val_re):
+            m = r.match(line)
+            if m:
+                data.setdefault(int(m.group(1)), {})[f"val-{metric_names[i]}"] = \
+                    float(m.group(2))
+        m = time_re.match(line)
+        if m:
+            data.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+    return data
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Parse training log")
+    parser.add_argument("logfile", nargs=1, type=str)
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none"])
+    parser.add_argument("--metric-names", type=str, nargs="+",
+                        default=["accuracy"])
+    args = parser.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines(), args.metric_names)
+
+    cols = ["epoch"]
+    for m in args.metric_names:
+        cols += [f"train-{m}", f"val-{m}"]
+    cols.append("time")
+    sep = " | " if args.format == "markdown" else " "
+    print(sep.join(cols))
+    if args.format == "markdown":
+        print(sep.join("---" for _ in cols))
+    for epoch in sorted(data):
+        row = [str(epoch)]
+        for c in cols[1:]:
+            v = data[epoch].get(c)
+            row.append(f"{v:.6f}" if isinstance(v, float) else "-")
+        print(sep.join(row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
