@@ -36,6 +36,13 @@ from . import amp
 from . import operator
 from . import monitor
 from .monitor import Monitor
+from . import config
+from . import tensor_inspector
+from .tensor_inspector import TensorInspector
+
+if config.get("MXNET_PROFILER_AUTOSTART"):
+    profiler.set_config(profile_all=True)
+    profiler.start()
 from . import parallel
 from . import sparse
 from . import symbol

@@ -30,35 +30,25 @@ class MXNetError(RuntimeError):
 # Typed environment-config registry (replaces scattered dmlc::GetEnv reads).
 # ---------------------------------------------------------------------------
 class _EnvConfig:
-    _REGISTRY: Dict[str, tuple] = {}
+    """Thin facade over mxnet_tpu.config — the single flag registry (that
+    module imports this one, so the delegation is lazy)."""
 
-    def register(self, name: str, default: Any, typ: type = str, doc: str = "") -> None:
-        self._REGISTRY[name] = (default, typ, doc)
+    def register(self, name: str, default: Any, typ: type = str,
+                 doc: str = "") -> None:
+        from . import config
+        config.register(name, default, typ, doc)
 
     def get(self, name: str, default: Any = None) -> Any:
-        if name in self._REGISTRY:
-            reg_default, typ, _ = self._REGISTRY[name]
-            raw = os.environ.get(name)
-            if raw is None:
-                return reg_default if default is None else default
-            if typ is bool:
-                return raw not in ("0", "false", "False", "")
-            return typ(raw)
-        raw = os.environ.get(name)
-        return default if raw is None else raw
+        from . import config
+        return config.get(name, default)
 
     def list_vars(self) -> Dict[str, tuple]:
-        return dict(self._REGISTRY)
+        from . import config
+        return {n: (config._REGISTRY[n]["default"], config._REGISTRY[n]["type"],
+                    config._REGISTRY[n]["doc"]) for n in config.list_flags()}
 
 
 env = _EnvConfig()
-env.register("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
-             "Engine flavour; NaiveEngine forces synchronous execution for debugging")
-env.register("MXNET_EXEC_BULK_EXEC_TRAIN", 1, int, "op bulking (subsumed by XLA fusion)")
-env.register("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int, "sharding threshold for kvstore")
-env.register("MXNET_CPU_WORKER_NTHREADS", 1, int, "host worker threads")
-env.register("MXNET_SAFE_ACCUMULATION", 1, int, "fp32 accumulation for reduced dtypes")
-env.register("MXNET_ENFORCE_DETERMINISM", 0, int, "deterministic kernels only")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,96 @@
+"""Environment flag registry (parity: the reference's MXNET_* env-var config
+system — docs/faq/env_var.md over dmlc::GetEnv call sites in src/).
+
+Typed, documented, centrally-registered flags: ``config.get("MXNET_...")``
+reads the process environment with the registered default and type, and
+``config.describe()`` lists every knob (the env_var.md analog). Subsystems
+read through here so behavior-affecting env vars are discoverable instead of
+scattered ad-hoc ``os.environ`` lookups.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+from .base import MXNetError
+
+__all__ = ["register", "get", "set", "describe", "list_flags"]
+
+_REGISTRY: Dict[str, dict] = {}
+_OVERRIDES: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+
+
+def register(name, default, type_=None, doc=""):
+    """Register a flag with its default, type and documentation."""
+    if type_ is None:
+        type_ = type(default) if default is not None else str
+    with _LOCK:
+        _REGISTRY[name] = {"default": default, "type": type_, "doc": doc}
+    return name
+
+
+def _coerce(name, raw, type_):
+    try:
+        if type_ is bool:
+            return str(raw).lower() in ("1", "true", "yes", "on")
+        return type_(raw)
+    except (TypeError, ValueError) as e:
+        raise MXNetError(f"{name}={raw!r}: expected {type_.__name__}") from e
+
+
+def get(name, default=None):
+    """Read a flag: set() override > process env > registered default."""
+    spec = _REGISTRY.get(name)
+    if name in _OVERRIDES:
+        return _OVERRIDES[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        if spec is not None:
+            return spec["default"]
+        return default
+    return _coerce(name, raw, spec["type"] if spec else
+                   (type(default) if default is not None else str))
+
+
+def set(name, value):  # noqa: A001 — mirrors the reference's setter naming
+    """Override a flag for this process (takes precedence over the env)."""
+    _OVERRIDES[name] = value
+
+
+def list_flags():
+    return sorted(_REGISTRY)
+
+
+def describe():
+    """Human-readable flag table (env_var.md analog)."""
+    lines = []
+    for name in list_flags():
+        spec = _REGISTRY[name]
+        cur = get(name)
+        lines.append(f"{name} (default {spec['default']!r}, "
+                     f"current {cur!r}): {spec['doc']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# flags consumed by this framework (kept to knobs that actually do something)
+# ---------------------------------------------------------------------------
+register("MXNET_ENGINE_TYPE", "ThreadedEngine", str,
+         "Engine for host tasks: ThreadedEngine (native C++ pool) or "
+         "NaiveEngine (synchronous Python fallback).")
+register("MXNET_CPU_WORKER_NTHREADS", 4, int,
+         "Worker threads of the host-task dependency engine.")
+register("MXNET_CPU_PRIORITY_NTHREADS", 4, int,
+         "Decode/augment threads of the native image pipeline default.")
+register("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
+         "Accepted for parity; op bulking is subsumed by XLA fusion.")
+register("MXNET_PROFILER_AUTOSTART", False, bool,
+         "Start the profiler at import (profiler.cc autostart parity).")
+register("MXNET_SAFE_ACCUMULATION", True, bool,
+         "Accumulate reductions over bf16/fp16 inputs in fp32.")
+register("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True, bool,
+         "Log when a sparse op densifies an operand (executor fallback log).")
+register("MXNET_HOME", os.path.join("~", ".mxnet"), str,
+         "Root for datasets/model downloads.")

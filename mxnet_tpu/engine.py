@@ -52,9 +52,16 @@ class _PythonEngine:
         pass
 
 
-def Engine(num_workers=4):
-    """Create a host-task dependency engine (NativeEngine when built)."""
-    from . import native
+def Engine(num_workers=None):
+    """Create a host-task dependency engine (NativeEngine when built).
+
+    Honors MXNET_ENGINE_TYPE / MXNET_CPU_WORKER_NTHREADS (env_var.md parity)."""
+    from . import config, native
+    if num_workers is None:
+        num_workers = config.get("MXNET_CPU_WORKER_NTHREADS")
+    etype = config.get("MXNET_ENGINE_TYPE")
+    if etype == "NaiveEngine":
+        return _PythonEngine(num_workers)
     if native.available():
         return native.NativeEngine(num_workers)
     return _PythonEngine(num_workers)

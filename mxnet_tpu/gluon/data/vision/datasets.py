@@ -18,6 +18,12 @@ from ....base import MXNetError
 from ....ndarray.ndarray import NDArray
 from ..dataset import ArrayDataset, Dataset
 
+
+def _mx_home():
+    """Dataset root honoring MXNET_HOME (env_var.md parity)."""
+    from .... import config
+    return config.get("MXNET_HOME")
+
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageRecordDataset",
            "ImageFolderDataset"]
 
@@ -56,7 +62,7 @@ def _synthetic(shape, num_classes, n, seed):
 class MNIST(_DownloadedDataset):
     """MNIST from idx-ubyte files (datasets.py MNIST)."""
 
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+    def __init__(self, root=os.path.join(_mx_home(), "datasets", "mnist"),
                  train=True, transform=None):
         self._train = train
         self._train_data = ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz")
@@ -103,14 +109,14 @@ class MNIST(_DownloadedDataset):
 
 
 class FashionMNIST(MNIST):
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+    def __init__(self, root=os.path.join(_mx_home(), "datasets",
                                          "fashion-mnist"), train=True,
                  transform=None):
         super().__init__(root, train, transform)
 
 
 class CIFAR10(_DownloadedDataset):
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+    def __init__(self, root=os.path.join(_mx_home(), "datasets", "cifar10"),
                  train=True, transform=None):
         self._train = train
         self._num_synthetic = 2048
@@ -145,7 +151,7 @@ class CIFAR10(_DownloadedDataset):
 
 
 class CIFAR100(CIFAR10):
-    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+    def __init__(self, root=os.path.join(_mx_home(), "datasets", "cifar100"),
                  fine_label=False, train=True, transform=None):
         self._fine_label = fine_label
         super().__init__(root, train, transform)

@@ -4,6 +4,7 @@ available; resize/crop run through jax.image on device."""
 from __future__ import annotations
 
 import io as _io
+import math
 import numbers
 import os
 import random as pyrandom
@@ -14,8 +15,13 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
 __all__ = ["imdecode", "imresize", "imread", "fixed_crop", "center_crop",
-           "random_crop", "resize_short", "color_normalize", "ImageIter",
-           "CreateAugmenter"]
+           "random_crop", "random_size_crop", "resize_short", "color_normalize",
+           "ImageIter", "CreateAugmenter", "Augmenter", "SequentialAug",
+           "RandomOrderAug", "ResizeAug", "ForceResizeAug", "CenterCropAug",
+           "RandomCropAug", "RandomSizedCropAug", "HorizontalFlipAug",
+           "CastAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug"]
 
 
 def imdecode(buf, flag=1, to_rgb=True):
@@ -159,32 +165,237 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+class SequentialAug(Augmenter):
+    """Compose augmenters in order (image.py:783)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in random order (image.py:921)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for aug in ts:
+            src = aug(src)
+        return src
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to an exact (w, h), ignoring aspect ratio (image.py:826)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop with size/aspect jitter (image.py random_size_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (math.log(ratio[0]), math.log(ratio[1]))
+        new_ratio = math.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(math.sqrt(target_area * new_ratio)))
+        new_h = int(round(math.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+class RandomSizedCropAug(Augmenter):
+    """Inception-style random sized crop (image.py:867)."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 ± U(0, brightness) (image.py:945)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the gray mean (image.py:964)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype("float32")
+        gray = (arr * _GRAY_COEF).sum(axis=2, keepdims=True)
+        mean = gray.mean() * (3.0 / arr.shape[2])
+        return NDArray(arr * alpha + mean * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with per-pixel gray (image.py:987)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype("float32")
+        gray = (arr * _GRAY_COEF).sum(axis=2, keepdims=True)
+        return NDArray(arr * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Rotate color channels in YIQ space (image.py:1011)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]])
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = math.cos(alpha * math.pi), math.sin(alpha * math.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = onp.dot(onp.dot(self.ityiq, bt), self.tyiq).T
+        arr = src.asnumpy().astype("float32")
+        return NDArray(onp.dot(arr, t))
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random-order brightness/contrast/saturation jitter (image.py:1045)."""
+    ts = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise, AlexNet-style (image.py:1068)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval)
+        self.eigvec = onp.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = onp.dot(self.eigvec * alpha, self.eigval)
+        return NDArray(src.asnumpy().astype("float32") + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    """Subtract mean, divide std (image.py:1094)."""
+
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = None if mean is None else onp.asarray(mean)
+        self.std = None if std is None else onp.asarray(std)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel gray (image.py:1114)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = onp.array([[0.21, 0.21, 0.21],
+                              [0.72, 0.72, 0.72],
+                              [0.07, 0.07, 0.07]])
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return NDArray(onp.dot(src.asnumpy().astype("float32"), self.mat))
+        return src
+
+
+_GRAY_COEF = onp.array([0.299, 0.587, 0.114]).reshape(1, 1, 3)
+
+_PCA_EIGVAL = onp.array([55.46, 4.794, 1.148])
+_PCA_EIGVEC = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
-    """Build the standard augmentation pipeline (mx.image.CreateAugmenter)."""
+    """Build the standard augmentation pipeline (mx.image.CreateAugmenter —
+    image.py:1179; full jitter/lighting/gray option surface)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0), inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is not None or std is not None:
         if isinstance(mean, bool) and mean:
             mean = onp.array([123.68, 116.28, 103.53])
         if isinstance(std, bool) and std:
             std = onp.array([58.395, 57.12, 57.375])
-
-        class _NormAug(Augmenter):
-            def __call__(self, src):
-                return color_normalize(src, mean, std)
-        auglist.append(_NormAug())
+        auglist.append(ColorNormalizeAug(mean, std))
     return auglist
 
 

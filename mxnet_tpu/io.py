@@ -266,10 +266,15 @@ class NativeImageRecordIter(DataIter):
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=128,
                     shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0,
                     mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
-                    preprocess_threads=4, prefetch_buffer=4, seed=0, **kwargs):
+                    preprocess_threads=None, prefetch_buffer=4, seed=0,
+                    **kwargs):
     """ImageRecordIter (src/io/iter_image_recordio_2.cc:887 parity): RecordIO
     decode→augment→batch with thread prefetch. Uses the native C++ pipeline
-    when built; otherwise the Python ImageIter + PrefetchingIter stack."""
+    when built; otherwise the Python ImageIter + PrefetchingIter stack.
+    Default thread count honors MXNET_CPU_PRIORITY_NTHREADS."""
+    from . import config
+    if preprocess_threads is None:
+        preprocess_threads = config.get("MXNET_CPU_PRIORITY_NTHREADS")
     mean = onp.array([mean_r, mean_g, mean_b]) if (mean_r or mean_g or mean_b) \
         else None
     std = onp.array([std_r, std_g, std_b]) if (std_r != 1 or std_g != 1

@@ -1,16 +1,18 @@
-"""NDArray save/load (parity surface: python/mxnet/ndarray/utils.py:149/:222 over
+"""NDArray save/load (parity: python/mxnet/ndarray/utils.py:149/:222 over
 src/ndarray/ndarray.cc:1679 Save / :1802 Load).
 
-Format: a single-file container holding named (or indexed) arrays. The reference
-uses a custom binary layout with magic 0x112; here an NPZ container with a
-framework magic entry — same API (save/load of list or dict of NDArrays), portable
-across hosts, and streaming-friendly for checkpoints.
+Byte-compatible with the reference container: uint64 magic 0x112 + reserved,
+a dmlc vector of NDArray records (NDARRAY_V2_MAGIC 0xF993fac9; int32 storage
+type; sparse storage shape; TShape as int32 ndim + int64 dims; Context as two
+int32s; int32 mshadow type flag; aux types/shapes; raw little-endian data),
+then a dmlc vector of name strings — so .params files interchange with the
+reference in both directions. Dense, row_sparse and csr storage supported;
+bfloat16 uses the reference's kBfloat16 flag. Files written by earlier rounds
+(NPZ container) still load via a fallback.
 """
 from __future__ import annotations
 
-import io
-import os
-import zipfile
+import struct
 from typing import Dict, List, Union
 
 import numpy as onp
@@ -18,53 +20,196 @@ import numpy as onp
 from ..base import MXNetError
 from .ndarray import NDArray
 
-_MAGIC = "MXTPU0112"
-_BF16_SUFFIX = "::bf16"
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_V3_MAGIC = 0xF993FACA
+
+# mshadow/base.h TypeFlag
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6, "bool": 7, "int16": 8,
+              "uint16": 9, "uint32": 10, "uint64": 11, "bfloat16": 12}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+# include/mxnet/ndarray.h NDArrayStorageType
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
 
 
-def _to_numpy(arr: NDArray):
-    np_arr = arr.asnumpy()
-    if str(arr.dtype) == "bfloat16":
-        return np_arr.view(onp.uint16) if np_arr.dtype.itemsize == 2 \
-            else np_arr.astype(onp.float32), True
-    return np_arr, False
+def _np_of(arr):
+    """numpy view with a dtype numpy can hold (bf16 via ml_dtypes)."""
+    return onp.ascontiguousarray(arr.asnumpy() if isinstance(arr, NDArray)
+                                 else onp.asarray(arr))
+
+
+def _write_shape(f, dims):
+    f.write(struct.pack("<i", len(dims)))
+    if dims:
+        f.write(struct.pack(f"<{len(dims)}q", *[int(d) for d in dims]))
+
+
+def _read_shape(f):
+    (ndim,) = struct.unpack("<i", f.read(4))
+    if ndim <= 0:
+        return ()
+    return struct.unpack(f"<{ndim}q", f.read(8 * ndim))
+
+
+def _dtype_name(np_arr):
+    name = str(np_arr.dtype)
+    if name not in _TYPE_FLAG:
+        raise MXNetError(f"save: dtype {name} has no reference type flag")
+    return name
+
+
+def _write_one(f, arr):
+    from ..sparse import BaseSparseNDArray, CSRNDArray, RowSparseNDArray
+    f.write(struct.pack("<I", _V2_MAGIC))
+    if isinstance(arr, RowSparseNDArray):
+        # compact static-nnz padding (idx == shape[0] sentinels) for interop
+        arr = arr.dedup()
+        idx = onp.asarray(arr._indices, onp.int64)
+        vals = _np_of(NDArray(arr._data))
+        keep = idx < arr.shape[0]
+        idx, vals = idx[keep], vals[keep]
+        f.write(struct.pack("<i", _STYPE_ROW_SPARSE))
+        _write_shape(f, vals.shape)            # storage shape
+        _write_shape(f, arr.shape)
+        f.write(struct.pack("<ii", 1, 0))      # context: kCPU, dev 0
+        f.write(struct.pack("<i", _TYPE_FLAG[_dtype_name(vals)]))
+        f.write(struct.pack("<i", _TYPE_FLAG["int64"]))   # aux 0: indices
+        _write_shape(f, idx.shape)
+        f.write(vals.tobytes())
+        f.write(onp.ascontiguousarray(idx).tobytes())
+        return
+    if isinstance(arr, CSRNDArray):
+        indptr = onp.asarray(arr._indptr, onp.int64)
+        idx = onp.asarray(arr._indices, onp.int64)
+        vals = _np_of(NDArray(arr._data))
+        f.write(struct.pack("<i", _STYPE_CSR))
+        _write_shape(f, vals.shape)
+        _write_shape(f, arr.shape)
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", _TYPE_FLAG[_dtype_name(vals)]))
+        f.write(struct.pack("<i", _TYPE_FLAG["int64"]))   # aux 0: indptr
+        _write_shape(f, indptr.shape)
+        f.write(struct.pack("<i", _TYPE_FLAG["int64"]))   # aux 1: indices
+        _write_shape(f, idx.shape)
+        f.write(vals.tobytes())
+        f.write(onp.ascontiguousarray(indptr).tobytes())
+        f.write(onp.ascontiguousarray(idx).tobytes())
+        return
+    if isinstance(arr, BaseSparseNDArray):
+        raise MXNetError(f"save: unsupported sparse type {type(arr)}")
+    np_arr = _np_of(arr)
+    f.write(struct.pack("<i", _STYPE_DEFAULT))
+    _write_shape(f, np_arr.shape)
+    f.write(struct.pack("<ii", 1, 0))
+    f.write(struct.pack("<i", _TYPE_FLAG[_dtype_name(np_arr)]))
+    f.write(np_arr.tobytes())
+
+
+def _np_dtype(flag):
+    if flag not in _FLAG_TYPE:
+        raise MXNetError(f"load: unknown type flag {flag}")
+    name = _FLAG_TYPE[flag]
+    if name == "bfloat16":
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(name)
+
+
+def _read_array(f, dtype, shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    buf = f.read(dtype.itemsize * n)
+    return onp.frombuffer(buf, dtype=dtype).reshape(shape).copy()
+
+
+def _read_one(f):
+    from ..sparse import CSRNDArray, RowSparseNDArray
+    (magic,) = struct.unpack("<I", f.read(4))
+    if magic not in (_V2_MAGIC, _V3_MAGIC):
+        raise MXNetError(f"load: unsupported NDArray record magic {magic:#x} "
+                         "(legacy V1 files not supported)")
+    (stype,) = struct.unpack("<i", f.read(4))
+    nad = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}.get(stype)
+    if nad is None:
+        raise MXNetError(f"load: unknown storage type {stype}")
+    storage_shape = _read_shape(f) if nad else None
+    shape = _read_shape(f)
+    f.read(8)  # context (dev_type, dev_id): placement is the loader's choice
+    (type_flag,) = struct.unpack("<i", f.read(4))
+    dtype = _np_dtype(type_flag)
+    aux = []
+    for _ in range(nad):
+        (aux_flag,) = struct.unpack("<i", f.read(4))
+        aux.append((_np_dtype(aux_flag), _read_shape(f)))
+    data = _read_array(f, dtype, storage_shape if nad else shape)
+    aux_data = [_read_array(f, dt, sh) for dt, sh in aux]
+    if stype == _STYPE_DEFAULT:
+        return NDArray(data)
+    if stype == _STYPE_ROW_SPARSE:
+        return RowSparseNDArray(data, aux_data[0].astype(onp.int32), shape)
+    return CSRNDArray(data, aux_data[1].astype(onp.int32),
+                      aux_data[0].astype(onp.int32), shape)
 
 
 def save(fname: str, data) -> None:
-    """Save a list or str-keyed dict of NDArrays (ndarray/utils.py:222 parity)."""
+    """Save a list or str-keyed dict of NDArrays in the reference binary
+    format (ndarray/utils.py:222 over ndarray.cc:1914)."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, (list, tuple)):
-        items = {f"__idx__{i}": a for i, a in enumerate(data)}
+        arrays, names = list(data), []
     elif isinstance(data, dict):
-        items = dict(data)
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
     else:
         raise MXNetError("save expects NDArray, list, or dict of NDArrays")
-    from ..sparse import BaseSparseNDArray, CSRNDArray
-    payload = {}
-    for k, v in items.items():
+    for i, v in enumerate(arrays):
         if not isinstance(v, NDArray):
-            raise MXNetError(f"save: value for {k!r} is not an NDArray")
-        if isinstance(v, BaseSparseNDArray):
-            # sparse arrays keep their components (ndarray.cc:1679 stores aux
-            # data for kRowSparse/kCSR storage the same way)
-            payload[f"{k}::stype"] = onp.asarray([v.stype])
-            payload[f"{k}::shape"] = onp.asarray(v.shape, onp.int64)
-            payload[f"{k}::indices"] = onp.asarray(v._indices)
-            if isinstance(v, CSRNDArray):
-                payload[f"{k}::indptr"] = onp.asarray(v._indptr)
-            np_arr, is_bf16 = _to_numpy(v.data)
-            payload[f"{k}::values" + (_BF16_SUFFIX if is_bf16 else "")] = np_arr
-            continue
-        np_arr, is_bf16 = _to_numpy(v)
-        payload[k + (_BF16_SUFFIX if is_bf16 else "")] = np_arr
-    payload["__magic__"] = onp.asarray([_MAGIC])
+            raise MXNetError(f"save: item {i} is not an NDArray")
     with open(fname, "wb") as f:
-        onp.savez(f, **payload)
+        f.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_one(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
 
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
-    """Load NDArrays saved by ``save`` (ndarray/utils.py:149 parity)."""
+    """Load NDArrays saved by ``save`` — or by the reference's mx.nd.save
+    (ndarray/utils.py:149 over ndarray.cc:1924). NPZ files written by earlier
+    rounds of this framework still load."""
+    with open(fname, "rb") as f:
+        head = f.read(16)
+        if len(head) == 16:
+            magic, _reserved = struct.unpack("<QQ", head)
+            if magic == _LIST_MAGIC:
+                (count,) = struct.unpack("<Q", f.read(8))
+                arrays = [_read_one(f) for _ in range(count)]
+                (n_names,) = struct.unpack("<Q", f.read(8))
+                names = []
+                for _ in range(n_names):
+                    (ln,) = struct.unpack("<Q", f.read(8))
+                    names.append(f.read(ln).decode("utf-8"))
+                if names:
+                    return dict(zip(names, arrays))
+                return arrays
+    return _load_npz(fname)
+
+
+# ---------------------------------------------------------------------------
+# legacy NPZ container (rounds 1-2 of this framework)
+# ---------------------------------------------------------------------------
+_BF16_SUFFIX = "::bf16"
+
+
+def _load_npz(fname):
     import ml_dtypes
     with onp.load(fname, allow_pickle=False) as z:
         keys = [k for k in z.files if k != "__magic__"]

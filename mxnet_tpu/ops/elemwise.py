@@ -225,9 +225,12 @@ def smooth_l1(x, *, scalar=1.0):
 # reductions (reference: broadcast_reduce_op_value.cc)
 # ---------------------------------------------------------------------------
 def _acc_dtype(x):
-    """fp32 accumulation for reduced-precision inputs (MXNET_SAFE_ACCUMULATION)."""
+    """fp32 accumulation for reduced-precision inputs (MXNET_SAFE_ACCUMULATION);
+    consulted at trace time, so jit caches bake the policy in."""
     if x.dtype in (jnp.bfloat16, jnp.float16):
-        return jnp.float32
+        from .. import config
+        if config.get("MXNET_SAFE_ACCUMULATION"):
+            return jnp.float32
     return x.dtype
 
 

@@ -363,7 +363,9 @@ class CSRNDArray(BaseSparseNDArray):
             return CSRNDArray(self._data * other._data, self._indices,
                               self._indptr, self._dense_shape, ctx=self._ctx)
         if isinstance(other, CSRNDArray):
+            _log_fallback("elemwise_mul(csr,csr)", "sparsity patterns differ")
             return cast_storage(self.todense() * other.todense(), "csr")
+        _log_fallback("elemwise_mul(csr,dense)", "dense operand")
         return self.todense() * other
 
     __rmul__ = __mul__
@@ -373,7 +375,9 @@ class CSRNDArray(BaseSparseNDArray):
             return CSRNDArray(self._data + other._data, self._indices,
                               self._indptr, self._dense_shape, ctx=self._ctx)
         if isinstance(other, CSRNDArray):
+            _log_fallback("elemwise_add(csr,csr)", "sparsity patterns differ")
             return cast_storage(self.todense() + other.todense(), "csr")
+        _log_fallback("elemwise_add(csr,dense)", "dense operand")
         return self.todense() + other
 
     def __radd__(self, other):
@@ -550,6 +554,16 @@ def add_n(arrays):
                             ctx=arrays[0]._ctx)
 
 
+def _log_fallback(op, why):
+    """Storage-fallback notice (the executor's 'operator densified' log,
+    gated on MXNET_STORAGE_FALLBACK_LOG_VERBOSE like the reference)."""
+    from .. import config
+    if config.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE"):
+        import logging
+        logging.getLogger("mxnet_tpu.sparse").info(
+            "storage fallback: %s densified (%s)", op, why)
+
+
 def elemwise_add(lhs, rhs):
     """Elementwise add supporting sparse operands (elemwise_binary_op.cc
     sparse dispatch): same-pattern csr/rsp stay sparse, else densify."""
@@ -596,6 +610,10 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         # (sparse-sparse matmul has no MXU-friendly form; reference also
         # routes through a dense side here, dot-inl.h dispatch)
         return dot(lhs, rhs.todense(), transpose_a=transpose_a)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        _log_fallback("dot", f"unsupported combination "
+                      f"({type(lhs).__name__}, {type(rhs).__name__}, "
+                      f"ta={transpose_a}, tb={transpose_b})")
     lhs_d = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     rhs_d = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return dense_dot(lhs_d, rhs_d, transpose_a=transpose_a,

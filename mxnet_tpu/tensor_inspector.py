@@ -1,0 +1,103 @@
+"""TensorInspector: tensor debugging utility (parity:
+src/common/tensor_inspector.h — print_string, check_value with the
+CheckerType set, dump_to_file; reachable from any op via a one-liner).
+
+TPU-native: values sync to host once and all checks are vectorized numpy;
+``interactive_print`` is replaced by returning the positions so the tool works
+in scripts and notebooks (no blocking stdin in an async runtime).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["TensorInspector", "CheckerType"]
+
+
+class CheckerType:
+    """Checker names (tensor_inspector.h:71 CheckerType)."""
+    NegativeChecker = "negative"
+    PositiveChecker = "positive"
+    ZeroChecker = "zero"
+    NaNChecker = "nan"
+    InfChecker = "inf"
+    PositiveInfChecker = "positive_inf"
+    NegativeInfChecker = "negative_inf"
+    FiniteChecker = "finite"
+    NormalChecker = "normal"
+    AbnormalChecker = "abnormal"
+
+
+_CHECKS = {
+    "negative": lambda a: a < 0,
+    "positive": lambda a: a > 0,
+    "zero": lambda a: a == 0,
+    "nan": onp.isnan,
+    "inf": onp.isinf,
+    "positive_inf": lambda a: onp.isposinf(a),
+    "negative_inf": lambda a: onp.isneginf(a),
+    "finite": onp.isfinite,
+    "normal": lambda a: ~(onp.isnan(a) | onp.isinf(a)),
+    "abnormal": lambda a: onp.isnan(a) | onp.isinf(a),
+}
+
+
+class TensorInspector:
+    """Inspect a tensor's values (tensor_inspector.h:103).
+
+    >>> ti = TensorInspector(arr)
+    >>> print(ti.to_string())
+    >>> bad = ti.check_value(CheckerType.AbnormalChecker)
+    """
+
+    def __init__(self, tensor, tag=""):
+        if isinstance(tensor, NDArray):
+            self._np = tensor.asnumpy()
+        else:
+            self._np = onp.asarray(tensor)
+        self.tag = tag
+
+    def to_string(self, max_elems=64):
+        """Shape/dtype header + (truncated) values — the print_string analog."""
+        flat = self._np.reshape(-1)
+        body = onp.array2string(self._np if flat.size <= max_elems
+                                else flat[:max_elems], threshold=max_elems)
+        suffix = "" if flat.size <= max_elems else \
+            f" ... ({flat.size - max_elems} more)"
+        tag = f"[{self.tag}] " if self.tag else ""
+        return f"{tag}<{self._np.dtype} {self._np.shape}> {body}{suffix}"
+
+    def print_string(self, max_elems=64):
+        print(self.to_string(max_elems))
+
+    def check_value(self, checker, full=False):
+        """Positions where the checker fires (check_value analog).
+
+        checker: a CheckerType name or a callable(ndarray)->bool mask.
+        Returns a list of index tuples (all of them when ``full``, else up to
+        1000 like the reference's default print cap)."""
+        if callable(checker):
+            mask = checker(self._np)
+        elif checker in _CHECKS:
+            arr = self._np
+            if not onp.issubdtype(arr.dtype, onp.floating) and \
+                    checker in ("nan", "inf", "positive_inf", "negative_inf",
+                                "finite", "normal", "abnormal"):
+                arr = arr.astype(onp.float64)
+            mask = _CHECKS[checker](arr)
+        else:
+            raise MXNetError(f"unknown checker {checker!r}; one of "
+                             f"{sorted(_CHECKS)}")
+        pos = onp.argwhere(mask)
+        if not full:
+            pos = pos[:1000]
+        return [tuple(int(v) for v in p) for p in pos]
+
+    def dump_to_file(self, tag, rank=0):
+        """Persist to '<tag>_<rank>.npy' (dump_to_file analog; .npy instead of
+        the reference's private binary layout)."""
+        fname = f"{tag}_{rank}.npy"
+        onp.save(fname, self._np)
+        return fname

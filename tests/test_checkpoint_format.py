@@ -1,0 +1,121 @@
+"""Binary checkpoint format tests: byte-level layout must match the reference
+mx.nd.save container (src/ndarray/ndarray.cc:1914 NDArray::Save list format,
+:1679 per-array record) so .params files interchange."""
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sparse
+
+
+def test_dense_record_byte_layout(tmp_path):
+    """Hand-decode the written bytes against the documented reference layout:
+    u64 0x112, u64 0, u64 count, [u32 V2 magic, i32 stype, shape(i32 ndim +
+    i64*ndim), i32 dev_type, i32 dev_id, i32 type_flag, raw data], u64 #names,
+    (u64 len + bytes)*."""
+    a = nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    path = str(tmp_path / "one.params")
+    nd.save(path, {"w": a})
+    buf = open(path, "rb").read()
+    o = 0
+    magic, reserved, count = struct.unpack_from("<QQQ", buf, o); o += 24
+    assert magic == 0x112 and reserved == 0 and count == 1
+    (v2,) = struct.unpack_from("<I", buf, o); o += 4
+    assert v2 == 0xF993FAC9
+    (stype,) = struct.unpack_from("<i", buf, o); o += 4
+    assert stype == 0  # kDefaultStorage
+    (ndim,) = struct.unpack_from("<i", buf, o); o += 4
+    assert ndim == 2
+    dims = struct.unpack_from("<2q", buf, o); o += 16
+    assert dims == (2, 3)
+    dev_type, dev_id = struct.unpack_from("<ii", buf, o); o += 8
+    assert dev_type == 1 and dev_id == 0  # kCPU
+    (type_flag,) = struct.unpack_from("<i", buf, o); o += 4
+    assert type_flag == 0  # mshadow kFloat32
+    data = onp.frombuffer(buf, "<f4", 6, o); o += 24
+    onp.testing.assert_array_equal(data, onp.arange(6, dtype="float32"))
+    (n_names,) = struct.unpack_from("<Q", buf, o); o += 8
+    assert n_names == 1
+    (ln,) = struct.unpack_from("<Q", buf, o); o += 8
+    assert buf[o:o + ln] == b"w"
+    assert o + ln == len(buf)  # nothing else in the file
+
+
+def test_roundtrip_dtypes(tmp_path):
+    arrays = {
+        "f32": nd.array(onp.random.RandomState(0).rand(3, 4).astype("float32")),
+        "i32": nd.array(onp.arange(5, dtype="int32")),
+        "u8": nd.array(onp.arange(4, dtype="uint8")),
+        "bf16": nd.array(onp.random.RandomState(1).rand(2, 2).astype("float32")
+                         ).astype("bfloat16"),
+    }
+    path = str(tmp_path / "multi.params")
+    nd.save(path, arrays)
+    out = nd.load(path)
+    assert set(out) == set(arrays)
+    for k in arrays:
+        assert str(out[k].dtype) == str(arrays[k].dtype), k
+        onp.testing.assert_array_equal(
+            out[k].asnumpy().astype("float32"),
+            arrays[k].asnumpy().astype("float32"))
+
+
+def test_roundtrip_list_and_sparse(tmp_path):
+    rsp = sparse.row_sparse_array(
+        (onp.array([[1., 2], [3, 4]], "float32"), [1, 4]), shape=(6, 2))
+    csr = sparse.csr_matrix(onp.array([[0, 5., 0], [7., 0, 0]], "float32"))
+    dense = nd.array(onp.ones((2, 2), "float32"))
+    path = str(tmp_path / "mixed.params")
+    nd.save(path, {"rsp": rsp, "csr": csr, "d": dense})
+    out = nd.load(path)
+    assert out["rsp"].stype == "row_sparse"
+    assert out["csr"].stype == "csr"
+    onp.testing.assert_allclose(out["rsp"].todense().asnumpy(),
+                                rsp.todense().asnumpy())
+    onp.testing.assert_allclose(out["csr"].todense().asnumpy(),
+                                csr.todense().asnumpy())
+    # list save: no names section -> loads as list
+    nd.save(str(tmp_path / "list.params"), [dense, dense * 2])
+    lst = nd.load(str(tmp_path / "list.params"))
+    assert isinstance(lst, list) and len(lst) == 2
+    onp.testing.assert_allclose(lst[1].asnumpy(), 2 * onp.ones((2, 2)))
+
+
+def test_sparse_record_sparse_layout(tmp_path):
+    """Sparse records carry storage shape + aux types/shapes/data like
+    ndarray.cc:1694-1752."""
+    rsp = sparse.row_sparse_array(
+        (onp.array([[1., 2]], "float32"), [3]), shape=(5, 2))
+    path = str(tmp_path / "rsp.params")
+    nd.save(path, {"r": rsp})
+    buf = open(path, "rb").read()
+    o = 24 + 4  # list header + V2 magic
+    (stype,) = struct.unpack_from("<i", buf, o); o += 4
+    assert stype == 1  # kRowSparseStorage
+    (sndim,) = struct.unpack_from("<i", buf, o); o += 4
+    sdims = struct.unpack_from(f"<{sndim}q", buf, o); o += 8 * sndim
+    assert sdims == (1, 2)  # storage (data) shape
+    (ndim,) = struct.unpack_from("<i", buf, o); o += 4
+    dims = struct.unpack_from(f"<{ndim}q", buf, o); o += 8 * ndim
+    assert dims == (5, 2)
+
+
+def test_legacy_npz_still_loads(tmp_path):
+    path = str(tmp_path / "old.params")
+    payload = {"a": onp.arange(3, dtype="float32"),
+               "__magic__": onp.asarray(["MXTPU0112"])}
+    with open(path, "wb") as f:
+        onp.savez(f, **payload)
+    out = nd.load(path)
+    onp.testing.assert_array_equal(out["a"].asnumpy(),
+                                   onp.arange(3, dtype="float32"))
+
+
+def test_load_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.params")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 40)
+    with pytest.raises(Exception):
+        nd.load(path)

@@ -1,0 +1,85 @@
+"""Env flag registry tests (parity pattern: the MXNET_* env-var system,
+docs/faq/env_var.md over dmlc::GetEnv call sites)."""
+import os
+import subprocess
+import sys
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_defaults_and_env(monkeypatch):
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 4
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "7")
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") == 7
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "0")
+    assert config.get("MXNET_EXEC_BULK_EXEC_TRAIN") is False
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "true")
+    assert config.get("MXNET_EXEC_BULK_EXEC_TRAIN") is True
+
+
+def test_override_and_describe():
+    config.set("MXNET_KVSTORE_BIGARRAY_BOUND", 42)
+    try:
+        assert config.get("MXNET_KVSTORE_BIGARRAY_BOUND") == 42
+    finally:
+        config._OVERRIDES.pop("MXNET_KVSTORE_BIGARRAY_BOUND", None)
+    text = config.describe()
+    assert "MXNET_ENGINE_TYPE" in text and "MXNET_CPU_WORKER_NTHREADS" in text
+
+
+def test_bad_value_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "lots")
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        config.get("MXNET_CPU_WORKER_NTHREADS")
+
+
+def test_engine_type_respected():
+    """MXNET_ENGINE_TYPE=NaiveEngine forces the synchronous fallback even
+    with the native build present (env_var.md MXNET_ENGINE_TYPE parity)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXNET_ENGINE_TYPE="NaiveEngine")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from mxnet_tpu import engine;"
+         "print(type(engine.get_engine()).__name__)"],
+        env=env, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "_PythonEngine"
+
+
+def test_profiler_autostart():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXNET_PROFILER_AUTOSTART="1")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import mxnet_tpu as mx;"
+         "from mxnet_tpu.profiler import _STATE;"
+         "print(_STATE['running'])"],
+        env=env, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "True"
+
+
+def test_tensor_inspector():
+    import numpy as onp
+    from mxnet_tpu import TensorInspector, nd
+    from mxnet_tpu.tensor_inspector import CheckerType
+
+    a = nd.array(onp.array([[1.0, -2.0], [onp.nan, onp.inf]], "float32"))
+    ti = TensorInspector(a, tag="grad")
+    s = ti.to_string()
+    assert "grad" in s and "float32" in s and "(2, 2)" in s
+    assert ti.check_value(CheckerType.NaNChecker) == [(1, 0)]
+    assert ti.check_value(CheckerType.AbnormalChecker) == [(1, 0), (1, 1)]
+    assert ti.check_value(CheckerType.NegativeChecker) == [(0, 1)]
+    assert ti.check_value(lambda x: x == 1.0) == [(0, 0)]
+    import os
+    f = ti.dump_to_file("/tmp/ti_test", 3)
+    try:
+        onp.testing.assert_array_equal(onp.load(f)[0], [1.0, -2.0])
+    finally:
+        os.unlink(f)

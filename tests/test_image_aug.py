@@ -1,0 +1,70 @@
+"""Image augmenter tests (parity patterns: tests/python/unittest/test_image.py
+— jitter/lighting/gray augmenters, CreateAugmenter full surface)."""
+import random
+
+import numpy as onp
+
+from mxnet_tpu import image, nd
+
+
+def test_create_augmenter_full_pipeline():
+    random.seed(0)
+    onp.random.seed(0)
+    src = nd.array(onp.random.RandomState(3).randint(
+        0, 255, (32, 40, 3)).astype("uint8"))
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_resize=True,
+                                 rand_mirror=True, brightness=0.2,
+                                 contrast=0.2, saturation=0.2, hue=0.1,
+                                 pca_noise=0.1, rand_gray=0.3,
+                                 mean=True, std=True)
+    out = src
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert str(out.dtype) == "float32"
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_hue_jitter_small_alpha_near_identity():
+    # the reference's YIQ matrices are rounded, so alpha=0 is identity only
+    # to ~0.3% of the 255 scale
+    h = image.HueJitterAug(0.0)
+    x = nd.array(onp.random.RandomState(1).rand(4, 4, 3).astype("float32") * 255)
+    onp.testing.assert_allclose(h(x).asnumpy(), x.asnumpy(), atol=1.0)
+
+
+def test_saturation_gray_invariant():
+    g = onp.full((4, 4, 3), 100.0, "float32")
+    s = image.SaturationJitterAug(0.5)
+    onp.testing.assert_allclose(s(nd.array(g)).asnumpy(), g, atol=0.5)
+
+
+def test_random_gray_channels_equal():
+    rg = image.RandomGrayAug(1.0)
+    out = rg(nd.array(onp.random.RandomState(2).rand(4, 4, 3)
+                      .astype("float32"))).asnumpy()
+    onp.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-5)
+    onp.testing.assert_allclose(out[..., 1], out[..., 2], rtol=1e-5)
+
+
+def test_brightness_scales():
+    b = image.BrightnessJitterAug(0.0)  # zero jitter -> identity
+    x = nd.array(onp.ones((2, 2, 3), "float32"))
+    onp.testing.assert_allclose(b(x).asnumpy(), onp.ones((2, 2, 3)))
+
+
+def test_random_sized_crop_bounds():
+    random.seed(1)
+    src = nd.array(onp.random.RandomState(0).rand(50, 60, 3).astype("float32"))
+    aug = image.RandomSizedCropAug((20, 20), (0.2, 1.0), (0.75, 1.333))
+    for _ in range(5):
+        out = aug(src)
+        assert out.shape == (20, 20, 3)
+
+
+def test_sequential_and_force_resize():
+    src = nd.array(onp.random.RandomState(1).rand(30, 30, 3).astype("float32"))
+    seq = image.SequentialAug([image.ForceResizeAug((12, 16)),
+                               image.CastAug("float32")])
+    out = seq(src)
+    assert out.shape == (16, 12, 3)

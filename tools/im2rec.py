@@ -127,7 +127,9 @@ def parse_args():
                         default=[".jpeg", ".jpg", ".png"])
     parser.add_argument("--recursive", action="store_true",
                         help="one label per subdirectory")
-    parser.add_argument("--shuffle", type=bool, default=True)
+    parser.add_argument("--shuffle", type=lambda v: str(v).lower() in
+                        ("1", "true", "yes"), default=True,
+                        help="shuffle the list (pass 0/false to disable)")
     parser.add_argument("--train-ratio", type=float, default=1.0)
     parser.add_argument("--test-ratio", type=float, default=0.0)
     parser.add_argument("--resize", type=int, default=0,
