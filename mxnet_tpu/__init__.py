@@ -40,6 +40,9 @@ from . import config
 from . import tensor_inspector
 from .tensor_inspector import TensorInspector
 
+from . import library
+library.initialize()  # atfork discipline + SIGSEGV logger (initialize.cc)
+
 if config.get("MXNET_PROFILER_AUTOSTART"):
     profiler.set_config(profile_all=True)
     profiler.start()
@@ -47,6 +50,7 @@ from . import parallel
 from . import sparse
 from . import symbol
 from . import symbol as sym
+from . import subgraph
 from . import module
 from . import module as mod
 from . import contrib

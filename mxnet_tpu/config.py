@@ -88,6 +88,9 @@ register("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
          "Accepted for parity; op bulking is subsumed by XLA fusion.")
 register("MXNET_PROFILER_AUTOSTART", False, bool,
          "Start the profiler at import (profiler.cc autostart parity).")
+register("MXNET_USE_SIGNAL_HANDLER", True, bool,
+         "Install the crash backtrace logger (faulthandler; the "
+         "initialize.cc SegfaultLogger analog).")
 register("MXNET_SAFE_ACCUMULATION", True, bool,
          "Accumulate reductions over bf16/fp16 inputs in fp32.")
 register("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True, bool,

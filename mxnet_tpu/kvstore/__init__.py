@@ -22,6 +22,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
 from .gradient_compression import GradientCompression
+from . import collective as _collective  # registers the 'collective' backend
 
 __all__ = ["create", "KVStore", "KVStoreBase"]
 

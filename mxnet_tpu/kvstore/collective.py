@@ -1,0 +1,82 @@
+"""Collective KVStore backend (parity pattern: python/mxnet/kvstore/horovod.py
+— a second backend registered through KVStoreBase.register, proving the
+pluggable-backend mechanism the reference uses for Horovod/BytePS).
+
+Design: no key->value store at all. ``broadcast`` fans the root's value out
+and ``pushpull`` is a single fused allreduce executed as one jitted XLA
+computation per (shape, dtype) over the device mesh — ICI collectives instead
+of the dict-based reduce of the default KVStore. This is the allreduce-native
+training path (horovod.py semantics: no server, no optimizer offload)."""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["Collective"]
+
+
+@KVStoreBase.register
+class Collective(KVStoreBase):
+    """mx.kv.create('collective'): allreduce-only backend (horovod.py analog)."""
+
+    def __init__(self):
+        from ..parallel.collectives import initialize_distributed
+        initialize_distributed()
+        # one helper for the life of the store so _allreduce_sum's
+        # per-store mesh/jit cache actually hits across steps
+        from . import KVStore
+        self._reducer = KVStore.__new__(KVStore)
+
+    @property
+    def type(self):
+        return "collective"
+
+    @property
+    def rank(self):
+        import jax
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        import jax
+        return jax.process_count()
+
+    @staticmethod
+    def is_capable(capability):
+        # no optimizer offload: updates happen on workers (horovod.py:52)
+        return {KVStoreBase.OPTIMIZER: False}.get(capability, False)
+
+    def broadcast(self, key, value, out, priority=0):
+        """Root's value to every worker/output (horovod broadcast_)."""
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        src = vals[0]
+        if self.num_workers > 1:
+            import jax.numpy as jnp
+            from jax.experimental import multihost_utils
+            data = multihost_utils.broadcast_one_to_all(src.data)
+            src = NDArray(jnp.asarray(data), ctx=src.context)
+        for o in (out if isinstance(out, (list, tuple)) else [out]):
+            src.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce of per-device values into out (horovod allreduce_)."""
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        total = vals[0].data
+        for v in vals[1:]:
+            total = total + v.data
+        if self.num_workers > 1:
+            # ride the same GSPMD allreduce as the dist kvstore dense path
+            total = self._reducer._allreduce_sum(total)
+        agg = NDArray(total, ctx=vals[0].context)
+        targets = out if out is not None else value
+        for o in (targets if isinstance(targets, (list, tuple)) else [targets]):
+            agg.copyto(o)
+
+    def push(self, key, value, priority=0):
+        raise MXNetError("collective kvstore is pushpull-only "
+                         "(allreduce-native; horovod.py parity)")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise MXNetError("collective kvstore is pushpull-only "
+                         "(allreduce-native; horovod.py parity)")

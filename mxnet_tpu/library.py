@@ -1,0 +1,76 @@
+"""Library initialization (parity: src/initialize.cc LibraryInitializer —
+pthread_atfork handlers + the SIGSEGV backtrace logger, and
+python/mxnet/library.py load_lib for external op libraries).
+
+TPU-native mapping:
+  - fork safety: the native dependency engine owns a worker thread pool and
+    PJRT owns device handles; neither survives fork. ``os.register_at_fork``
+    drains the engine in the parent before fork and discards the (invalid)
+    engine handle in the child so the child lazily builds a fresh one — the
+    atfork_prepare/atfork_child discipline of initialize.cc:70-86.
+  - crash logging: ``faulthandler`` dumps Python + thread backtraces on
+    SIGSEGV/SIGFPE/SIGABRT/SIGBUS, the segfault-logger analog
+    (initialize.cc SegfaultLogger). Enabled unless MXNET_USE_SIGNAL_HANDLER=0.
+  - load(path): loads an external library of custom C ops (lib_api.h analog)
+    via ctypes and calls its registration entry point.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["load"]
+
+_INITIALIZED = False
+
+
+def _atfork_prepare():
+    from . import engine
+    if engine._engine is not None:
+        try:
+            engine._engine.wait_all()
+        except Exception:  # noqa: BLE001 — never block a fork on debris
+            pass
+
+
+def _atfork_child():
+    from . import engine
+    # worker threads don't exist in the child; drop the handle so the next
+    # get_engine() builds a fresh pool (initialize.cc atfork_child)
+    with engine._lock:
+        eng = engine._engine
+        engine._engine = None
+    if eng is not None and hasattr(eng, "_h"):
+        eng._h = None  # do NOT destroy: memory belongs to the parent's pool
+
+
+def initialize():
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    _INITIALIZED = True
+    os.register_at_fork(before=_atfork_prepare, after_in_child=_atfork_child)
+    from . import config
+    if config.get("MXNET_USE_SIGNAL_HANDLER"):
+        import faulthandler
+        if not faulthandler.is_enabled():
+            faulthandler.enable(file=sys.stderr, all_threads=True)
+
+
+def load(path, verbose=True):
+    """Load an external operator library (python/mxnet/library.py:31 load_lib
+    over lib_api.h). The library must export ``mxtpu_lib_init`` returning 0."""
+    import ctypes
+    from .base import MXNetError
+    if not os.path.exists(path):
+        raise MXNetError(f"library {path!r} not found")
+    lib = ctypes.CDLL(os.path.abspath(path), ctypes.RTLD_LOCAL)
+    if not hasattr(lib, "mxtpu_lib_init"):
+        raise MXNetError(f"{path}: missing mxtpu_lib_init entry point "
+                         "(external op library ABI)")
+    ret = lib.mxtpu_lib_init()
+    if ret != 0:
+        raise MXNetError(f"{path}: mxtpu_lib_init failed with code {ret}")
+    if verbose:
+        print(f"loaded library {path}")
+    return lib

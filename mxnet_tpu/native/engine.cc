@@ -87,11 +87,18 @@ class Engine {
     task->writes.assign(writes, writes + n_writes);
     std::unique_lock<std::mutex> lk(mu_);
     ++pending_;
+    int ndeps = static_cast<int>(task->reads.size() + task->writes.size());
+    if (ndeps == 0) {
+      // no dependencies: runnable immediately (GrantOne only fires from a
+      // var's queue, so dep-free tasks must enter the ready queue here)
+      ready_.push(task);
+      ready_cv_.notify_one();
+      return;
+    }
     int grants = 0;
     for (int64_t v : task->reads) vars_.at(v)->queue.push_back(task);
     for (int64_t v : task->writes) vars_.at(v)->queue.push_back(task);
-    task->wait_count.store(
-        static_cast<int>(task->reads.size() + task->writes.size()));
+    task->wait_count.store(ndeps);
     // try to grant from each var's queue front
     for (int64_t v : task->reads) grants += TryGrant(v);
     for (int64_t v : task->writes) grants += TryGrant(v);

@@ -89,6 +89,31 @@ def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False):
             else:
                 outs = node_out_shapes.get(id(inp[0]))
                 in_shapes.append(outs[inp[1]] if outs is not None else None)
+        if n.op == "_CachedSubgraph":
+            # recurse: infer the inner graph with whatever outer slot shapes
+            # are known; inner inference fills parameter shapes (FC/conv
+            # hooks), which map back onto the outer variables
+            inner = n.attrs["sym"]
+            arg_names = n.attrs["arg_names"]
+            inner_known = {an: s for an, s in zip(arg_names, in_shapes)
+                           if s is not None}
+            inner_shapes, inner_outs, _ = _infer_shapes(inner, inner_known,
+                                                        partial=partial)
+            for slot, an in enumerate(arg_names):
+                if in_shapes[slot] is None and an in inner_shapes:
+                    src = n.inputs[slot][0]
+                    if src.is_var and src.name not in shapes:
+                        shapes[src.name] = inner_shapes[an]
+                    in_shapes[slot] = inner_shapes[an]
+            if any(s is None for s in inner_outs):
+                if partial:
+                    node_out_shapes[id(n)] = None
+                    continue
+                raise IncompleteShapeError(
+                    f"infer_shape: subgraph {n.name} outputs unresolved")
+            n.num_outputs = len(inner_outs)
+            node_out_shapes[id(n)] = tuple(tuple(s) for s in inner_outs)
+            continue
         # fill unknown parameter shapes from the hook
         hook = _param_shape_hook(n.op, n.attrs, in_shapes, n.arg_names)
         for slot, shp in hook.items():

@@ -87,7 +87,9 @@ def _positional_names(op_name: str):
 class Symbol:
     """A node-output handle in the symbolic graph (symbol.py Symbol)."""
 
-    __slots__ = ("_node", "_index", "_group")
+    # _sg_jit_fn: compiled-executable cache slot for subgraph delegation
+    # (lifetime follows the Symbol; see subgraph._get_subgraph_fn)
+    __slots__ = ("_node", "_index", "_group", "_sg_jit_fn")
 
     def __init__(self, node: Optional[_SymNode] = None, index: int = 0,
                  group: Optional[List["Symbol"]] = None):

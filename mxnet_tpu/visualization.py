@@ -1,8 +1,24 @@
-"""Network visualization (parity: python/mxnet/visualization.py print_summary /
-plot_network). Works over gluon Blocks; plot_network emits graphviz dot source."""
+"""Network visualization (parity: python/mxnet/visualization.py print_summary:25
+/ plot_network:214). Works over Symbols (op-level DAG, shape-labeled edges,
+reference color scheme) and gluon Blocks (module hierarchy); plot_network
+emits graphviz dot source and wraps it in graphviz.Source when the optional
+package is importable."""
 from __future__ import annotations
 
 from typing import Optional
+
+# reference plot_network fill colors by op family (visualization.py:266)
+_COLORS = {
+    "Convolution": "#fb8072", "Deconvolution": "#fb8072",
+    "FullyConnected": "#fb8072",
+    "Activation": "#ffffb3", "LeakyReLU": "#ffffb3",
+    "BatchNorm": "#bebada", "LayerNorm": "#bebada",
+    "Pooling": "#80b1d3",
+    "Concat": "#fdb462", "Flatten": "#fdb462", "Reshape": "#fdb462",
+    "softmax": "#fccde5", "SoftmaxOutput": "#fccde5",
+}
+_DEFAULT_COLOR = "#8dd3c7"
+_VAR_COLOR = "#8dd3c7"
 
 
 def print_summary(block, input_shape=None, line_length=98):
@@ -25,10 +41,73 @@ def print_summary(block, input_shape=None, line_length=98):
     return total_params
 
 
-def plot_network(block, title="plot", shape=None, save_format="pdf", hide_weights=True):
-    """Return graphviz dot source for the block hierarchy (visualization.py:214).
-    Rendering requires the optional graphviz package; the dot text is always built."""
-    lines = ["digraph plot {", '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+def _label(node):
+    attrs = node.attrs or {}
+    if node.op == "Convolution":
+        k = attrs.get("kernel")
+        return f"Convolution\\n{k}/{attrs.get('stride', (1, 1))}, " \
+               f"{attrs.get('num_filter', '?')}"
+    if node.op == "FullyConnected":
+        return f"FullyConnected\\n{attrs.get('num_hidden', '?')}"
+    if node.op == "Pooling":
+        return f"Pooling\\n{attrs.get('pool_type', 'max')}, " \
+               f"{attrs.get('kernel')}/{attrs.get('stride', (1, 1))}"
+    if node.op == "Activation":
+        return f"Activation\\n{attrs.get('act_type', '')}"
+    return node.op
+
+
+def _plot_symbol(sym, title, shape, hide_weights):
+    lines = [f'digraph "{title}" {{',
+             "  node [shape=box, fixedsize=true, width=1.3, height=0.8034, "
+             "style=filled];"]
+    shapes = {}
+    if shape:
+        try:
+            arg_shapes, out_shapes, _ = sym.infer_shape(**shape)
+            shapes = dict(zip(sym.list_arguments(), arg_shapes))
+        except Exception:  # noqa: BLE001 — shapes are decoration only
+            shapes = {}
+    topo = sym._topo()
+    hidden = set()
+    if hide_weights:
+        for n in topo:
+            if n.is_var and not n.name.endswith("data") and \
+                    any(n.name.endswith(s) for s in
+                        ("weight", "bias", "gamma", "beta", "moving_mean",
+                         "moving_var", "running_mean", "running_var")):
+                hidden.add(id(n))
+    for n in topo:
+        if id(n) in hidden:
+            continue
+        if n.is_var:
+            lines.append(f'  "{n.name}" [label="{n.name}", '
+                         f'fillcolor="{_VAR_COLOR}"];')
+        else:
+            color = _COLORS.get(n.op, _DEFAULT_COLOR)
+            lines.append(f'  "{n.name}" [label="{_label(n)}", '
+                         f'fillcolor="{color}"];')
+    for n in topo:
+        if n.is_var or id(n) in hidden:
+            continue
+        for slot in n.inputs:
+            if slot is None:
+                continue
+            src, _ = slot
+            if id(src) in hidden:
+                continue
+            edge = f'  "{src.name}" -> "{n.name}"'
+            if src.name in shapes:
+                edge += f' [label="{"x".join(map(str, shapes[src.name]))}"]'
+            lines.append(edge + ";")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def _plot_block(block, title):
+    lines = [f'digraph "{title}" {{',
+             '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+
     def walk(b, prefix):
         node = prefix or b.__class__.__name__
         lines.append(f'  "{node}" [label="{b.__class__.__name__}"];')
@@ -38,7 +117,19 @@ def plot_network(block, title="plot", shape=None, save_format="pdf", hide_weight
             lines.append(f'  "{child_id}" -> "{node}";')
     walk(block, "")
     lines.append("}")
-    src = "\n".join(lines)
+    return "\n".join(lines)
+
+
+def plot_network(symbol, title="plot", shape=None, save_format="pdf",
+                 hide_weights=True):
+    """Graphviz plot of a Symbol's op DAG — shape-labeled edges, reference
+    color scheme (visualization.py:214) — or of a gluon Block's hierarchy.
+    Rendering needs the optional graphviz package; dot text is always built."""
+    from .symbol.symbol import Symbol
+    if isinstance(symbol, Symbol):
+        src = _plot_symbol(symbol, title, shape, hide_weights)
+    else:
+        src = _plot_block(symbol, title)
     try:
         import graphviz
         return graphviz.Source(src)

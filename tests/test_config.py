@@ -83,3 +83,37 @@ def test_tensor_inspector():
         onp.testing.assert_array_equal(onp.load(f)[0], [1.0, -2.0])
     finally:
         os.unlink(f)
+
+
+def test_library_fork_safety():
+    """os.fork after engine use: the child gets a fresh engine (atfork
+    discipline, initialize.cc:70-86)."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    code = """
+import os
+from mxnet_tpu import engine
+e = engine.get_engine()
+e.push(lambda: None)
+e.wait_all()
+pid = os.fork()
+if pid == 0:
+    # atfork_child must have dropped the parent's engine handle; the child
+    # only checks state (building a thread pool post-fork is its caller's
+    # choice) and exits without running any teardown
+    ok = engine._engine is None
+    os._exit(0 if ok else 1)
+_, status = os.waitpid(pid, 0)
+assert os.waitstatus_to_exitcode(status) == 0, "child kept parent engine"
+# parent side must still work after the fork
+e2 = engine.get_engine()
+e2.push(lambda: None)
+e2.wait_all()
+print("fork ok")
+"""
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "fork ok" in out.stdout

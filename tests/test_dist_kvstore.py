@@ -22,3 +22,23 @@ def test_dist_sync_kvstore_two_processes():
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, f"dist workers failed:\n{out}"
     assert "worker 0: OK" in out and "worker 1: OK" in out, out
+
+
+def test_collective_backend_registered():
+    """Second pluggable backend via KVStoreBase.register (horovod.py pattern)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("collective")
+    assert kv.type == "collective"
+    a = nd.array(onp.ones((2, 3), "float32"))
+    b = nd.array(onp.full((2, 3), 2.0, "float32"))
+    kv.pushpull("k", [a, b])
+    onp.testing.assert_allclose(a.asnumpy(), onp.full((2, 3), 3.0))
+    out = nd.zeros((2, 3))
+    kv.broadcast("k", nd.array(onp.full((2, 3), 7.0, "float32")), out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 7.0))
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        kv.push("k", a)

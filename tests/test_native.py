@@ -43,6 +43,18 @@ def test_engine_readers_parallel_writer_exclusive():
     eng.close()
 
 
+def test_engine_depfree_tasks_run():
+    """Tasks pushed with no read/write vars must still execute (regression:
+    grant logic only fired from var queues, so dep-free pushes hung wait_all)."""
+    eng = native.NativeEngine(num_workers=2)
+    out = []
+    for i in range(8):
+        eng.push(lambda i=i: out.append(i))
+    eng.wait_all()
+    assert sorted(out) == list(range(8))
+    eng.close()
+
+
 def test_engine_exception_at_sync_point():
     eng = native.NativeEngine(num_workers=2)
     v = eng.new_var()

@@ -1,0 +1,93 @@
+"""Subgraph partition API tests (parity patterns: tests/python/unittest/
+test_subgraph_op.py — partitioned vs unpartitioned numerical identity,
+backend registration, unsupported-op splitting, backward)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, subgraph
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = mx.sym.Activation(fc1, name="act", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, name="fc2", num_hidden=4)
+    return mx.sym.softmax(fc2, name="sm")
+
+
+def _bind_like(sym, ref_exe, x, **kwargs):
+    exe = sym.simple_bind(mx.cpu(), data=x.shape, **kwargs)
+    for k, a in ref_exe.arg_dict.items():
+        if k in exe.arg_dict:
+            a.copyto(exe.arg_dict[k])
+    exe.arg_dict["data"][:] = nd.array(x)
+    return exe
+
+
+def _ref(sym, shape, seed=0):
+    exe = sym.simple_bind(mx.cpu(), data=shape)
+    rng = onp.random.RandomState(seed)
+    for k, a in exe.arg_dict.items():
+        if k != "data":
+            a[:] = nd.array(rng.rand(*a.shape).astype("float32"))
+    x = rng.rand(*shape).astype("float32")
+    exe.arg_dict["data"][:] = nd.array(x)
+    return exe, x
+
+
+def test_full_graph_collapses_to_one_subgraph():
+    out = _mlp()
+    part = subgraph.optimize_for(out, "xla")
+    ops = [n.op for n in part._topo() if not n.is_var]
+    assert ops == ["_CachedSubgraph"], ops
+    exe0, x = _ref(out, (2, 5))
+    want = exe0.forward()[0].asnumpy()
+    exe1 = _bind_like(part, exe0, x)
+    onp.testing.assert_allclose(exe1.forward()[0].asnumpy(), want, rtol=1e-5)
+
+
+def test_unsupported_op_splits_regions():
+    out = _mlp()
+
+    class NoSoftmax(subgraph.SubgraphBackend):
+        def supported(self, node):
+            return node.op != "softmax"
+
+    subgraph.register_backend(NoSoftmax("no_softmax"))
+    part = subgraph.optimize_for(out, "no_softmax")
+    ops = [n.op for n in part._topo() if not n.is_var]
+    assert ops == ["_CachedSubgraph", "softmax"], ops
+    exe0, x = _ref(out, (3, 6), seed=1)
+    want = exe0.forward()[0].asnumpy()
+    exe1 = _bind_like(part, exe0, x)
+    onp.testing.assert_allclose(exe1.forward()[0].asnumpy(), want, rtol=1e-5)
+
+
+def test_backward_through_subgraph():
+    out = _mlp()
+    part = subgraph.optimize_for(out, "xla")
+    exe0, x = _ref(out, (2, 5), seed=2)
+    exe0.forward(is_train=True)
+    head = nd.array(onp.ones((2, 4), "float32"))
+    exe0.backward(head)
+    g0 = exe0.grad_dict["fc1_weight"].asnumpy()
+    exe1 = _bind_like(part, exe0, x, grad_req="write")
+    exe1.forward(is_train=True)
+    exe1.backward(head)
+    onp.testing.assert_allclose(exe1.grad_dict["fc1_weight"].asnumpy(), g0,
+                                rtol=1e-4, atol=1e-6)
+
+
+def test_min_size_rejects_small_groups():
+    out = _mlp()
+    subgraph.register_backend(subgraph.SubgraphBackend(
+        "bigonly", min_size=100))
+    part = subgraph.optimize_for(out, "bigonly")
+    assert [n.op for n in part._topo() if not n.is_var] == \
+        [n.op for n in out._topo() if not n.is_var]
+
+
+def test_unknown_backend_raises():
+    import pytest
+    with pytest.raises(mx.MXNetError, match="unknown subgraph backend"):
+        subgraph.optimize_for(_mlp(), "no_such_backend")
