@@ -84,19 +84,46 @@ def full_like(a, fill_value):
 # ---------------------------------------------------------------------------
 # hand-written wrappers (stateful / variadic / writeback semantics)
 # ---------------------------------------------------------------------------
-def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
-              fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
-              **kwargs):
+def _bn_writeback(op_name, data, gamma, beta, moving_mean, moving_var,
+                  use_global_stats, **attrs):
+    """Shared wrapper for the BatchNorm family: train-mode detection + the
+    moving-stat aux write-back discipline (in-op mutation in the reference)."""
     from .. import autograd, tracing
     training = autograd.is_training() and not use_global_stats
     out, new_mean, new_var = _apply_op(
-        "BatchNorm", data, gamma, beta, moving_mean, moving_var, eps=eps,
-        momentum=momentum, fix_gamma=fix_gamma, use_global_stats=use_global_stats,
-        axis=axis, training=training)
+        op_name, data, gamma, beta, moving_mean, moving_var,
+        use_global_stats=use_global_stats, training=training, **attrs)
     if training:
         tracing.write_aux(moving_mean, new_mean.data)
         tracing.write_aux(moving_var, new_var.data)
     return out
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+              fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
+              **kwargs):
+    return _bn_writeback("BatchNorm", data, gamma, beta, moving_mean,
+                         moving_var, use_global_stats, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma, axis=axis)
+
+
+def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                  momentum=0.9, fix_gamma=True, use_global_stats=False,
+                  output_mean_var=False, ndev=1, key="", axis_name=None,
+                  **kwargs):
+    """Cross-device BatchNorm (contrib/sync_batch_norm.cc)."""
+    return _bn_writeback("SyncBatchNorm", data, gamma, beta, moving_mean,
+                         moving_var, use_global_stats, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma, ndev=ndev,
+                         key=key, axis_name=axis_name)
+
+
+def BatchNormWithReLU(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                      momentum=0.9, fix_gamma=True, use_global_stats=False,
+                      axis=1, **kwargs):
+    return _bn_writeback("BatchNormWithReLU", data, gamma, beta, moving_mean,
+                         moving_var, use_global_stats, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma, axis=axis)
 
 
 def Dropout(data, p=0.5, mode="training", axes=(), **kwargs):
@@ -218,7 +245,11 @@ def _install_wrappers():
                         ("Deconvolution", "Deconvolution"), ("LayerNorm", "LayerNorm"),
                         ("InstanceNorm", "InstanceNorm"), ("GroupNorm", "GroupNorm"),
                         ("L2Normalization", "L2Normalization"), ("LeakyReLU", "leaky_relu"),
-                        ("UpSampling", "UpSampling"), ("CTCLoss", "CTCLoss")]:
+                        ("UpSampling", "UpSampling"), ("CTCLoss", "CTCLoss"),
+                        ("SliceChannel", "split"), ("SwapAxis", "swapaxes"),
+                        ("Cast", "cast"), ("Pad", "pad"),
+                        ("stop_gradient", "BlockGrad"),
+                        ("make_loss", "identity")]:
         if not hasattr(_this, legacy) and hasattr(_this, new):
             setattr(_this, legacy, getattr(_this, new))
 

@@ -6,6 +6,7 @@ from . import elemwise  # noqa: F401
 from . import tensor    # noqa: F401
 from . import nn        # noqa: F401
 from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 from . import contrib   # noqa: F401
 from . import pallas    # noqa: F401
 from . import quantization  # noqa: F401

@@ -303,10 +303,13 @@ def softmax_cross_entropy(data, label):
 @register("BatchNorm", jit=True)
 def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
-               cudnn_off=False, training=False):
+               cudnn_off=False, training=False, axis_name=None):
     """BatchNorm (nn/batch_norm.cc). Returns (out, new_moving_mean, new_moving_var);
     stat write-back is handled by the caller (gluon layer / nd wrapper) — the
-    functional formulation of the reference's in-op aux-state mutation."""
+    functional formulation of the reference's in-op aux-state mutation.
+
+    ``axis_name``: when set and tracing inside shard_map/pmap, batch moments
+    are averaged across that mesh axis (lax.pmean) — the SyncBatchNorm hook."""
     acc = jnp.float32
     xa = x.astype(acc)
     red = tuple(i for i in range(x.ndim) if i != axis)
@@ -316,7 +319,13 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.
         gamma = jnp.ones_like(gamma)
     if training and not use_global_stats:
         mean = jnp.mean(xa, axis=red)
-        var = jnp.mean(jnp.square(xa - mean.reshape(bshape)), axis=red)
+        if axis_name is not None:
+            # cross-device moments via E[x^2] - E[x]^2 (one pmean pair)
+            sq = lax.pmean(jnp.mean(jnp.square(xa), axis=red), axis_name)
+            mean = lax.pmean(mean, axis_name)
+            var = sq - jnp.square(mean)
+        else:
+            var = jnp.mean(jnp.square(xa - mean.reshape(bshape)), axis=red)
         new_mean = momentum * moving_mean.astype(acc) + (1 - momentum) * mean
         new_var = momentum * moving_var.astype(acc) + (1 - momentum) * var
     else:

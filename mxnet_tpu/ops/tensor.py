@@ -530,3 +530,174 @@ def linalg_det(a):
 def linalg_slogdet(a):
     sign, logdet = jnp.linalg.slogdet(a)
     return sign, logdet
+
+
+@register("linalg_potri")
+def linalg_potri(a):
+    """Inverse of the SPD matrix whose Cholesky factor is ``a`` (la_op.cc
+    potri): (a a^T)^-1 via two triangular solves — one MXU-friendly
+    batched trsm pair instead of an explicit inverse."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_syevd")
+def linalg_syevd(a):
+    """Symmetric eigendecomposition (la_op.cc syevd): returns (U, L) with
+    rows of U the eigenvectors (reference layout: a = U^T diag(L) U)."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_gelqf")
+def linalg_gelqf(a):
+    """LQ factorization of a full-rank wide matrix (la_op.cc gelqf):
+    a = L Q with Q orthonormal rows — the QR of a^T transposed."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_extracttrian")
+def linalg_extracttrian(a, *, offset=0, lower=True):
+    """Pack the triangular part of each matrix into a vector (la_op.cc
+    ExtractTrian): row-major walk over the kept triangle."""
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return a[..., rows, cols]
+
+
+@register("linalg_maketrian")
+def linalg_maketrian(a, *, offset=0, lower=True):
+    """Unpack a vector into a triangular matrix (la_op.cc MakeTrian),
+    inverse of linalg_extracttrian for the same offset/lower."""
+    m = a.shape[-1]
+    # m packs the triangle of side (n - |offset|): T(s) = s(s+1)/2 = m
+    s = int(round((-1 + (1 + 8 * m) ** 0.5) / 2))
+    n = s + abs(offset)
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+# ---------------------------------------------------------------------------
+# misc tensor ops (matrix_op.cc / histogram.cc / ravel.cc / im2col.h)
+# ---------------------------------------------------------------------------
+@register("histogram", differentiable=False)
+def histogram(data, bins=None, *, bin_cnt=None, range=None):
+    """Histogram counts (histogram.cc): either explicit bin edges or
+    (bin_cnt, range). Counts are integer like the reference's int64 output
+    (int32 here — the widest integer with jax x64 disabled)."""
+    if bins is not None:
+        counts, edges = jnp.histogram(data, bins=bins)
+    else:
+        counts, edges = jnp.histogram(data, bins=int(bin_cnt), range=range)
+    return counts.astype(jnp.int32), edges
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, *, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape (matrix_op.cc BroadcastLike); with axes
+    given, only those axes take rhs's extent."""
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    target = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        target[la % lhs.ndim] = rhs.shape[ra % rhs.ndim]
+    return jnp.broadcast_to(lhs, tuple(target))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    """Reshape lhs to rhs's shape over the selected axis windows
+    (matrix_op.cc ReshapeLike)."""
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = 0 if lhs_begin is None else lhs_begin % (lhs.ndim + 1)
+    le = lhs.ndim if lhs_end is None else lhs_end % (lhs.ndim + 1)
+    rb = 0 if rhs_begin is None else rhs_begin % (rhs.ndim + 1)
+    re_ = rhs.ndim if rhs_end is None else rhs_end % (rhs.ndim + 1)
+    target = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, target)
+
+
+@register("ravel_multi_index", differentiable=False)
+def ravel_multi_index(data, *, shape):
+    """(ndim, N) coordinates -> flat indices (ravel.cc)."""
+    coords = [data[i].astype(jnp.int32) for i in range(len(shape))]
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = list(reversed(strides))
+    flat = sum(c * st for c, st in zip(coords, strides))
+    return flat.astype(data.dtype)
+
+
+@register("unravel_index", differentiable=False)
+def unravel_index(data, *, shape):
+    """Flat indices -> (ndim, N) coordinates (ravel.cc UnravelIndex)."""
+    coords = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack([c.astype(data.dtype) for c in coords], axis=0)
+
+
+def _slice_tuple(shape, begin, end, step=None):
+    step = step if step else (None,) * len(begin)
+    idx = []
+    for i, (b, e) in enumerate(zip(begin, end)):
+        s = step[i] if i < len(step) else None
+        idx.append(slice(b, e, s))
+    return tuple(idx)
+
+
+@register("slice_assign")
+def slice_assign(lhs, rhs, *, begin, end, step=None):
+    """Write rhs into lhs[begin:end:step] (matrix_op.cc _slice_assign) —
+    functional: returns the updated array (XLA scatter)."""
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("slice_assign_scalar")
+def slice_assign_scalar(lhs, *, scalar, begin, end, step=None):
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(scalar)
+
+
+@register("im2col")
+def im2col(data, *, kernel, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction (im2col.h): NCHW input ->
+    (N, C*prod(kernel), L) patch matrix. One XLA patch-gather, the matmul
+    side of convolution-as-GEMM."""
+    kh, kw = kernel
+    sh, sw = stride or (1, 1)
+    dh, dw = dilate or (1, 1)
+    ph, pw = pad or (0, 0)
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)), rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n = data.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, *, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Scatter-accumulate patches back to an image (im2col.h col2im): the
+    exact adjoint of im2col, taken as its XLA-transposed VJP."""
+    oh, ow = output_size
+    c = data.shape[1] // (kernel[0] * kernel[1])
+    ref = jnp.zeros((data.shape[0], c, oh, ow), data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: im2col(x, kernel=kernel, stride=stride, dilate=dilate,
+                         pad=pad), ref)
+    return vjp(data)[0]
+
+
+@register("BlockGrad")
+def BlockGrad(x):
+    """Identity forward, zero gradient (tensor/elemwise_unary_op_basic.cc
+    BlockGrad / stop_gradient)."""
+    return lax.stop_gradient(x)

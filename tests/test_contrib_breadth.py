@@ -1,0 +1,190 @@
+"""New contrib op coverage: SyncBatchNorm, AdaptiveAvgPooling2D,
+DeformableConvolution, Proposal, allclose, bipartite_matching, graph ops
+(parity patterns: tests/python/unittest/test_contrib_operator.py,
+test_operator.py test_deformable_convolution, gpu/test_operator_gpu.py
+test_sync_batchnorm)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_sync_batch_norm_single_device_matches_bn():
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(0)
+    x = rng.rand(4, 3, 5, 5).astype("float32")
+    g = onp.ones(3, "float32"); b = onp.zeros(3, "float32")
+    mm = onp.zeros(3, "float32"); mv = onp.ones(3, "float32")
+    args = [nd.array(t) for t in (x, g, b, mm, mv)]
+    args2 = [nd.array(t) for t in (x, g, b, mm, mv)]
+    with autograd.record():
+        out_s = nd.SyncBatchNorm(*args, fix_gamma=False, eps=1e-3)
+        out_b = nd.BatchNorm(*args2, fix_gamma=False, eps=1e-3)
+    onp.testing.assert_allclose(out_s.asnumpy(), out_b.asnumpy(), atol=1e-4)
+    # moving stats written back identically
+    onp.testing.assert_allclose(args[3].asnumpy(), args2[3].asnumpy(),
+                                atol=1e-6)
+
+
+def test_sync_batch_norm_cross_device_stats():
+    """Under shard_map over the 8-device mesh, moments must be GLOBAL batch
+    moments — each shard normalized by the full-batch mean/var."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from mxnet_tpu.ops.contrib import sync_batch_norm
+
+    devs = jax.devices()[:8]
+    mesh = Mesh(onp.array(devs), ("dp",))
+    rng = onp.random.RandomState(1)
+    x = rng.rand(16, 4, 3, 3).astype("float32")
+    g = onp.ones(4, "float32"); b = onp.zeros(4, "float32")
+    mm = onp.zeros(4, "float32"); mv = onp.ones(4, "float32")
+
+    def f(x, g, b, mm, mv):
+        out, nm, nv = sync_batch_norm(x, g, b, mm, mv, training=True,
+                                      fix_gamma=False, axis_name="dp")
+        return out, nm, nv
+
+    fm = shard_map(f, mesh=mesh,
+                   in_specs=(P("dp"), P(), P(), P(), P()),
+                   out_specs=(P("dp"), P(), P()))
+    out, nm, nv = jax.jit(fm)(x, g, b, mm, mv)
+    # global-batch oracle: plain BN over the unsharded batch
+    want, wm, wv = sync_batch_norm(jnp.asarray(x), jnp.asarray(g),
+                                   jnp.asarray(b), jnp.asarray(mm),
+                                   jnp.asarray(mv), training=True,
+                                   fix_gamma=False)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(want), atol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(nm), onp.asarray(wm), atol=1e-5)
+
+
+def test_adaptive_avg_pooling2d():
+    x = nd.array(onp.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+    out = nd.AdaptiveAvgPooling2D(x, output_size=2)
+    assert out.shape == (1, 1, 2, 2)
+    want = x.asnumpy().reshape(2, 3, 2, 3).mean(axis=(1, 3)).reshape(1, 1, 2, 2)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    # global pool
+    out1 = nd.AdaptiveAvgPooling2D(x, output_size=1)
+    onp.testing.assert_allclose(out1.asnumpy().ravel(), [17.5], rtol=1e-6)
+
+
+def test_allclose_op():
+    a = nd.array(onp.ones((3,), "float32"))
+    b = nd.array(onp.ones((3,), "float32") + 1e-9)
+    assert float(nd.allclose(a, b).asnumpy()) == 1.0
+    c = nd.array(onp.array([1.0, 2.0, 3.5], "float32"))
+    assert float(nd.allclose(a, c).asnumpy()) == 0.0
+
+
+def test_bipartite_matching():
+    d = nd.array(onp.array([[2.0, 0.1], [0.5, 1.5]], "float32"))
+    rows, cols = nd.bipartite_matching(d, threshold=0.2)
+    onp.testing.assert_array_equal(rows.asnumpy(), [0, 1])
+    onp.testing.assert_array_equal(cols.asnumpy(), [0, 1])
+    # high threshold: only the 2.0 edge survives
+    rows2, cols2 = nd.bipartite_matching(d, threshold=1.8)
+    onp.testing.assert_array_equal(rows2.asnumpy(), [0, -1])
+    onp.testing.assert_array_equal(cols2.asnumpy(), [0, -1])
+
+
+def test_edge_id_and_adjacency():
+    # graph: 0->1, 0->2, 1->2 with edge ids 0,1,2
+    indptr = nd.array(onp.array([0, 2, 3, 3], "float32"))
+    indices = nd.array(onp.array([1, 2, 2], "float32"))
+    data = nd.array(onp.array([0, 1, 2], "float32"))
+    u = nd.array(onp.array([0, 0, 1, 2], "float32"))
+    v = nd.array(onp.array([1, 2, 2, 0], "float32"))
+    out = nd.edge_id(indptr, indices, data, u, v).asnumpy()
+    onp.testing.assert_array_equal(out, [0, 1, 2, -1])
+    adj = nd.dgl_adjacency(indptr, indices).asnumpy()
+    want = onp.zeros((3, 3), "float32")
+    want[0, 1] = want[0, 2] = want[1, 2] = 1
+    onp.testing.assert_array_equal(adj, want)
+
+
+def test_dgl_neighbor_sampling():
+    indptr = nd.array(onp.array([0, 2, 3, 3], "float32"))
+    indices = nd.array(onp.array([1, 2, 2], "float32"))
+    seeds = nd.array(onp.array([0], "float32"))
+    verts, n = nd.dgl_csr_neighbor_uniform_sample(
+        indptr, indices, seeds, num_neighbor=2, max_num_vertices=8)
+    verts = verts.asnumpy()
+    assert verts[0] == 0 and int(n.asnumpy()[0]) == 3
+    assert set(verts[1:3].astype(int)) == {1, 2}
+    prob = nd.array(onp.array([0.0, 1.0, 0.0], "float32"))
+    verts2, n2 = nd.dgl_csr_neighbor_non_uniform_sample(
+        prob, indptr, indices, seeds, num_neighbor=1, max_num_vertices=8)
+    # only vertex 1 has nonzero probability among 0's neighbors
+    assert verts2.asnumpy()[1] == 1
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    """With zero offsets, deformable conv must equal plain convolution."""
+    rng = onp.random.RandomState(2)
+    x = rng.rand(2, 3, 7, 7).astype("float32")
+    w = rng.rand(4, 3, 3, 3).astype("float32")
+    off = onp.zeros((2, 2 * 9, 5, 5), "float32")
+    out = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                   kernel=(3, 3), num_filter=4, no_bias=True)
+    want = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                          num_filter=4, no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_deformable_convolution_integer_shift():
+    """Integer offset (0, 1) shifts sampling one pixel right: equals plain
+    conv on the shifted image (interior columns)."""
+    rng = onp.random.RandomState(3)
+    x = rng.rand(1, 2, 6, 6).astype("float32")
+    w = rng.rand(2, 2, 3, 3).astype("float32")
+    off = onp.zeros((1, 2 * 9, 4, 4), "float32")
+    off[:, 1::2] = 1.0  # x-offset = +1 for every kernel point
+    out = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                   kernel=(3, 3), num_filter=2, no_bias=True)
+    want = nd.Convolution(nd.array(x[:, :, :, 1:]), nd.array(w),
+                          kernel=(3, 3), num_filter=2, no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy()[..., :3],
+                                want.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_grad_flows():
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(4)
+    x = nd.array(rng.rand(1, 2, 5, 5).astype("float32"))
+    w = nd.array(rng.rand(2, 2, 3, 3).astype("float32"))
+    off = nd.array(onp.zeros((1, 18, 3, 3), "float32"))
+    for t in (x, w, off):
+        t.attach_grad()
+    with autograd.record():
+        out = nd.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                       num_filter=2, no_bias=True)
+        out.sum().backward()
+    assert float(onp.abs(x.grad.asnumpy()).sum()) > 0
+    assert float(onp.abs(w.grad.asnumpy()).sum()) > 0
+    assert off.grad is not None
+
+
+def test_proposal_shapes_and_clip():
+    rng = onp.random.RandomState(5)
+    n, na, fh, fw = 1, 12, 4, 4
+    cls_prob = nd.array(rng.rand(n, 2 * na, fh, fw).astype("float32"))
+    bbox_pred = nd.array((rng.rand(n, 4 * na, fh, fw).astype("float32") - 0.5)
+                         * 0.1)
+    im_info = nd.array(onp.array([[64.0, 64.0, 1.0]], "float32"))
+    rois, scores = nd.Proposal(cls_prob, bbox_pred, im_info,
+                               rpn_pre_nms_top_n=32, rpn_post_nms_top_n=8,
+                               threshold=0.7, rpn_min_size=4,
+                               output_score=True)
+    assert rois.shape == (8, 5)
+    assert scores.shape == (8, 1)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()                      # batch index
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()  # clipped
+    # scores sorted descending where valid
+    s = scores.asnumpy().ravel()
+    assert (onp.diff(s[s > 0]) <= 1e-6).all()

@@ -79,3 +79,120 @@ def test_sequence_mask_axis1():
     o = out.asnumpy()
     assert (o[0, :3] == 1).all() and (o[0, 3:] == -1).all()
     assert (o[1, :1] == 1).all() and (o[1, 1:] == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# misc tensor ops (matrix_op.cc / histogram.cc / ravel.cc / im2col.h)
+# ---------------------------------------------------------------------------
+def test_histogram():
+    d = nd.array(onp.array([0.1, 0.4, 0.6, 0.9, 0.95], "float32"))
+    counts, edges = nd.histogram(d, bin_cnt=2, range=(0.0, 1.0))
+    onp.testing.assert_array_equal(counts.asnumpy(), [2, 3])
+    onp.testing.assert_allclose(edges.asnumpy(), [0.0, 0.5, 1.0])
+    bins = nd.array(onp.array([0.0, 0.5, 1.0], "float32"))
+    counts2, _ = nd.histogram(d, bins)
+    onp.testing.assert_array_equal(counts2.asnumpy(), [2, 3])
+
+
+def test_broadcast_reshape_like():
+    a = nd.array(onp.ones((1, 3), "float32"))
+    b = nd.array(onp.zeros((2, 3), "float32"))
+    assert nd.broadcast_like(a, b).shape == (2, 3)
+    c = nd.array(onp.arange(6, dtype="float32").reshape(6,))
+    assert nd.reshape_like(c, b).shape == (2, 3)
+    # windowed form: reshape lhs axes [0,1) to rhs axes [0,2)
+    d = nd.array(onp.arange(6, dtype="float32"))
+    out = nd.reshape_like(d, b, lhs_begin=0, lhs_end=1, rhs_begin=0, rhs_end=2)
+    assert out.shape == (2, 3)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = onp.array([0, 7, 59, 23], "int32")
+    coords = nd.unravel_index(nd.array(flat.astype("float32")), shape=shape)
+    back = nd.ravel_multi_index(coords, shape=shape)
+    onp.testing.assert_array_equal(back.asnumpy().astype("int64"), flat)
+    onp.testing.assert_array_equal(
+        coords.asnumpy().astype("int64"),
+        onp.stack(onp.unravel_index(flat, shape)))
+
+
+def test_slice_assign():
+    x = nd.zeros((4, 4))
+    y = nd.slice_assign(x, nd.ones((2, 2)), begin=(1, 1), end=(3, 3))
+    want = onp.zeros((4, 4)); want[1:3, 1:3] = 1
+    onp.testing.assert_array_equal(y.asnumpy(), want)
+    z = nd.slice_assign_scalar(x, scalar=5.0, begin=(0, 0), end=(1, 4))
+    assert z.asnumpy()[0].tolist() == [5.0] * 4
+
+
+def test_im2col_col2im_adjoint():
+    rng = onp.random.RandomState(3)
+    x = nd.array(rng.rand(2, 3, 5, 5).astype("float32"))
+    cols = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert cols.shape == (2, 3 * 9, 25)
+    # col2im(im2col(x)) multiplies each pixel by its patch multiplicity;
+    # interior pixels of a 3x3/s1/p1 window appear 9 times
+    back = nd.col2im(cols, output_size=(5, 5), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    onp.testing.assert_allclose(back.asnumpy()[:, :, 2, 2],
+                                x.asnumpy()[:, :, 2, 2] * 9, rtol=1e-5)
+
+
+def test_legacy_aliases_and_blockgrad():
+    x = nd.array(onp.arange(8, dtype="float32").reshape(2, 4))
+    parts = nd.SliceChannel(x, num_outputs=2, axis=1)
+    assert parts[0].shape == (2, 2)
+    assert nd.SwapAxis(x, dim1=0, dim2=1).shape == (4, 2)
+    assert nd.Cast(x, dtype="float16").dtype == onp.float16
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.BlockGrad(x) * 2 + x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.ones((2, 4)))
+
+
+# ---------------------------------------------------------------------------
+# linalg completions (la_op.cc)
+# ---------------------------------------------------------------------------
+def test_linalg_syevd():
+    rng = onp.random.RandomState(5)
+    m = rng.rand(4, 4).astype("float32")
+    a = (m + m.T) / 2
+    u, lam = nd.linalg_syevd(nd.array(a))
+    u, lam = u.asnumpy(), lam.asnumpy()
+    # rows of u are eigenvectors: a = u^T diag(lam) u
+    onp.testing.assert_allclose(u.T @ onp.diag(lam) @ u, a, atol=1e-4)
+
+
+def test_linalg_gelqf():
+    rng = onp.random.RandomState(6)
+    a = rng.rand(3, 5).astype("float32")
+    l, q = nd.linalg_gelqf(nd.array(a))
+    l, q = l.asnumpy(), q.asnumpy()
+    onp.testing.assert_allclose(l @ q, a, atol=1e-5)
+    onp.testing.assert_allclose(q @ q.T, onp.eye(3), atol=1e-5)
+    assert onp.allclose(l, onp.tril(l))
+
+
+def test_linalg_potri():
+    rng = onp.random.RandomState(7)
+    m = rng.rand(4, 4).astype("float32")
+    spd = m @ m.T + 4 * onp.eye(4, dtype="float32")
+    chol = onp.linalg.cholesky(spd)
+    inv = nd.linalg_potri(nd.array(chol)).asnumpy()
+    onp.testing.assert_allclose(inv, onp.linalg.inv(spd), atol=1e-4)
+
+
+def test_linalg_trian_roundtrip():
+    rng = onp.random.RandomState(8)
+    a = onp.tril(rng.rand(4, 4)).astype("float32")
+    packed = nd.linalg_extracttrian(nd.array(a))
+    assert packed.shape == (10,)
+    back = nd.linalg_maketrian(packed).asnumpy()
+    onp.testing.assert_allclose(back, a, rtol=1e-6)
+    # offset variant
+    p2 = nd.linalg_extracttrian(nd.array(a), offset=-1)
+    assert p2.shape == (6,)
+    b2 = nd.linalg_maketrian(p2, offset=-1).asnumpy()
+    onp.testing.assert_allclose(b2, onp.tril(a, -1), rtol=1e-6)

@@ -1,0 +1,218 @@
+"""Op-level optimizer updates vs the Optimizer classes / numpy oracles
+(parity pattern: tests/python/unittest/test_optimizer.py compares python
+reference implementations against the registered update ops)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _rand(shape, seed, dtype="float32"):
+    return onp.random.RandomState(seed).rand(*shape).astype(dtype)
+
+
+def test_sgd_update_matches_numpy():
+    w, g = _rand((3, 4), 0), _rand((3, 4), 1)
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                        rescale_grad=0.5)
+    want = w - 0.1 * (0.5 * g + 0.01 * w)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+
+
+def test_sgd_mom_update_trajectory_matches_class():
+    w0, g = _rand((5,), 2), _rand((5,), 3)
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    wc = nd.array(w0)
+    state = opt.create_state(0, wc)
+    w_op, m_op = nd.array(w0), nd.zeros((5,))
+    for _ in range(3):
+        opt.update(0, wc, nd.array(g), state)
+        w_op, m_op = nd.sgd_mom_update(w_op, nd.array(g), m_op, lr=0.1,
+                                       momentum=0.9)
+    onp.testing.assert_allclose(w_op.asnumpy(), wc.asnumpy(), rtol=1e-5)
+
+
+def test_clip_gradient_applies_before_wd():
+    w = onp.ones((4,), "float32")
+    g = onp.full((4,), 10.0, "float32")
+    out = nd.sgd_update(nd.array(w), nd.array(g), lr=1.0, wd=0.0,
+                        clip_gradient=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), w - 1.0, rtol=1e-6)
+
+
+def test_adam_update_no_bias_correction():
+    w, g = _rand((3,), 4), _rand((3,), 5)
+    m = onp.zeros(3, "float32")
+    v = onp.zeros(3, "float32")
+    nw, nm, nv = nd.adam_update(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), lr=0.01)
+    em = 0.1 * g
+    ev = 0.001 * g * g
+    ew = w - 0.01 * em / (onp.sqrt(ev) + 1e-8)
+    onp.testing.assert_allclose(nm.asnumpy(), em, rtol=1e-5)
+    onp.testing.assert_allclose(nv.asnumpy(), ev, rtol=1e-5)
+    onp.testing.assert_allclose(nw.asnumpy(), ew, rtol=1e-5)
+
+
+def test_adamw_decoupled_wd():
+    w, g = _rand((3,), 6), onp.zeros(3, "float32")
+    m = v = onp.zeros(3, "float32")
+    nw, _, _ = nd.adamw_update(nd.array(w), nd.array(g), nd.array(m),
+                               nd.array(v), lr=0.1, eta=1.0, wd=0.5)
+    onp.testing.assert_allclose(nw.asnumpy(), w - 0.1 * 0 - 0.5 * w * 1.0,
+                                rtol=1e-5)
+
+
+def test_mp_sgd_update_master_weights():
+    w16 = _rand((4,), 7, "float16")
+    g16 = _rand((4,), 8, "float16")
+    w32 = w16.astype("float32")
+    nw, nw32 = nd.mp_sgd_update(nd.array(w16), nd.array(g16), nd.array(w32),
+                                lr=0.1)
+    assert nw.dtype == onp.float16 and nw32.dtype == onp.float32
+    onp.testing.assert_allclose(nw32.asnumpy(),
+                                w32 - 0.1 * g16.astype("float32"), rtol=1e-3)
+
+
+def test_ftrl_update_matches_class():
+    w0, g = _rand((6,), 9), _rand((6,), 10)
+    opt = mx.optimizer.Ftrl(learning_rate=0.1, lamda1=0.01, beta=1.0, wd=0.0)
+    wc = nd.array(w0)
+    state = opt.create_state(0, wc)
+    w_op = nd.array(w0)
+    z = nd.zeros((6,)); n = nd.zeros((6,))
+    for _ in range(2):
+        opt.update(0, wc, nd.array(g), state)
+        w_op, z, n = nd.ftrl_update(w_op, nd.array(g), z, n, lr=0.1,
+                                    lamda1=0.01, beta=1.0)
+    onp.testing.assert_allclose(w_op.asnumpy(), wc.asnumpy(), rtol=1e-5,
+                                atol=1e-7)
+
+
+def test_rmspropalex_centered_matches_class():
+    w0, g = _rand((4,), 11), _rand((4,), 12)
+    opt = mx.optimizer.RMSProp(learning_rate=0.05, rho=0.95, momentum=0.9,
+                               centered=True, wd=0.0)
+    wc = nd.array(w0)
+    state = opt.create_state(0, wc)
+    w_op = nd.array(w0)
+    n = nd.zeros((4,)); ga = nd.zeros((4,)); delta = nd.zeros((4,))
+    for _ in range(3):
+        opt.update(0, wc, nd.array(g), state)
+        w_op, n, ga, delta = nd.rmspropalex_update(
+            w_op, nd.array(g), n, ga, delta, lr=0.05, gamma1=0.95,
+            gamma2=0.9)
+    onp.testing.assert_allclose(w_op.asnumpy(), wc.asnumpy(), rtol=1e-4)
+
+
+def test_lamb_two_phase_matches_class():
+    w0, g = _rand((8,), 13), _rand((8,), 14)
+    opt = mx.optimizer.LAMB(learning_rate=0.01, wd=0.1)
+    wc = nd.array(w0)
+    state = opt.create_state(0, wc)
+    opt.update(0, wc, nd.array(g), state)
+    gp, m, v = nd.lamb_update_phase1(nd.array(w0), nd.array(g),
+                                     nd.zeros((8,)), nd.zeros((8,)),
+                                     t=1, wd=0.1)
+    import numpy.linalg as la
+    r1 = nd.array(onp.array(la.norm(w0), "float32"))
+    r2 = nd.array(onp.array(la.norm(gp.asnumpy()), "float32"))
+    w_op = nd.lamb_update_phase2(nd.array(w0), gp, r1, r2, lr=0.01)
+    onp.testing.assert_allclose(w_op.asnumpy(), wc.asnumpy(), rtol=1e-5)
+
+
+def test_group_adagrad_row_sharing():
+    w = _rand((3, 4), 15)
+    g = _rand((3, 4), 16)
+    hist = onp.zeros((3,), "float32")
+    nw, nh = nd.group_adagrad_update(nd.array(w), nd.array(g),
+                                     nd.array(hist), lr=0.1)
+    want_h = (g ** 2).mean(axis=1)
+    onp.testing.assert_allclose(nh.asnumpy(), want_h, rtol=1e-5)
+    want_w = w - 0.1 * g / (onp.sqrt(want_h)[:, None] + 1e-5)
+    onp.testing.assert_allclose(nw.asnumpy(), want_w, rtol=1e-5)
+
+
+def test_sparse_adagrad_only_touches_rows():
+    w = _rand((5, 3), 17)
+    gv = _rand((2, 3), 18)
+    hist = onp.zeros((5, 3), "float32")
+    idx = onp.array([1, 3], "float32")
+    nw, nh = nd.sparse_adagrad_update(nd.array(w), nd.array(gv),
+                                      nd.array(idx), nd.array(hist), lr=0.1)
+    nw, nh = nw.asnumpy(), nh.asnumpy()
+    onp.testing.assert_array_equal(nw[[0, 2, 4]], w[[0, 2, 4]])
+    assert not onp.allclose(nw[[1, 3]], w[[1, 3]])
+    onp.testing.assert_allclose(nh[[1, 3]], gv ** 2, rtol=1e-6)
+
+
+def test_multi_sgd_mom_update_fused():
+    ws = [_rand((3,), 20 + i) for i in range(2)]
+    gs = [_rand((3,), 30 + i) for i in range(2)]
+    ms = [onp.zeros(3, "float32") for _ in range(2)]
+    flat = []
+    for w, g, m in zip(ws, gs, ms):
+        flat += [nd.array(w), nd.array(g), nd.array(m)]
+    outs = nd.multi_sgd_mom_update(*flat, lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                                   momentum=0.9, num_weights=2)
+    assert len(outs) == 4
+    for i in range(2):
+        single_w, single_m = nd.sgd_mom_update(
+            nd.array(ws[i]), nd.array(gs[i]), nd.array(ms[i]),
+            lr=(0.1, 0.2)[i], momentum=0.9)
+        onp.testing.assert_allclose(outs[2 * i].asnumpy(),
+                                    single_w.asnumpy(), rtol=1e-6)
+
+
+def test_preloaded_multi_sgd_lrs_as_tensor():
+    ws = [_rand((3,), 40 + i) for i in range(2)]
+    gs = [_rand((3,), 50 + i) for i in range(2)]
+    flat = []
+    for w, g in zip(ws, gs):
+        flat += [nd.array(w), nd.array(g)]
+    lrs = nd.array(onp.array([0.1, 0.2], "float32"))
+    wds = nd.zeros((2,))
+    outs = nd.preloaded_multi_sgd_update(*flat, lrs, wds, num_weights=2)
+    for i in range(2):
+        want = ws[i] - (0.1, 0.2)[i] * gs[i]
+        onp.testing.assert_allclose(outs[i].asnumpy(), want, rtol=1e-6)
+
+
+def test_multi_lars_rates():
+    lrs = onp.array([0.1, 0.1], "float32")
+    w2 = onp.array([4.0, 0.0], "float32")   # ||w|| = 2, 0
+    g2 = onp.array([1.0, 1.0], "float32")   # ||g|| = 1
+    wds = onp.array([0.0, 0.0], "float32")
+    out = nd.multi_lars(nd.array(lrs), nd.array(w2), nd.array(g2),
+                        nd.array(wds), eta=0.001, eps=0.0).asnumpy()
+    onp.testing.assert_allclose(out[0], 0.1 * 0.001 * 2.0, rtol=1e-6)
+    onp.testing.assert_allclose(out[1], 0.1, rtol=1e-6)  # degenerate: passthrough
+
+
+def test_multi_lamb_matches_two_phase():
+    w, g = _rand((6,), 60), _rand((6,), 61)
+    m = v = onp.zeros(6, "float32")
+    outs = nd.multi_lamb_update(nd.array(w), nd.array(g), nd.array(m),
+                                nd.array(v), lrs=(0.01,), wds=(0.1,),
+                                num_weights=1, step_count=(1,))
+    gp, _, _ = nd.lamb_update_phase1(nd.array(w), nd.array(g), nd.array(m),
+                                     nd.array(v), t=1, wd=0.1)
+    r1 = nd.array(onp.array(onp.linalg.norm(w), "float32"))
+    r2 = nd.array(onp.array(onp.linalg.norm(gp.asnumpy()), "float32"))
+    want = nd.lamb_update_phase2(nd.array(w), gp, r1, r2, lr=0.01)
+    onp.testing.assert_allclose(outs[0].asnumpy(), want.asnumpy(), rtol=1e-6)
+
+
+def test_signum_and_nag():
+    w, g = _rand((4,), 70), _rand((4,), 71)
+    m = onp.zeros(4, "float32")
+    nw, nm = nd.signum_update(nd.array(w), nd.array(g), nd.array(m), lr=0.1,
+                              momentum=0.9)
+    onp.testing.assert_allclose(nm.asnumpy(), -0.1 * g, rtol=1e-6)
+    onp.testing.assert_allclose(nw.asnumpy(), w + 0.1 * onp.sign(-0.1 * g),
+                                rtol=1e-6)
+    nw2, nm2 = nd.nag_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                 lr=0.1, momentum=0.9)
+    onp.testing.assert_allclose(nw2.asnumpy(), w - 0.1 * (g + 0.9 * g),
+                                rtol=1e-6)
