@@ -97,3 +97,10 @@ register("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True, bool,
          "Log when a sparse op densifies an operand (executor fallback log).")
 register("MXNET_HOME", os.path.join("~", ".mxnet"), str,
          "Root for datasets/model downloads.")
+register("MXNET_KVSTORE_ASYNC_AVG_PERIOD", 16, int,
+         "dist_async: pushes per key between parameter-averaging allreduces.")
+register("MXNET_KVSTORE_HEARTBEAT_DIR", "", str,
+         "Shared dir for worker heartbeat files (ps-lite heartbeat analog); "
+         "empty disables failure detection.")
+register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 5, int,
+         "Seconds between heartbeat file touches.")

@@ -8,10 +8,22 @@ TPU-native mapping (SURVEY.md §2.3):
     jax.sharding.Mesh the reduction lowers to an ICI AllReduce inside one jitted
     computation (see mxnet_tpu.parallel for the in-program pjit path, which is how
     multi-chip training actually runs).
-  - 'dist_sync'/'dist_device_sync'/'dist_async'/'p3' (ps-lite parameter server) →
+  - 'dist_sync'/'dist_device_sync'/'p3' (ps-lite parameter server) →
     multi-host collectives over jax.distributed (ICI within slice, DCN across
     hosts); there is no parameter-server process because sync SGD on TPU is
-    allreduce-native. dist_async degrades to sync (documented gap).
+    allreduce-native.
+  - 'dist_async' (ps-lite async push, kvstore_dist.h): the async property is
+    "no waiting on stragglers", not the server. TPU-native mapping: each
+    worker applies its updater to its local replica immediately (zero
+    cross-host traffic on the critical path) and replicas reconcile by
+    periodic parameter averaging (every MXNET_KVSTORE_ASYNC_AVG_PERIOD pushes
+    per key, one allreduce-mean) — the local-SGD formulation of asynchronous
+    PS training. Workers must push each key at the same cadence (true for
+    training loops), matching the reference's assumption that every worker
+    pushes every iteration.
+  - failure detection (ps-lite heartbeat → scheduler dead-node count): each
+    worker touches a heartbeat file under MXNET_KVSTORE_HEARTBEAT_DIR (set by
+    tools/launch.py); num_dead_node counts ranks whose heartbeat is stale.
 """
 from __future__ import annotations
 
@@ -48,6 +60,14 @@ class KVStore(KVStoreBase):
             initialize_distributed()
             import jax
             self._multi_host = jax.process_count() > 1
+            self._async = "async" in kv_type
+            from .. import config
+            self._async_avg_period = config.get(
+                "MXNET_KVSTORE_ASYNC_AVG_PERIOD")
+            self._async_push_count: Dict = {}
+            self._start_heartbeat()
+        else:
+            self._async = False
 
     # -- identity -----------------------------------------------------------
     @property
@@ -121,7 +141,8 @@ class KVStore(KVStoreBase):
         return multihost_utils.global_array_to_host_local_array(
             summed, mesh, P())
 
-    def _reduce(self, values: List[NDArray], key=None) -> NDArray:
+    def _reduce(self, values: List[NDArray], key=None,
+                cross_host=True) -> NDArray:
         """Sum per-device gradients (CommDevice::Reduce analog), then the
         cross-worker reduction when multi-host.
 
@@ -136,7 +157,7 @@ class KVStore(KVStoreBase):
         if any(isinstance(v, BaseSparseNDArray) for v in values):
             if all(isinstance(v, RowSparseNDArray) for v in values):
                 agg = values[0] if len(values) == 1 else add_n(values)
-                if self._multi_host:
+                if self._multi_host and cross_host:
                     # gather (indices, values) parts from every worker, then
                     # one jitted dedup — sparse on the wire, like the
                     # reference's RowSparsePushPull server path.
@@ -186,7 +207,7 @@ class KVStore(KVStoreBase):
                     buf = jax.device_put(buf, next(iter(target.devices())))
                 total = total + buf
             out = total
-        if self._multi_host:
+        if self._multi_host and cross_host:
             from jax.experimental import multihost_utils
             if comp is not None:
                 # only the packed wire tensor (+1-bit scale) crosses hosts:
@@ -206,6 +227,22 @@ class KVStore(KVStoreBase):
         return NDArray(out, ctx=values[0].context)
 
 
+    def _async_maybe_average(self, k):
+        """Periodic parameter averaging for dist_async: one allreduce-mean of
+        the replica every N-th push of this key (local-SGD reconciliation)."""
+        if not (self._async and self._multi_host and self._updater is not None):
+            return
+        cnt = self._async_push_count.get(k, 0) + 1
+        self._async_push_count[k] = cnt
+        if cnt % self._async_avg_period:
+            return
+        from ..sparse import BaseSparseNDArray
+        val = self._store[k]
+        if isinstance(val, BaseSparseNDArray):
+            val = val.todense()
+        avg = self._allreduce_sum(val.data) / self.num_workers
+        self._store[k] = NDArray(avg, ctx=val.context)
+
     def push(self, key, value, priority=0):
         keys, values = _listify(key), _listify(value)
         if len(keys) == 1 and len(values) > 1:
@@ -213,12 +250,19 @@ class KVStore(KVStoreBase):
         from ..sparse import BaseSparseNDArray
         for k, vlist in zip(keys, values):
             vlist = _listify(vlist)
-            agg = self._reduce(vlist, key=k)
+            # dist_async: local gradients only on the critical path — the
+            # cross-host hop happens in _async_maybe_average instead. Without
+            # an updater there is nothing to reconcile later, so the
+            # aggregate-into-store path keeps the synchronous reduce (the
+            # ps-lite server sums across workers in async mode too).
+            local_only = self._async and self._updater is not None
+            agg = self._reduce(vlist, key=k, cross_host=not local_only)
             sparse_agg = isinstance(agg, BaseSparseNDArray)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} not initialized")
                 self._updater(_key_int(k), agg, self._store[k])
+                self._async_maybe_average(k)
             else:
                 if k in self._store and getattr(self, "_accumulate", False):
                     prev = self._store[k]
@@ -302,8 +346,79 @@ class KVStore(KVStoreBase):
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
 
+    def _start_heartbeat(self):
+        """Touch rank-stamped heartbeat files on a daemon thread (the ps-lite
+        worker→scheduler heartbeat, van.cc Heartbeat). Enabled when the
+        launcher exports MXNET_KVSTORE_HEARTBEAT_DIR."""
+        import os
+        import threading
+        import time
+        from .. import config
+        hb_dir = config.get("MXNET_KVSTORE_HEARTBEAT_DIR")
+        if not hb_dir:
+            return
+        os.makedirs(hb_dir, exist_ok=True)
+        interval = config.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL")
+        path = os.path.join(hb_dir, f"heartbeat_{self.rank}")
+        stop = self._hb_stop = threading.Event()
+
+        def write_beat():
+            # atomic: a concurrent num_dead_node read must never see a
+            # truncated/empty file (that would misread as epoch-0 = dead)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(str(time.time()))
+                os.replace(tmp, path)
+            except OSError:
+                pass
+
+        def beat():
+            while not stop.is_set():
+                write_beat()
+                stop.wait(interval)
+
+        write_beat()
+        self._hb_thread = threading.Thread(
+            target=beat, daemon=True, name=f"kvstore-heartbeat-{self.rank}")
+        self._hb_thread.start()
+
+    def close(self):
+        """Stop the heartbeat (a closed store must look DEAD to peers —
+        resurrecting beats would mask real worker failure)."""
+        stop = getattr(self, "_hb_stop", None)
+        if stop is not None:
+            stop.set()
+            self._hb_thread.join(timeout=2)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
     def num_dead_node(self, node_id=0, timeout_sec=60):
-        return 0
+        """Count workers whose heartbeat is stale (ps-lite scheduler
+        GetDeadNodes analog). 0 when failure detection is disabled."""
+        import os
+        import time
+        from .. import config
+        hb_dir = config.get("MXNET_KVSTORE_HEARTBEAT_DIR")
+        if not hb_dir or not os.path.isdir(hb_dir):
+            return 0
+        now = time.time()
+        dead = 0
+        for r in range(self.num_workers):
+            path = os.path.join(hb_dir, f"heartbeat_{r}")
+            try:
+                with open(path) as f:
+                    last = float(f.read().strip() or 0)
+            except (OSError, ValueError):
+                dead += 1
+                continue
+            if now - last > timeout_sec:
+                dead += 1
+        return dead
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:

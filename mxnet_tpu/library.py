@@ -59,7 +59,24 @@ def initialize():
 
 def load(path, verbose=True):
     """Load an external operator library (python/mxnet/library.py:31 load_lib
-    over lib_api.h). The library must export ``mxtpu_lib_init`` returning 0."""
+    over lib_api.h). The library must export ``mxtpu_lib_init`` returning 0.
+
+    Op ABI (the lib_api.h analog, float32 elementwise-shaped — richer ops use
+    the Python CustomOp API instead):
+      int          mxtpu_lib_num_ops();
+      const char*  mxtpu_lib_op_name(int idx);
+      int          mxtpu_lib_op_num_inputs(int idx);   // optional, default 1
+      int mxtpu_lib_op_forward(int idx, int n_inputs, const float** inputs,
+                               const int64_t** shapes, const int* ndims,
+                               float* output);              // out = shape of input 0
+      int mxtpu_lib_op_backward(int idx, int n_inputs, const float* out_grad,
+                                const float** inputs, const int64_t** shapes,
+                                const int* ndims, float* in_grad0);  // optional
+
+    Each exported op is registered as a CustomOp (host callback via
+    jax.pure_callback), callable as ``nd.Custom(*data, op_type=name)`` and
+    from symbols/hybridized blocks — the same dispatch surface the
+    reference's external ops get through MXLoadLib."""
     import ctypes
     from .base import MXNetError
     if not os.path.exists(path):
@@ -71,6 +88,96 @@ def load(path, verbose=True):
     ret = lib.mxtpu_lib_init()
     if ret != 0:
         raise MXNetError(f"{path}: mxtpu_lib_init failed with code {ret}")
+    names = []
+    if hasattr(lib, "mxtpu_lib_num_ops"):
+        lib.mxtpu_lib_num_ops.restype = ctypes.c_int
+        lib.mxtpu_lib_op_name.restype = ctypes.c_char_p
+        lib.mxtpu_lib_op_name.argtypes = [ctypes.c_int]
+        has_arity = hasattr(lib, "mxtpu_lib_op_num_inputs")
+        if has_arity:
+            lib.mxtpu_lib_op_num_inputs.restype = ctypes.c_int
+            lib.mxtpu_lib_op_num_inputs.argtypes = [ctypes.c_int]
+        for idx in range(lib.mxtpu_lib_num_ops()):
+            name = lib.mxtpu_lib_op_name(idx).decode()
+            n_in = lib.mxtpu_lib_op_num_inputs(idx) if has_arity else 1
+            _register_external_op(lib, idx, name, n_in)
+            names.append(name)
     if verbose:
-        print(f"loaded library {path}")
+        print(f"loaded library {path}: ops {names}")
     return lib
+
+
+def _register_external_op(lib, idx, name, n_in=1):
+    """Wrap one C op as a CustomOpProp (host-callback execution under jit)."""
+    import ctypes
+    import numpy as onp
+    from . import operator
+
+    c = ctypes
+    lib.mxtpu_lib_op_forward.restype = c.c_int
+    has_bwd = hasattr(lib, "mxtpu_lib_op_backward")
+    if has_bwd:
+        lib.mxtpu_lib_op_backward.restype = c.c_int
+
+    def _marshal(arrays):
+        n = len(arrays)
+        bufs = [onp.ascontiguousarray(a, dtype=onp.float32) for a in arrays]
+        ins = (c.POINTER(c.c_float) * n)(
+            *[b.ctypes.data_as(c.POINTER(c.c_float)) for b in bufs])
+        shapes_arrs = [onp.asarray(b.shape, onp.int64) for b in bufs]
+        shapes = (c.POINTER(c.c_int64) * n)(
+            *[s.ctypes.data_as(c.POINTER(c.c_int64)) for s in shapes_arrs])
+        ndims = (c.c_int * n)(*[b.ndim for b in bufs])
+        return bufs, ins, shapes, ndims, shapes_arrs
+
+    class _ExternalOp(operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            arrays = [a.asnumpy() for a in in_data]
+            bufs, ins, shapes, ndims, _keep = _marshal(arrays)
+            out = onp.zeros_like(bufs[0])
+            rc = lib.mxtpu_lib_op_forward(
+                idx, len(bufs), ins, shapes, ndims,
+                out.ctypes.data_as(c.POINTER(c.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"external op {name}: forward rc={rc}")
+            self.assign(out_data[0], req[0], out_data[0].__class__(out))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            if not has_bwd:
+                for g, r in zip(in_grad, req):
+                    self.assign(g, r, g.__class__(onp.zeros(g.shape, "float32")))
+                return
+            arrays = [a.asnumpy() for a in in_data]
+            bufs, ins, shapes, ndims, _keep = _marshal(arrays)
+            og = onp.ascontiguousarray(out_grad[0].asnumpy(), onp.float32)
+            gin = onp.zeros_like(bufs[0])
+            rc = lib.mxtpu_lib_op_backward(
+                idx, len(bufs), og.ctypes.data_as(c.POINTER(c.c_float)),
+                ins, shapes, ndims,
+                gin.ctypes.data_as(c.POINTER(c.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"external op {name}: backward rc={rc}")
+            self.assign(in_grad[0], req[0], in_grad[0].__class__(gin))
+            for g, r in list(zip(in_grad, req))[1:]:
+                self.assign(g, r, g.__class__(onp.zeros(g.shape, "float32")))
+
+    class _ExternalOpProp(operator.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            # arity comes from the library's mxtpu_lib_op_num_inputs (the
+            # lib_api.h num_inputs declaration), not from the caller
+            self._n_in = n_in
+
+        def list_arguments(self):
+            return [f"data{i}" for i in range(self._n_in)]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _ExternalOp()
+
+    operator.register(name)(_ExternalOpProp)

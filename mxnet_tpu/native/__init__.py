@@ -77,6 +77,10 @@ def _configure(lib):
     lib.mxtpu_engine_push.argtypes = [
         c.c_void_p, c.CFUNCTYPE(None, c.c_void_p), c.c_void_p,
         c.POINTER(c.c_int64), c.c_int, c.POINTER(c.c_int64), c.c_int]
+    lib.mxtpu_engine_push_ex.argtypes = [
+        c.c_void_p, c.CFUNCTYPE(None, c.c_void_p), c.c_void_p,
+        c.POINTER(c.c_int64), c.c_int, c.POINTER(c.c_int64), c.c_int,
+        c.c_int, c.c_int, c.c_int]
     lib.mxtpu_engine_wait_for_var.argtypes = [c.c_void_p, c.c_int64]
     lib.mxtpu_engine_wait_all.argtypes = [c.c_void_p]
     lib.mxtpu_engine_last_error.restype = c.c_char_p
@@ -133,7 +137,12 @@ class NativeEngine:
     def new_var(self):
         return self._lib.mxtpu_engine_new_var(self._h)
 
-    def push(self, fn, read_vars=(), write_vars=()):
+    LANE_NORMAL, LANE_COPY, LANE_PRIORITY = 0, 1, 2  # FnProperty analog
+
+    def push(self, fn, read_vars=(), write_vars=(), device=0, lane=0,
+             priority=0):
+        """PushAsync. ``device``/``lane`` route to a dedicated worker pool
+        (ThreadedEnginePerDevice); ``priority`` orders dispatch in-pool."""
         with self._cb_lock:
             cb_id = self._next_id
             self._next_id += 1
@@ -152,8 +161,15 @@ class NativeEngine:
             self._cbs[cb_id] = cfunc
         reads = (ctypes.c_int64 * len(read_vars))(*read_vars)
         writes = (ctypes.c_int64 * len(write_vars))(*write_vars)
-        self._lib.mxtpu_engine_push(self._h, cfunc, None, reads,
-                                    len(read_vars), writes, len(write_vars))
+        if device == 0 and lane == 0 and priority == 0:
+            self._lib.mxtpu_engine_push(self._h, cfunc, None, reads,
+                                        len(read_vars), writes,
+                                        len(write_vars))
+        else:
+            self._lib.mxtpu_engine_push_ex(self._h, cfunc, None, reads,
+                                           len(read_vars), writes,
+                                           len(write_vars), device, lane,
+                                           priority)
 
     def _check_error(self):
         err = self._lib.mxtpu_engine_last_error(self._h)

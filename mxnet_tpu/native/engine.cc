@@ -16,6 +16,14 @@
 //  - exceptions captured per task and rethrown at sync points
 //    (threaded_engine.cc:422-427)
 //
+// Per-device lanes (the ThreadedEnginePerDevice analog,
+// threaded_engine_perdevice.cc): tasks carry (device_id, lane, priority);
+// each (device, lane) gets its own worker pool so copy traffic and
+// prioritized host work never queue behind bulk decode (FnProperty::kCopyTo/
+// FromGPU, kCPUPrioritized semantics). Priority orders dispatch within a
+// pool (engine.h Push(priority) hint). Lane/device 0 is the default shared
+// pool — the plain ThreadedEngine behavior.
+//
 // Built as a plain C ABI for ctypes (no pybind11 in this image).
 #include <atomic>
 #include <condition_variable>
@@ -23,6 +31,7 @@
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <queue>
@@ -51,23 +60,27 @@ struct Task {
   void* arg = nullptr;
   std::vector<int64_t> reads, writes;
   std::atomic<int> wait_count{0};  // vars not yet granting this task
+  int device = 0;                  // pool routing (perdevice semantics)
+  int lane = 0;                    // 0 normal, 1 copy, 2 prioritized
+  int priority = 0;                // dispatch order hint within a pool
 };
 
 class Engine {
  public:
-  explicit Engine(int num_workers) : stop_(false), pending_(0) {
-    if (num_workers < 1) num_workers = 1;
-    for (int i = 0; i < num_workers; ++i)
-      workers_.emplace_back([this] { WorkerLoop(); });
+  explicit Engine(int num_workers) : stop_(false), pending_(0),
+                                     num_workers_(num_workers < 1 ? 1
+                                                                  : num_workers) {
+    GetPool(0, 0);  // default shared pool
   }
 
   ~Engine() {
     {
       std::unique_lock<std::mutex> lk(mu_);
       stop_ = true;
-      ready_cv_.notify_all();
+      for (auto& kv : pools_) kv.second->cv.notify_all();
     }
-    for (auto& t : workers_) t.join();
+    for (auto& kv : pools_)
+      for (auto& t : kv.second->threads) t.join();
     for (auto& kv : vars_) delete kv.second;
   }
 
@@ -79,20 +92,24 @@ class Engine {
   }
 
   void Push(TaskFn fn, void* arg, const int64_t* reads, int n_reads,
-            const int64_t* writes, int n_writes) {
+            const int64_t* writes, int n_writes, int device = 0, int lane = 0,
+            int priority = 0) {
     auto* task = new Task();
     task->fn = fn;
     task->arg = arg;
     task->reads.assign(reads, reads + n_reads);
     task->writes.assign(writes, writes + n_writes);
+    task->device = device;
+    task->lane = lane;
+    task->priority = priority;
     std::unique_lock<std::mutex> lk(mu_);
+    GetPool(device, lane);  // spin the pool up before work can be granted
     ++pending_;
     int ndeps = static_cast<int>(task->reads.size() + task->writes.size());
     if (ndeps == 0) {
       // no dependencies: runnable immediately (GrantOne only fires from a
       // var's queue, so dep-free tasks must enter the ready queue here)
-      ready_.push(task);
-      ready_cv_.notify_one();
+      Enqueue(task);
       return;
     }
     int grants = 0;
@@ -162,11 +179,37 @@ class Engine {
     return granted;
   }
 
+  // One worker pool per (device, lane) — perdevice isolation. Guarded by mu_.
+  struct Pool {
+    // higher priority first; equal keys keep insertion (FIFO) order
+    std::multimap<int, Task*, std::greater<int>> ready;
+    std::condition_variable cv;
+    std::vector<std::thread> threads;
+  };
+
+  Pool* GetPool(int device, int lane) {
+    auto key = std::make_pair(device, lane);
+    auto it = pools_.find(key);
+    if (it != pools_.end()) return it->second.get();
+    auto pool = std::make_unique<Pool>();
+    Pool* p = pool.get();
+    // copy lanes get a small dedicated pool (kCopyFromGPU discipline);
+    // normal/priority lanes get the full width
+    int n = (lane == 1) ? 2 : num_workers_;
+    for (int i = 0; i < n; ++i)
+      p->threads.emplace_back([this, p] { WorkerLoop(p); });
+    pools_[key] = std::move(pool);
+    return p;
+  }
+
+  void Enqueue(Task* t) {
+    Pool* p = GetPool(t->device, t->lane);
+    p->ready.emplace(t->priority, t);
+    p->cv.notify_one();
+  }
+
   void GrantOne(Task* t) {
-    if (t->wait_count.fetch_sub(1) == 1) {
-      ready_.push(t);
-      ready_cv_.notify_one();
-    }
+    if (t->wait_count.fetch_sub(1) == 1) Enqueue(t);
   }
 
   void CompleteTask(Task* t) {
@@ -186,15 +229,16 @@ class Engine {
     delete t;
   }
 
-  void WorkerLoop() {
+  void WorkerLoop(Pool* pool) {
     for (;;) {
       Task* t = nullptr;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        ready_cv_.wait(lk, [&] { return stop_ || !ready_.empty(); });
-        if (stop_ && ready_.empty()) return;
-        t = ready_.front();
-        ready_.pop();
+        pool->cv.wait(lk, [&] { return stop_ || !pool->ready.empty(); });
+        if (stop_ && pool->ready.empty()) return;
+        auto it = pool->ready.begin();
+        t = it->second;
+        pool->ready.erase(it);
       }
       // run outside the lock; capture failures for sync-point rethrow
       // (threaded_engine.cc:422-427 exception propagation)
@@ -212,13 +256,13 @@ class Engine {
   void RethrowIfError() {}  // error surfaced via LastError to Python
 
   std::mutex mu_;
-  std::condition_variable ready_cv_, done_cv_;
-  std::queue<Task*> ready_;
+  std::condition_variable done_cv_;
+  std::map<std::pair<int, int>, std::unique_ptr<Pool>> pools_;
   std::unordered_map<int64_t, Var*> vars_;
-  std::vector<std::thread> workers_;
   int64_t next_var_ = 1;
   bool stop_;
   int64_t pending_;
+  int num_workers_;
   std::string error_;
 
  public:
@@ -244,6 +288,16 @@ void mxtpu_engine_push(void* e, void (*fn)(void*), void* arg,
                        const int64_t* reads, int n_reads,
                        const int64_t* writes, int n_writes) {
   static_cast<Engine*>(e)->Push(fn, arg, reads, n_reads, writes, n_writes);
+}
+
+// perdevice push: route to the (device, lane) pool with a priority hint
+// (lane: 0 normal, 1 copy, 2 prioritized — FnProperty analog)
+void mxtpu_engine_push_ex(void* e, void (*fn)(void*), void* arg,
+                          const int64_t* reads, int n_reads,
+                          const int64_t* writes, int n_writes, int device,
+                          int lane, int priority) {
+  static_cast<Engine*>(e)->Push(fn, arg, reads, n_reads, writes, n_writes,
+                                device, lane, priority);
 }
 
 void mxtpu_engine_wait_for_var(void* e, int64_t var) {

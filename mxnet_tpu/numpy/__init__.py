@@ -130,6 +130,21 @@ _NP_FUNCS = [
     "convolve", "correlate", "heaviside", "float_power", "ldexp", "frexp",
     "deg2rad", "rad2deg", "insert", "delete", "append", "resize", "trim_zeros",
     "tri", "vander", "polyval",
+    # breadth batch 2 (round 3): bitwise, windows, set ops, nan-reductions,
+    # poly family, index helpers, misc — everything jnp itself provides
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift", "blackman", "hamming", "hanning", "bartlett",
+    "kaiser", "compress", "extract", "divmod", "fmod", "modf", "select",
+    "piecewise", "lexsort", "logspace", "geomspace", "identity", "full_like",
+    "empty_like", "fill_diagonal", "diag_indices", "diag_indices_from",
+    "tril_indices", "triu_indices", "tril_indices_from", "triu_indices_from",
+    "in1d", "isin", "intersect1d", "setdiff1d", "setxor1d", "union1d",
+    "histogram2d", "histogram_bin_edges", "histogramdd", "nanargmax",
+    "nanargmin", "nancumprod", "nancumsum", "nanmean", "nanmedian", "nanstd",
+    "nanvar", "nanpercentile", "nanquantile", "unwrap", "packbits",
+    "unpackbits", "apply_along_axis", "apply_over_axes", "array_equiv",
+    "poly", "polyadd", "polydiv", "polyfit", "polyint", "polymul", "polysub",
+    "roots", "ix_", "spacing", "angle", "conj", "conjugate", "cumulative_sum",
 ]
 
 _DIFFERENTIABLE_EXCEPTIONS = {
@@ -210,6 +225,125 @@ for _name in _NP_FUNCS:
 
 from . import linalg    # noqa: E402,F401
 from . import random    # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# aliases, constants, dtype utilities, host-side numpy delegates
+# (parity: python/mxnet/numpy/multiarray.py + utils.py exported surface)
+# ---------------------------------------------------------------------------
+NAN = NaN = nan
+NINF = -inf
+PINF = inf
+NZERO = -0.0
+PZERO = 0.0
+bool = bool_  # noqa: A001 — numpy exports `bool` as a dtype name
+alltrue = getattr(_this, "all", None)
+round_ = getattr(_this, "round", None)
+row_stack = getattr(_this, "vstack", None)
+
+
+def msort(a):
+    """Sort along the first axis (numpy msort)."""
+    return _this.sort(a, axis=0)
+
+
+def fill_diagonal(a, val, wrap=False, inplace=False):
+    """Functional fill_diagonal: arrays are immutable on device, so the
+    filled array is RETURNED (jnp requires inplace=False; numpy's in-place
+    contract cannot hold)."""
+    op = _ensure_np_op("fill_diagonal")
+    arrays = [a if isinstance(a, NDArray) else NDArray(a)]
+    if isinstance(val, (NDArray, _onp.ndarray)):
+        arrays.append(val if isinstance(val, NDArray) else NDArray(val))
+        return _reg.invoke(op, arrays, {"wrap": wrap, "inplace": False})
+    return _reg.invoke(op, arrays, {"val": val, "wrap": wrap,
+                                    "inplace": False})
+
+
+# dtype machinery is host-side numpy's (no device work involved)
+dtype = _onp.dtype
+finfo = _onp.finfo
+iinfo = _onp.iinfo
+promote_types = _onp.promote_types
+result_type = _onp.result_type
+min_scalar_type = _onp.min_scalar_type
+set_printoptions = _onp.set_printoptions
+
+
+def genfromtxt(*args, **kwargs):
+    """Host-side text parse into a device array (numpy genfromtxt)."""
+    return NDArray(_onp.genfromtxt(*args, **kwargs).astype("float32"))
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+# ---------------------------------------------------------------------------
+# financial functions (parity: the reference numpy surface exports the
+# pre-numpy-1.20 financial set; formulas per numpy-financial semantics).
+# Host scalar math — these size loans, not tensors.
+# ---------------------------------------------------------------------------
+def npv(rate, values):
+    v = _onp.asarray(values, dtype=_onp.float64)
+    return float((v / (1 + rate) ** _onp.arange(len(v))).sum())
+
+
+def pv(rate, nper, pmt, fv=0, when=0):
+    if rate == 0:
+        return float(-(fv + pmt * nper))
+    f = (1 + rate) ** nper
+    return float(-(fv + pmt * (1 + rate * when) * (f - 1) / rate) / f)
+
+
+def _pmt(rate, nper, pv_, fv=0, when=0):
+    if rate == 0:
+        return -(fv + pv_) / nper
+    f = (1 + rate) ** nper
+    return -(fv + pv_ * f) * rate / ((1 + rate * when) * (f - 1))
+
+
+def ppmt(rate, per, nper, pv_, fv=0, when=0):
+    pmt = _pmt(rate, nper, pv_, fv, when)
+    # interest portion = rate on the balance remaining after per-1 payments;
+    # begin-mode (when=1): period 1 accrues no interest, later periods'
+    # interest discounts by one period (numpy-financial ipmt semantics)
+    f = (1 + rate) ** (per - 1)
+    balance = pv_ * f + pmt * (1 + rate * when) * (f - 1) / rate \
+        if rate != 0 else pv_ + pmt * (per - 1)
+    ipmt = -balance * rate
+    if when == 1:
+        ipmt = 0.0 if per == 1 else ipmt / (1 + rate)
+    return float(pmt - ipmt)
+
+
+def rate(nper, pmt, pv_, fv, when=0, guess=0.1, maxiter=100):
+    """Interest rate per period via Newton iterations (numpy-financial rate)."""
+    r = guess
+    for _ in range(maxiter):
+        f = (1 + r) ** nper
+        y = fv + pv_ * f + pmt * (1 + r * when) * (f - 1) / r
+        dfdr = nper * (1 + r) ** (nper - 1)
+        dy = (pv_ * dfdr + pmt *
+              (when * (f - 1) / r +
+               (1 + r * when) * (dfdr * r - (f - 1)) / (r * r)))
+        step = y / dy
+        r -= step
+        if -1e-12 < step < 1e-12:  # builtin abs is shadowed by the np wrapper
+            break
+    return float(r)
+
+
+def mirr(values, finance_rate, reinvest_rate):
+    v = _onp.asarray(values, dtype=_onp.float64)
+    n = len(v)
+    pos = _onp.where(v > 0, v, 0.0)
+    neg = _onp.where(v < 0, v, 0.0)
+    if not (pos.any() and neg.any()):
+        return float("nan")
+    fv_pos = (pos * (1 + reinvest_rate) ** _onp.arange(n - 1, -1, -1)).sum()
+    pv_neg = (neg / (1 + finance_rate) ** _onp.arange(n)).sum()
+    return float((fv_pos / -pv_neg) ** (1 / (n - 1)) - 1)
 
 
 def may_share_memory(a, b):

@@ -42,3 +42,53 @@ def test_collective_backend_registered():
     import pytest
     with pytest.raises(mx.MXNetError):
         kv.push("k", a)
+
+
+def test_async_kvstore_single_process():
+    """dist_async on one process: updater applies immediately, no averaging
+    traffic (num_workers == 1)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("dist_async")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, wd=0.0))
+    kv.init("w", nd.zeros((3,)))
+    kv.push("w", nd.ones((3,)))
+    out = nd.zeros((3,))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), -onp.ones(3), rtol=1e-6)
+
+
+def test_heartbeat_failure_detection(tmp_path):
+    """num_dead_node counts stale/absent heartbeats (ps-lite scheduler
+    GetDeadNodes analog over the launcher-shared heartbeat dir)."""
+    import time
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    mx.config.set("MXNET_KVSTORE_HEARTBEAT_DIR", str(tmp_path))
+    kv = None
+    try:
+        kv = mx.kv.create("dist_sync")
+        assert kv.num_dead_node(timeout_sec=60) == 0  # own beat is fresh
+        # a stale beat from a (simulated) second worker
+        stale = tmp_path / "heartbeat_1"
+        stale.write_text(str(time.time() - 3600))
+        # single process: num_workers == 1, rank-1 file is out of range
+        assert kv.num_dead_node(timeout_sec=60) == 0
+        # simulate the scheduler view: scan as if world had 2 workers
+        import types
+        kv2 = kv
+        real = type(kv).num_workers
+        try:
+            type(kv).num_workers = property(lambda self: 2)
+            assert kv2.num_dead_node(timeout_sec=60) == 1
+            stale.write_text(str(time.time()))
+            assert kv2.num_dead_node(timeout_sec=60) == 0
+        finally:
+            type(kv).num_workers = real
+    finally:
+        if kv is not None:
+            kv.close()  # stop the beat thread; a closed store must go dead
+        mx.config.set("MXNET_KVSTORE_HEARTBEAT_DIR", "")

@@ -254,3 +254,47 @@ def test_prefetching_iter_on_engine():
     from mxnet_tpu import native
     if native.available():
         assert type(engine_mod.get_engine()).__name__ == "NativeEngine"
+
+
+def test_engine_perdevice_lanes_and_priority():
+    """ThreadedEnginePerDevice semantics: (device, lane) pools are isolated —
+    a saturated normal lane must not block the copy lane — and priority
+    orders dispatch within a pool (threaded_engine_perdevice.cc,
+    engine.h FnProperty/priority)."""
+    import threading
+    eng = native.NativeEngine(num_workers=1)
+    gate = threading.Event()
+    copy_done = threading.Event()
+    # saturate the single normal worker
+    eng.push(lambda: gate.wait(10))
+    # copy-lane work must run despite the blocked normal lane
+    eng.push(copy_done.set, lane=native.NativeEngine.LANE_COPY)
+    assert copy_done.wait(5), "copy lane starved by blocked normal lane"
+    gate.set()
+    eng.wait_all()
+
+    # priority ordering: with one worker on device 1, queue three tasks while
+    # the worker is held; higher priority runs first
+    order = []
+    hold = threading.Event()
+    started = threading.Event()
+    eng.push(lambda: (started.set(), hold.wait(10)), device=1)
+    started.wait(5)
+    v = eng.new_var()
+    for name, prio in (("low", 0), ("high", 5), ("mid", 2)):
+        eng.push(lambda n=name: order.append(n), write_vars=[v], device=1)
+        # same-var writes serialize FIFO; use distinct vars for priority test
+    hold.set()  # release the first holder before flushing
+    eng.wait_for_var(v)  # flush the FIFO batch
+    order.clear()
+    hold2 = threading.Event()
+    started2 = threading.Event()
+    eng.push(lambda: (started2.set(), hold2.wait(10)), device=1)
+    started2.wait(5)
+    for name, prio in (("low", 0), ("high", 5), ("mid", 2)):
+        eng.push(lambda n=name: order.append(n), device=1, priority=prio)
+    hold.set()
+    hold2.set()
+    eng.wait_all()
+    assert order == ["high", "mid", "low"], order
+    eng.close()

@@ -1,0 +1,108 @@
+"""mx.np frontend breadth batch 2 (parity: python/mxnet/numpy exported
+surface; test pattern tests/python/unittest/test_numpy_op.py — compare
+against host numpy oracles)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.numpy as np
+from mxnet_tpu import nd
+
+
+def test_surface_count():
+    """The frontend must expose the bulk of the reference np surface."""
+    import re, pathlib
+    ref = pathlib.Path("/root/reference/python/mxnet/numpy")
+    names = set()
+    for f in ref.glob("*.py"):
+        txt = f.read_text(errors="ignore")
+        for m in re.finditer(r"__all__\s*=\s*\[([^\]]*)\]", txt, re.S):
+            names.update(re.findall(r"'([A-Za-z0-9_]+)'", m.group(1)))
+    missing = sorted(n for n in names
+                     if not hasattr(np, n) and not hasattr(np.linalg, n)
+                     and not n.startswith("_"))
+    # a handful of host-only leftovers are acceptable; breadth must be >90%
+    assert len(missing) <= 0.1 * len(names), missing
+
+
+def test_bitwise_and_windows():
+    a = np.array(onp.array([0b1100, 0b1010], "int32"))
+    b = np.array(onp.array([0b1010, 0b1010], "int32"))
+    onp.testing.assert_array_equal(np.bitwise_and(a, b).asnumpy(), [8, 10])
+    onp.testing.assert_array_equal(np.bitwise_xor(a, b).asnumpy(), [6, 0])
+    w = np.hanning(8).asnumpy()
+    onp.testing.assert_allclose(w, onp.hanning(8), atol=1e-6)
+
+
+def test_set_ops():
+    a = np.array(onp.array([1, 2, 3, 4], "float32"))
+    b = np.array(onp.array([3, 4, 5], "float32"))
+    onp.testing.assert_array_equal(
+        onp.sort(np.intersect1d(a, b).asnumpy()), [3, 4])
+    onp.testing.assert_array_equal(np.isin(a, b).asnumpy(),
+                                   [False, False, True, True])
+    u = np.union1d(a, b).asnumpy()
+    onp.testing.assert_array_equal(onp.sort(u), [1, 2, 3, 4, 5])
+
+
+def test_nan_reductions():
+    x = np.array(onp.array([[1.0, onp.nan], [3.0, 4.0]], "float32"))
+    assert float(np.nanmean(x).asnumpy()) == pytest.approx(8 / 3)
+    assert int(np.nanargmax(x).asnumpy()) == 3
+
+
+def test_poly_family():
+    c = np.polyfit(np.array(onp.arange(5, dtype="float32")),
+                   np.array((2 * onp.arange(5) + 1).astype("float32")), 1)
+    onp.testing.assert_allclose(c.asnumpy(), [2.0, 1.0], atol=1e-4)
+    r = np.roots(np.array(onp.array([1.0, -3.0, 2.0], "float32"))).asnumpy()
+    onp.testing.assert_allclose(sorted(onp.real(r)), [1.0, 2.0], atol=1e-5)
+
+
+def test_index_helpers_and_misc():
+    rows, cols = np.tril_indices(3)
+    assert len(rows.asnumpy()) == 6
+    x = np.array(onp.arange(9, dtype="float32").reshape(3, 3))
+    filled = np.fill_diagonal(x, np.array(onp.zeros(3, "float32")),
+                              inplace=False)
+    assert onp.trace(filled.asnumpy()) == 0
+    onp.testing.assert_array_equal(np.msort(np.array(
+        onp.array([[3.0, 1.0], [1.0, 2.0]], "float32"))).asnumpy(),
+        [[1, 1], [3, 2]])
+
+
+def test_constants_and_dtype_utils():
+    assert np.NAN != np.NAN   # nan
+    assert np.NINF == -np.inf and np.PINF == np.inf
+    assert np.finfo("float32").eps == onp.finfo("float32").eps
+    assert np.promote_types("float32", "float64") == onp.float64
+    assert np.result_type("int32", "float32") == onp.result_type(
+        "int32", "float32")
+
+
+def test_financial():
+    # hand-checkable oracles (numpy-financial semantics)
+    assert np.npv(0.0, [1, 2, 3]) == pytest.approx(6.0)
+    assert np.npv(1.0, [-2, 4]) == pytest.approx(0.0)
+    assert np.pv(0.05 / 12, 10 * 12, -100, 15692.93) == pytest.approx(
+        -100.00, abs=0.1)
+    assert np.rate(10, 0, -3500, 10000) == pytest.approx(0.1107, abs=1e-4)
+    assert np.mirr([-4500, -800, 800, 800, 600, 600, 800, 800, 700, 3000],
+                   0.08, 0.055) == pytest.approx(0.0666, abs=1e-4)
+    # principal payments over the loan sum to the principal
+    total = sum(np.ppmt(0.1 / 12, per, 24, 2000) for per in range(1, 25))
+    assert total == pytest.approx(-2000, abs=1e-6)
+    # begin-mode: the first payment is pure principal (no interest accrued)
+    assert np.ppmt(0.1, 1, 10, 1000, when=1) == pytest.approx(
+        np.pv(0.1, 10, 0, 0) * 0 - 162.745394883 / 1.1, abs=1e-3)
+    total1 = sum(np.ppmt(0.1, per, 10, 1000, when=1) for per in range(1, 11))
+    assert total1 == pytest.approx(-1000, abs=1e-6)
+
+
+def test_histogram2d_and_digitize():
+    x = np.array(onp.array([0.1, 0.6, 0.9], "float32"))
+    y = np.array(onp.array([0.2, 0.7, 0.8], "float32"))
+    h, ex, ey = np.histogram2d(x, y, bins=2, range=[[0, 1], [0, 1]])
+    assert h.asnumpy().sum() == 3
+    bins = np.array(onp.array([0.0, 0.5, 1.0], "float32"))
+    onp.testing.assert_array_equal(np.digitize(x, bins).asnumpy(), [1, 2, 2])
