@@ -41,6 +41,7 @@ from . import tensor_inspector
 from .tensor_inspector import TensorInspector
 
 from . import library
+from . import rtc
 library.initialize()  # atfork discipline + SIGSEGV logger (initialize.cc)
 
 if config.get("MXNET_PROFILER_AUTOSTART"):

@@ -1,0 +1,109 @@
+"""Python side of the C predict API (parity: include/mxnet/c_predict_api.h
+over src/c_api/c_predict_api.cc).
+
+The native ``libmxtpu_predict.so`` embeds the CPython runtime and drives this
+module through the CPython C API: a C/C++ application links the .so, hands it
+an exported ``-symbol.json`` (embedded StableHLO program, gluon/block.py
+export) plus the ``.params`` bytes, and runs inference without writing a line
+of Python — the cpp-package / c_predict_api binding surface of the reference,
+with the XLA executable doing the compute.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as onp
+
+__all__ = ["create"]
+
+
+class _Predictor:
+    def __init__(self, symbol_json, param_bytes, input_keys, input_shapes):
+        import base64
+        import jax
+        from jax import export as jax_export
+
+        meta = json.loads(symbol_json)
+        if meta.get("format") != "mxnet_tpu/stablehlo-v1":
+            raise ValueError("not a mxnet_tpu/stablehlo-v1 export")
+        exported = jax_export.deserialize(bytearray(
+            base64.b64decode(meta["stablehlo_b64"])))
+        self._call = jax.jit(exported.call)
+
+        fd, path = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_bytes)
+            from .ndarray.utils import load as nd_load
+            loaded = nd_load(path)
+        finally:
+            os.unlink(path)
+        by_name = {k.replace("arg:", "").replace("aux:", ""): v
+                   for k, v in loaded.items()}
+        missing = [n for n in meta["params"] if n not in by_name]
+        if missing:
+            raise ValueError(f"params missing values for {missing}")
+        self._param_vals = tuple(by_name[n].data for n in meta["params"])
+
+        self._keys = list(input_keys)
+        # input dtypes come from the export's recorded signature (jax.export
+        # enforces the traced avals, so a blanket float32 would be rejected
+        # for int/bf16 inputs)
+        def _np_dtype(name):
+            try:
+                return onp.dtype(name)
+            except TypeError:
+                import ml_dtypes  # bfloat16 etc. live outside base numpy
+                return onp.dtype(getattr(ml_dtypes, name))
+
+        in_meta = meta.get("inputs", [])
+        dtypes = [_np_dtype(m.get("dtype", "float32")) for m in in_meta]
+        dtypes += [onp.dtype(onp.float32)] * (len(self._keys) - len(dtypes))
+        self._bufs = {k: onp.zeros(tuple(s), dt)
+                      for k, s, dt in zip(self._keys, input_shapes, dtypes)}
+        self._outs = None
+
+    def set_input(self, key, flat):
+        if key not in self._bufs:
+            raise KeyError(f"unknown input {key!r}; have {self._keys}")
+        buf = self._bufs[key]
+        if isinstance(flat, (bytes, bytearray, memoryview)):
+            # zero-boxing path from the C binding: raw float32 buffer
+            arr = onp.frombuffer(flat, onp.float32)
+        else:
+            arr = onp.asarray(flat, onp.float32)
+        if arr.size != buf.size:
+            raise ValueError(f"input {key!r}: got {arr.size} elements, "
+                             f"want {buf.size}")
+        buf[...] = arr.reshape(buf.shape).astype(buf.dtype)
+
+    def forward(self):
+        outs = self._call(self._param_vals,
+                          *[self._bufs[k] for k in self._keys])
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        self._outs = [onp.asarray(o, onp.float32) for o in outs]
+
+    def num_outputs(self):
+        self._require_forward()
+        return len(self._outs)
+
+    def output_shape(self, index):
+        self._require_forward()
+        return list(self._outs[index].shape)
+
+    def output(self, index):
+        self._require_forward()
+        return onp.ascontiguousarray(self._outs[index], onp.float32)
+
+    def _require_forward(self):
+        if self._outs is None:
+            raise RuntimeError("call forward() before reading outputs")
+
+
+def create(symbol_json, param_bytes, input_keys, input_shapes):
+    """Entry point invoked by libmxtpu_predict.so (MXPredCreate)."""
+    return _Predictor(symbol_json, param_bytes, list(input_keys),
+                      [list(s) for s in input_shapes])

@@ -1,0 +1,6 @@
+"""Estimator training facade (parity: gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    BatchBegin, BatchEnd, CheckpointHandler, EarlyStoppingHandler,
+    EpochBegin, EpochEnd, EventHandler, LoggingHandler, MetricHandler,
+    StoppingHandler, TrainBegin, TrainEnd, ValidationHandler)

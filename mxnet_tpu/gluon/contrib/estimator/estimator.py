@@ -1,0 +1,116 @@
+"""Estimator: the high-level train facade (parity:
+gluon/contrib/estimator/estimator.py:42-460 — fit/evaluate over a gluon
+Block + Trainer with a handler event loop). The per-batch step is the same
+record/backward/step flow as Trainer training; on TPU the loss/forward jit
+via hybridize as usual."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        from .... import metric as metric_mod
+        from ... import Trainer
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics if train_metrics is not None else \
+            [metric_mod.Accuracy()]
+        if not isinstance(self.train_metrics, (list, tuple)):
+            self.train_metrics = [self.train_metrics]
+        self.train_metrics = list(self.train_metrics)
+        self.loss_metric = metric_mod.Loss(name="loss")
+        self.trainer = trainer or Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 1e-3})
+        self.context = context
+        self.stop_training = False
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate(self, val_data, val_metrics=None):
+        """Run the net over val_data updating val_metrics
+        (estimator.py:272)."""
+        from .... import autograd
+        metrics = val_metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._unpack(batch)
+            with autograd.pause():
+                pred = self.net(data)
+            for m in metrics:
+                if getattr(m, "name", "") == "loss":
+                    m.update(0, self.loss(pred, label))
+                else:
+                    m.update(label, pred)
+        return [m.get() for m in metrics]
+
+    # -- training -----------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        """Train (estimator.py:326): epoch/batch loop broadcasting lifecycle
+        events to the handler set."""
+        from .... import autograd
+        if epochs is None and batches is None:
+            raise MXNetError("fit needs epochs or batches")
+        handlers = self._default_handlers(val_data, event_handlers,
+                                          epochs, batches)
+        self.stop_training = False
+
+        def emit(stage, *args, **kwargs):
+            for h in handlers:
+                fn = getattr(h, stage, None)
+                if fn is not None:
+                    fn(self, *args, **kwargs)
+
+        emit("train_begin")
+        epoch = 0
+        while not self.stop_training and (epochs is None or epoch < epochs):
+            emit("epoch_begin")
+            for batch in train_data:
+                if self.stop_training:
+                    break
+                emit("batch_begin", batch=batch)
+                data, label = self._unpack(batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                bs = data.shape[0]
+                self.trainer.step(bs)
+                self.loss_metric.update(0, loss)
+                emit("batch_end", batch=batch, pred=pred, label=label,
+                     loss=loss)
+            emit("epoch_end", epoch=epoch)
+            epoch += 1
+        emit("train_end")
+
+    # -- plumbing -----------------------------------------------------------
+    def _unpack(self, batch):
+        if hasattr(batch, "data"):  # DataBatch
+            return batch.data[0], batch.label[0]
+        data, label = batch
+        return data, label
+
+    def _default_handlers(self, val_data, user_handlers, epochs, batches):
+        handlers = list(user_handlers or [])
+        have = {type(h) for h in handlers}
+        if StoppingHandler not in have:
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.loss_metric] + self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.loss_metric] + self.train_metrics))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers

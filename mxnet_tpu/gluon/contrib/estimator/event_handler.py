@@ -1,0 +1,243 @@
+"""Event handlers for the Estimator train loop (parity:
+gluon/contrib/estimator/event_handler.py:37-520 — same mixin taxonomy:
+handlers subclass the lifecycle stages they care about, the Estimator calls
+every handler at every stage in priority order)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches (event_handler.py:82)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics at epoch start, update them per batch
+    (event_handler.py:122)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if getattr(m, "name", "") == "loss" and loss is not None:
+                m.update(0, loss)
+            elif pred is not None and label is not None:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs (event_handler.py:160)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress (event_handler.py:226). ``log_interval``:
+    'epoch' or an integer batch count."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-3000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.batch_index = 0
+
+    def _metric_str(self):
+        parts = []
+        for m in self.metrics:
+            name, val = m.get()
+            parts.append(f"{name}: {val:.4f}" if isinstance(val, float)
+                         else f"{name}: {val}")
+        return ", ".join(parts)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.3fs; %s",
+                         time.time() - self.train_start, self._metric_str())
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.logger.info("Epoch done in %.3fs; %s",
+                         time.time() - self.epoch_start, self._metric_str())
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self.logger.info("Batch %d; %s", self.batch_index,
+                             self._metric_str())
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save parameters every ``epoch_period`` epochs; optionally keep only
+    the best by a monitored metric (event_handler.py:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="auto", epoch_period=1, batch_period=None,
+                 save_best=False, priority=-3000):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.save_best = save_best
+        self.priority = priority
+        if mode == "auto":
+            mode = "min" if monitor is not None and \
+                "loss" in getattr(monitor, "name", "") else "max"
+        self.mode = mode
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_epoch = 0
+
+    def _save(self, estimator, tag):
+        path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}.params")
+        estimator.net.save_parameters(path)
+        return path
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, f"epoch{self.current_epoch}")
+        if self.save_best and self.monitor is not None:
+            _, val = self.monitor.get()
+            better = val < self.best if self.mode == "min" else val > self.best
+            if better:
+                self.best = val
+                self._save(estimator, "best")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
+    """Stop when the monitored metric stops improving (event_handler.py:520
+    region)."""
+
+    def __init__(self, monitor, min_delta=0.0, patience=0, mode="auto"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        if mode == "auto":
+            mode = "min" if "loss" in getattr(monitor, "name", "") else "max"
+        self.mode = mode
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+        self.wait = 0
+        self.stopped_epoch = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+        self.wait = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        improved = (val < self.best - self.min_delta if self.mode == "min"
+                    else val > self.best + self.min_delta)
+        if improved:
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped_epoch = kwargs.get("epoch")
+                estimator.stop_training = True

@@ -18,7 +18,8 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+           "CSVIter", "LibSVMIter", "ImageRecordIter", "PrefetchingIter",
+           "ResizeIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -201,6 +202,87 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator producing CSR batches (src/io/iter_libsvm.cc
+    parity): each line is ``label idx:val idx:val ...``; batches carry a
+    CSRNDArray for data (sparse stays sparse through the pipeline, the
+    FInferStorageType discipline of the reference's sparse iterators)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._feature_dim = int(data_shape[0]) if hasattr(data_shape, "__len__") \
+            else int(data_shape)
+        vals, idxs, ptr, labels = [], [], [0], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idxs.append(int(i))
+                    vals.append(float(v))
+                ptr.append(len(idxs))
+        self._vals = onp.asarray(vals, onp.float32)
+        self._idxs = onp.asarray(idxs, onp.int32)
+        self._ptr = onp.asarray(ptr, onp.int64)
+        self._labels = onp.asarray(labels, onp.float32)
+        if label_libsvm:
+            # label file is ALSO libsvm-format (first token per line), like
+            # iter_libsvm.cc's label_libsvm param
+            lab = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        lab.append(float(parts[0]))
+            self._labels = onp.asarray(lab, onp.float32)
+        if len(self._labels) != len(self._ptr) - 1:
+            raise ValueError(
+                f"LibSVMIter: {len(self._ptr) - 1} data rows but "
+                f"{len(self._labels)} labels")
+        self._round_batch = round_batch
+        self._n = len(self._labels)
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size, self._feature_dim))]
+        self.provide_label = [DataDesc("softmax_label", (batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor < self._n
+
+    def next(self):
+        from .sparse import CSRNDArray
+        if not self.iter_next():
+            raise StopIteration
+        b = self.batch_size
+        lo = self._cursor
+        hi = min(lo + b, self._n)
+        pad = b - (hi - lo)
+        if pad and not self._round_batch:
+            b = hi - lo  # round_batch=False: emit the short final batch
+            pad = 0
+        rows = list(range(lo, hi)) + list(range(pad))  # wrap from the start
+        ptr = [0]
+        vals, idxs = [], []
+        for r in rows:
+            s, e = self._ptr[r], self._ptr[r + 1]
+            vals.append(self._vals[s:e])
+            idxs.append(self._idxs[s:e])
+            ptr.append(ptr[-1] + (e - s))
+        csr = CSRNDArray(onp.concatenate(vals) if vals else onp.zeros(0),
+                         onp.concatenate(idxs) if idxs else onp.zeros(0),
+                         onp.asarray(ptr, onp.int64),
+                         (b, self._feature_dim))
+        label = NDArray(self._labels[[min(r, self._n - 1) for r in rows]])
+        self._cursor = hi
+        return DataBatch(data=[csr], label=[label], pad=pad)
 
 
 class NativeImageRecordIter(DataIter):

@@ -1,0 +1,215 @@
+// C predict API (parity: include/mxnet/c_predict_api.h, implemented in
+// src/c_api/c_predict_api.cc). A C/C++ application links libmxtpu_predict.so
+// and runs inference on an exported model (gluon export: -symbol.json with an
+// embedded StableHLO program + .params) with no Python source of its own.
+//
+// Design: the library embeds the CPython runtime (Py_Initialize on first
+// MXPredCreate) and drives mxnet_tpu.c_predict through the CPython C API —
+// the same layering as the reference, where c_predict_api.cc sits on the
+// full runtime; here the runtime is Python-on-JAX, so the binding embeds it.
+// The XLA executable does the compute; this file only marshals buffers.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+
+struct Predictor {
+  PyObject* obj = nullptr;  // mxnet_tpu.c_predict._Predictor
+};
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+// capture the pending Python exception into g_last_error
+void CapturePyError() {
+  PyObject *type, *value, *trace;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  SetError(msg);
+}
+
+bool EnsurePython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);  // no signal handlers: the host app owns them
+    // release the GIL acquired by initialization; every entry point takes
+    // it back via PyGILState_Ensure. Without this, the initializing thread
+    // keeps the GIL forever and any other host thread deadlocks.
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// Mirrors c_predict_api.h MXPredCreate: symbol json string, param bytes,
+// device (accepted, informational — placement is PJRT's), named input shapes
+// via CSR-style (indptr, flat dims).
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, void** out) {
+  (void)dev_type;
+  (void)dev_id;
+  if (!EnsurePython()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *mod = nullptr, *fn = nullptr, *keys = nullptr, *shapes = nullptr,
+           *json = nullptr, *params = nullptr, *pred = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.c_predict");
+    if (!mod) { CapturePyError(); break; }
+    fn = PyObject_GetAttrString(mod, "create");
+    if (!fn) { CapturePyError(); break; }
+    keys = PyList_New(num_input_nodes);
+    shapes = PyList_New(num_input_nodes);
+    for (unsigned i = 0; i < num_input_nodes; ++i) {
+      PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+      unsigned lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject* shp = PyList_New(hi - lo);
+      for (unsigned j = lo; j < hi; ++j)
+        PyList_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+            input_shape_data[j]));
+      PyList_SetItem(shapes, i, shp);
+    }
+    json = PyUnicode_FromString(symbol_json_str);
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    pred = PyObject_CallFunctionObjArgs(fn, json, params, keys, shapes,
+                                        nullptr);
+    if (!pred) { CapturePyError(); break; }
+    auto* p = new Predictor();
+    p->obj = pred;
+    pred = nullptr;  // ownership moved
+    *out = p;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(pred);
+  Py_XDECREF(params);
+  Py_XDECREF(json);
+  Py_XDECREF(shapes);
+  Py_XDECREF(keys);
+  Py_XDECREF(fn);
+  Py_XDECREF(mod);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   unsigned size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // one bytes object for the whole buffer — no per-element boxing on the
+  // inference hot path; python side reads it with numpy.frombuffer
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size) * sizeof(float));
+  PyObject* r = PyObject_CallMethod(p->obj, "set_input", "sO", key, bytes);
+  if (r) { rc = 0; Py_DECREF(r); } else { CapturePyError(); }
+  Py_DECREF(bytes);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  if (r) { rc = 0; Py_DECREF(r); } else { CapturePyError(); }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(void* handle, unsigned index, unsigned** shape_data,
+                         unsigned* shape_ndim) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  if (shp) {
+    Py_ssize_t n = PyList_Size(shp);
+    // buffer owned by the predictor handle (freed in MXPredFree), matching
+    // the reference's handle-owned out_shape_data lifetime
+    auto* buf = new unsigned[n];
+    for (Py_ssize_t i = 0; i < n; ++i)
+      buf[i] = static_cast<unsigned>(PyLong_AsUnsignedLong(
+          PyList_GetItem(shp, i)));
+    // stash on the python object (one slot PER OUTPUT INDEX: a shared slot
+    // would free the previous caller-visible buffer) so Free can reap it
+    PyObject* cap = PyCapsule_New(buf, nullptr, [](PyObject* c) {
+      delete[] static_cast<unsigned*>(PyCapsule_GetPointer(c, nullptr));
+    });
+    std::string attr = "_shape_capsule_" + std::to_string(index);
+    PyObject_SetAttrString(p->obj, attr.c_str(), cap);
+    Py_DECREF(cap);
+    *shape_data = buf;
+    *shape_ndim = static_cast<unsigned>(n);
+    rc = 0;
+    Py_DECREF(shp);
+  } else {
+    CapturePyError();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(void* handle, unsigned index, float* data, unsigned size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* arr = PyObject_CallMethod(p->obj, "output", "I", index);
+  do {
+    if (!arr) { CapturePyError(); break; }
+    // numpy array, C-contiguous float32: read through the buffer protocol
+    Py_buffer view;
+    if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+      CapturePyError();
+      break;
+    }
+    size_t n = static_cast<size_t>(view.len) / sizeof(float);
+    if (n != size) {
+      PyBuffer_Release(&view);
+      SetError("MXPredGetOutput: size mismatch");
+      break;
+    }
+    std::memcpy(data, view.buf, view.len);
+    PyBuffer_Release(&view);
+    rc = 0;
+  } while (false);
+  Py_XDECREF(arr);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

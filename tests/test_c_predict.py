@@ -1,0 +1,131 @@
+"""C predict API end-to-end (parity: include/mxnet/c_predict_api.h +
+cpp-package inference example image-classification/predict-cpp): export a
+model from Python, then run inference from a compiled C program that links
+libmxtpu_predict.so and never touches Python source."""
+import os
+import subprocess
+import textwrap
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+
+NATIVE = os.path.join(os.path.dirname(mx.__file__), "native")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_DRIVER = textwrap.dedent("""
+    #include <stdio.h>
+    #include <stdlib.h>
+    #include <string.h>
+
+    extern int MXPredCreate(const char*, const void*, int, int, int,
+                            unsigned, const char**, const unsigned*,
+                            const unsigned*, void**);
+    extern int MXPredSetInput(void*, const char*, const float*, unsigned);
+    extern int MXPredForward(void*);
+    extern int MXPredGetOutputShape(void*, unsigned, unsigned**, unsigned*);
+    extern int MXPredGetOutput(void*, unsigned, float*, unsigned);
+    extern int MXPredFree(void*);
+    extern const char* MXGetLastError();
+
+    static char* slurp(const char* path, long* size) {
+        FILE* f = fopen(path, "rb");
+        if (!f) return NULL;
+        fseek(f, 0, SEEK_END);
+        *size = ftell(f);
+        fseek(f, 0, SEEK_SET);
+        char* buf = malloc(*size + 1);
+        if (fread(buf, 1, *size, f) != (size_t)*size) { fclose(f); return NULL; }
+        buf[*size] = 0;
+        fclose(f);
+        return buf;
+    }
+
+    int main(int argc, char** argv) {
+        long jsize, psize;
+        char* json = slurp(argv[1], &jsize);
+        char* params = slurp(argv[2], &psize);
+        if (!json || !params) { fprintf(stderr, "io\\n"); return 2; }
+
+        const char* keys[] = {"data"};
+        unsigned indptr[] = {0, 2};
+        unsigned dims[] = {2, 4};
+        void* h = NULL;
+        if (MXPredCreate(json, params, (int)psize, 1, 0, 1, keys, indptr,
+                         dims, &h) != 0) {
+            fprintf(stderr, "create: %s\\n", MXGetLastError());
+            return 3;
+        }
+        float in[8];
+        for (int i = 0; i < 8; ++i) in[i] = (float)i * 0.1f;
+        if (MXPredSetInput(h, "data", in, 8) != 0) {
+            fprintf(stderr, "set_input: %s\\n", MXGetLastError());
+            return 4;
+        }
+        if (MXPredForward(h) != 0) {
+            fprintf(stderr, "forward: %s\\n", MXGetLastError());
+            return 5;
+        }
+        unsigned* shape; unsigned ndim;
+        if (MXPredGetOutputShape(h, 0, &shape, &ndim) != 0) return 6;
+        unsigned total = 1;
+        printf("shape:");
+        for (unsigned i = 0; i < ndim; ++i) {
+            printf(" %u", shape[i]);
+            total *= shape[i];
+        }
+        printf("\\n");
+        float* out = malloc(total * sizeof(float));
+        if (MXPredGetOutput(h, 0, out, total) != 0) return 7;
+        printf("out:");
+        for (unsigned i = 0; i < total; ++i) printf(" %.6f", out[i]);
+        printf("\\n");
+        MXPredFree(h);
+        return 0;
+    }
+""")
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(NATIVE, "Makefile")),
+                    reason="native sources absent")
+def test_c_predict_end_to_end(tmp_path):
+    # 1. train-ish: build + run a small dense net, export it
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(onp.arange(8, dtype="float32").reshape(2, 4) * 0.1)
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    model_file, params_file = net.export(prefix)
+
+    # 2. build the predict library + the pure-C driver
+    r = subprocess.run(["make", "-C", NATIVE, "libmxtpu_predict.so"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    c_src = tmp_path / "driver.c"
+    c_src.write_text(C_DRIVER)
+    exe = tmp_path / "driver"
+    r = subprocess.run(
+        ["gcc", "-O2", str(c_src), "-o", str(exe),
+         f"-L{NATIVE}", "-lmxtpu_predict", f"-Wl,-rpath,{NATIVE}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    # 3. run the C program (embedded Python needs the repo on PYTHONPATH and
+    #    the CPU platform — same env contract as any mxnet_tpu process)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=f"{REPO}:{os.environ.get('PYTHONPATH', '')}")
+    r = subprocess.run([str(exe), model_file, params_file],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+
+    lines = dict(l.split(":", 1) for l in r.stdout.strip().splitlines())
+    shape = tuple(int(v) for v in lines["shape"].split())
+    assert shape == want.shape
+    got = onp.array([float(v) for v in lines["out"].split()],
+                    "float32").reshape(shape)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

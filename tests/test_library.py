@@ -115,3 +115,35 @@ def test_load_missing_entry_point(tmp_path):
     assert r.returncode == 0, r.stderr
     with pytest.raises(mx.MXNetError, match="mxtpu_lib_init"):
         mx.library.load(str(so), verbose=False)
+
+
+# ---------------------------------------------------------------------------
+# mx.rtc: runtime kernel module (rtc.py CudaModule analog over Pallas)
+# ---------------------------------------------------------------------------
+def test_rtc_pallas_module():
+    from mxnet_tpu import rtc
+    mod = rtc.PallasModule("""
+def axpy(x_ref, y_ref, o_ref):
+    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+
+def scale(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 3.0
+""")
+    x = nd.array(onp.arange(8, dtype="float32"))
+    y = nd.array(onp.ones(8, "float32"))
+    k = mod.get_kernel("axpy")
+    out = k.launch([x, y], out_shapes=[x.shape])
+    onp.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy() + 1, rtol=1e-6)
+    # second launch hits the executable cache; different kernel compiles anew
+    out2 = mod.get_kernel("scale").launch([x], out_shapes=[x.shape])
+    onp.testing.assert_allclose(out2.asnumpy(), 3 * x.asnumpy(), rtol=1e-6)
+
+
+def test_rtc_errors():
+    from mxnet_tpu import rtc
+    with pytest.raises(mx.MXNetError, match="failed to compile"):
+        rtc.PallasModule("def broken(:")
+    mod = rtc.PallasModule("def k(x_ref, o_ref):\n    o_ref[...] = x_ref[...]",
+                           exports=("k",))
+    with pytest.raises(mx.MXNetError, match="not found|not exported"):
+        mod.get_kernel("missing")

@@ -446,3 +446,26 @@ def test_sparse_astype():
     assert str(out.dtype) == "bfloat16"
     onp.testing.assert_allclose(out.todense().asnumpy().astype("float32"),
                                 rsp.todense().asnumpy(), rtol=1e-2)
+
+
+def test_libsvm_iter(tmp_path):
+    """LibSVM iterator yields CSR batches (iter_libsvm.cc parity pattern:
+    tests/python/unittest/test_io.py test_LibSVMIter)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import LibSVMIter
+    from mxnet_tpu.sparse import CSRNDArray
+    f = tmp_path / "train.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n")
+    it = LibSVMIter(str(f), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert isinstance(b0.data[0], CSRNDArray)
+    dense = b0.data[0].todense().asnumpy()
+    want = onp.zeros((2, 4), "float32")
+    want[0, 0], want[0, 3], want[1, 1] = 1.5, 2.0, 0.5
+    onp.testing.assert_allclose(dense, want)
+    onp.testing.assert_allclose(b0.label[0].asnumpy(), [1.0, 0.0])
+    assert batches[1].pad == 1  # 3 rows, batch 2 -> last batch padded
+    it.reset()
+    assert len(list(it)) == 2
