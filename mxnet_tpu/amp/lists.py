@@ -2,43 +2,102 @@
 
 On TPU the target reduced dtype is bfloat16 (fp16 lists kept for API compat).
 Ops in TARGET_DTYPE_OPS run in bf16 (MXU-bound: matmul/conv/attention); ops in
-FP32_OPS stay fp32 (reductions, softmax/norm internals use fp32 accumulation
-already); WIDEST_TYPE_CASTS follow their widest input.
+FP32_OPS stay fp32 (exp/log families, norms, losses, decompositions —
+numerically sensitive); WIDEST_TYPE_CASTS follow their widest input
+(elementwise/shape plumbing); DTYPE_NEUTRAL_OPS are untouched by AMP
+(integer/bool outputs, shape metadata, optimizer updates applied outside the
+autocast region, detection post-processing). The classification covers the
+whole float-facing registry — tests/test_amp.py asserts coverage so new ops
+must be placed deliberately, the discipline behind the reference's curated
+507-line list.
 """
 
 # compute-bound ops that benefit from bf16 on the MXU
 TARGET_DTYPE_OPS = [
-    "Convolution", "Deconvolution", "FullyConnected", "RNN", "dot", "batch_dot",
-    "matmul", "linalg_gemm2", "_contrib_interleaved_matmul_selfatt_qk",
+    "Convolution", "Deconvolution", "FullyConnected", "RNN", "dot",
+    "batch_dot", "matmul", "einsum", "khatri_rao", "linalg_gemm",
+    "linalg_gemm2", "linalg_syrk", "linalg_trmm",
+    "DeformableConvolution",
+    "_contrib_interleaved_matmul_selfatt_qk",
     "_contrib_interleaved_matmul_selfatt_valatt",
     "_contrib_interleaved_matmul_encdec_qk",
     "_contrib_interleaved_matmul_encdec_valatt", "multi_head_attention",
-    "Embedding",
+    "flash_attention", "Embedding",
 ]
 
 # numerically sensitive ops pinned to fp32
 FP32_OPS = [
-    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "L2Normalization",
-    "LRN", "SoftmaxOutput", "softmax", "log_softmax", "masked_softmax",
-    "softmax_cross_entropy", "CTCLoss", "exp", "log", "log2", "log10", "log1p",
-    "expm1", "sum", "mean", "prod", "nansum", "nanprod", "norm", "erf", "erfinv",
-    "gamma", "gammaln", "cumsum", "logsumexp", "linalg_potrf", "linalg_sumlogdiag",
-    "linalg_syrk", "linalg_trsm", "linalg_trmm", "linalg_svd", "linalg_inverse",
-    "linalg_det", "linalg_slogdet", "moments",
+    "BatchNorm", "SyncBatchNorm", "BatchNormWithReLU", "LayerNorm",
+    "GroupNorm", "InstanceNorm", "L2Normalization", "LRN", "SoftmaxOutput",
+    "softmax", "log_softmax", "masked_softmax", "softmin", "softmax_cross_entropy", "CTCLoss", "exp", "log", "log2",
+    "log10", "log1p", "expm1", "sum", "mean", "prod", "nansum", "nanprod",
+    "norm", "erf", "erfinv", "gamma", "gammaln", "digamma", "cumsum",
+    "cumprod", "logsumexp", "linalg_potrf", "linalg_potri",
+    "linalg_sumlogdiag", "linalg_trsm", "linalg_svd", "linalg_inverse",
+    "linalg_det", "linalg_slogdet", "linalg_syevd", "linalg_gelqf",
+    "moments", "mish", "smooth_l1", "_contrib_hawkes_ll",
+    "RMSNorm", "SoftmaxActivation", "softrelu", "gelu_tanh", "erf_inv",
+    "sum_axis", "_contrib_div_sqrt_dim",
+    "rsqrt", "rcbrt", "reciprocal", "cosh", "sinh", "tanh",
+    "arcsinh", "arccosh", "arctanh", "sigmoid", "hard_sigmoid", "softsign",
+    "_contrib_fft", "_contrib_ifft", "_contrib_count_sketch", "col2im",
 ]
 
 # conditionally fp32 (parity with symbol_fp16.py CONDITIONAL_FP32_FUNCS)
 CONDITIONAL_FP32_OPS = [
     ("Activation", "act_type", ["softrelu"]),
-    ("leaky_relu", "act_type", ["elu", "selu"]),
+    ("leaky_relu", "act_type", ["gelu"]),
 ]
 
-# ops that take the widest dtype among inputs
+# ops that take the widest dtype among inputs (safe in any float dtype)
 WIDEST_TYPE_CASTS = [
     "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
-    "broadcast_mod", "broadcast_power", "broadcast_maximum", "broadcast_minimum",
-    "broadcast_hypot", "elemwise_add", "elemwise_sub", "elemwise_mul",
-    "elemwise_div", "add_n", "concat", "stack", "where",
+    "broadcast_mod", "broadcast_power", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_hypot", "elemwise_add", "elemwise_sub",
+    "elemwise_mul", "elemwise_div", "add_n", "concat", "stack", "where",
+    "maximum", "minimum", "clip", "abs", "sign", "negative", "square",
+    "sqrt", "cbrt", "floor", "ceil", "round", "rint", "trunc", "fix",
+    "relu", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "degrees",
+    "radians", "gelu", "silu", "prelu", "Activation",
+    "leaky_relu", "Pooling", "UpSampling", "Dropout", "reshape", "flatten", "transpose", "swapaxes", "expand_dims", "squeeze",
+    "broadcast_to", "broadcast_axis", "broadcast_like", "reshape_like",
+    "split", "split_v2", "slice", "slice_axis", "slice_like", "pad", "tile",
+    "repeat", "reverse", "depth_to_space", "space_to_depth",
+    "diag", "take", "batch_take", "pick", "gather_nd", "scatter_nd",
+    "index_add", "index_copy", "slice_assign", "slice_assign_scalar",
+    "sequence_mask", "sequence_last", "sequence_reverse",
+    "boolean_mask_dense", "sort", "max", "min", "identity",
+    "BlockGrad", "im2col", "_contrib_ROIAlign", "ROIPooling",
+    "BilinearResize2D", "AdaptiveAvgPooling2D", "_contrib_gradientmultiplier",
+    "_contrib_quadratic", "ldexp", "_div_scalar", "_hypot_scalar",
+    "_maximum_scalar", "_minimum_scalar", "_minus_scalar", "_mod_scalar",
+    "_mul_scalar", "_plus_scalar", "_power_scalar", "_scatter_set_nd",
+    "arctan2", "linalg_extractdiag", "linalg_extracttrian",
+    "linalg_makediag", "linalg_maketrian", "_contrib_index_copy",
+]
+
+# untouched by AMP: integer/bool/index outputs, shape metadata, RNG,
+# optimizer updates (run outside the autocast region), quantization,
+# detection post-processing, graph/debug utilities
+DTYPE_NEUTRAL_OPS = [
+    "cast", "amp_cast", "amp_multicast", "zeros_like", "ones_like",
+    "shape_array",
+    "size_array", "argmax", "argmin", "argsort", "topk", "unique",
+    "one_hot", "histogram", "ravel_multi_index", "unravel_index",
+    "arange_like", "logical_not",
+    "isnan", "isinf", "isfinite", "all_finite", "multi_all_finite",
+    "multi_sum_sq", "reset_arrays", "allclose", "bipartite_matching",
+    "edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
+    "dgl_csr_neighbor_non_uniform_sample", "_contrib_index_array",
+    "_contrib_getnnz", "_contrib_box_iou", "_contrib_box_nms",
+    "_contrib_box_encode", "_contrib_box_decode", "MultiBoxPrior",
+    "MultiBoxTarget", "MultiBoxDetection", "Proposal", "argmax_channel",
+    "broadcast_equal", "broadcast_greater", "broadcast_greater_equal",
+    "broadcast_lesser", "broadcast_lesser_equal", "broadcast_logical_and",
+    "broadcast_logical_or", "broadcast_logical_xor", "broadcast_not_equal",
+    "_contrib_calibrate_entropy", "_contrib_quantize_v2",
+    "_contrib_dequantize", "_contrib_requantize", "_contrib_quantized_conv",
+    "_contrib_quantized_fully_connected",
 ]
 
 FP16_FUNCS = TARGET_DTYPE_OPS          # compat aliases (reference naming)

@@ -480,6 +480,59 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        """NEP-13 dispatch (parity: numpy_dispatch_protocol.py): numpy ufuncs
+        applied to NDArrays run the device implementation from mx.np when one
+        matches the call exactly; anything else (reduce/accumulate, dtype=,
+        where=, out=, ufuncs with no device analog) computes on host via
+        __array__ — defining __array_ufunc__ disables numpy's automatic
+        coercion, so the fallback must be explicit or those calls TypeError."""
+        from .. import numpy as mx_np
+        fn = getattr(mx_np, ufunc.__name__, None)
+        if method == "__call__" and fn is not None and not kwargs:
+            try:
+                return fn(*inputs)
+            except Exception:  # noqa: BLE001 — fall through to host path
+                pass
+        import jax.numpy as jnp
+
+        def unwrap(a):
+            return a.asnumpy() if isinstance(a, NDArray) else a
+
+        host_inputs = tuple(unwrap(a) for a in inputs)
+        out = kwargs.pop("out", None)
+        result = getattr(ufunc, method)(*host_inputs, **kwargs)
+        if out is not None:
+            outs = out if isinstance(out, tuple) else (out,)
+            results = result if isinstance(result, tuple) else (result,)
+            written = []
+            for o, r in zip(outs, results):
+                if isinstance(o, NDArray):
+                    o._set_data(jnp.asarray(onp.asarray(r)).astype(
+                        o.data.dtype))
+                    written.append(o)
+                else:
+                    o[...] = r
+                    written.append(o)
+            return written[0] if len(written) == 1 else tuple(written)
+        return result
+
+    def __array_function__(self, func, types, args, kwargs):
+        """NEP-18 dispatch: onp.mean(x)/onp.concatenate([...]) etc. route to
+        the mx.np implementation when one exists."""
+        from .. import numpy as mx_np
+        fn = getattr(mx_np, func.__name__, None)
+        if fn is None or fn is func:
+            # no device implementation: evaluate on host via __array__
+            def unwrap(a):
+                if isinstance(a, NDArray):
+                    return a.asnumpy()
+                if isinstance(a, (list, tuple)):
+                    return type(a)(unwrap(x) for x in a)
+                return a
+            return func(*[unwrap(a) for a in args], **kwargs)
+        return fn(*args, **kwargs)
+
     def __dlpack__(self, **kw):
         return self._data.__dlpack__(**kw)
 

@@ -157,6 +157,13 @@ _DIFFERENTIABLE_EXCEPTIONS = {
 }
 
 
+# functions whose first argument is ONE sequence of arrays: the registry op
+# receives them variadically, so re-pack before calling jnp (meshgrid/
+# broadcast_arrays are genuinely variadic in jnp and stay out)
+_SEQ_FUNCS = {"concatenate", "stack", "vstack", "hstack", "dstack",
+              "column_stack", "lexsort"}
+
+
 def _ensure_np_op(name):
     opname = f"_np_{name}"
     try:
@@ -166,8 +173,12 @@ def _ensure_np_op(name):
     import jax.numpy as jnp
     base = getattr(jnp, name)
 
-    def fn(*arrays, **attrs):
-        return base(*arrays, **attrs)
+    if name in _SEQ_FUNCS:
+        def fn(*arrays, **attrs):
+            return base(arrays, **attrs)
+    else:
+        def fn(*arrays, **attrs):
+            return base(*arrays, **attrs)
     fn.__name__ = opname
     fn.__doc__ = f"numpy-compatible {name} (jnp-backed)"
     _reg.register(opname, differentiable=name not in _DIFFERENTIABLE_EXCEPTIONS)(fn)
@@ -194,8 +205,11 @@ def _make_np_wrapper(name):
             try:
                 sig = inspect.signature(getattr(jnp, name))
                 names = [p.name for p in sig.parameters.values()]
+                # sequence-first functions consume ALL arrays as jnp's first
+                # parameter, so positionals continue from index 1 there
+                base_idx = 1 if name in _SEQ_FUNCS else len(arrays)
                 for i, val in enumerate(rest):
-                    kwargs[names[len(arrays) + i]] = val
+                    kwargs[names[base_idx + i]] = val
             except (ValueError, TypeError, IndexError):
                 raise MXNetError(f"np.{name}: unsupported positional arguments")
         return _reg.invoke(op, arrays, kwargs)

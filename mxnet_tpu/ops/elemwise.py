@@ -116,6 +116,28 @@ def amp_cast(x, *, dtype):
     return x.astype(DTypes.jnp(dtype))
 
 
+@register("amp_multicast")
+def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
+    """Cast a group of arrays to a common float dtype
+    (tensor/amp_cast.cc AMPMultiCast): widest by default, narrowest with
+    ``cast_narrow`` — the multi-input consistency op AMP inserts before
+    widest-type ops."""
+    floats = [a.dtype for a in arrays
+              if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not floats:
+        return arrays if len(arrays) > 1 else arrays[0]
+    order = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
+
+    def rank(dt):
+        return order.index(dt) if dt in order else len(order)
+
+    target = min(floats, key=rank) if cast_narrow else max(floats, key=rank)
+    outs = tuple(a.astype(target)
+                 if jnp.issubdtype(a.dtype, jnp.floating) else a
+                 for a in arrays)
+    return outs if len(outs) > 1 else outs[0]
+
+
 @register("leaky_relu")
 def leaky_relu(x, *, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334):
     """LeakyReLU family (src/operator/leaky_relu.cc): leaky/elu/selu/gelu supported;

@@ -65,7 +65,8 @@ def _param_shape_hook(op, attrs, in_shapes, arg_names):
     return out
 
 
-def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False):
+def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False,
+                  node_shapes_out: Optional[dict] = None):
     """Forward shape-inference walk (infer_graph_attr_pass.cc analog)."""
     import jax
     import jax.numpy as jnp
@@ -162,6 +163,8 @@ def _infer_shapes(sym: Symbol, known: Dict[str, tuple], partial=False):
         else:
             outs = node_out_shapes.get(id(s._node))
             out_shapes.append(outs[s._index] if outs else None)
+    if node_shapes_out is not None:
+        node_shapes_out.update(node_out_shapes)
     return shapes, out_shapes, None
 
 

@@ -22,7 +22,12 @@ _VAR_COLOR = "#8dd3c7"
 
 
 def print_summary(block, input_shape=None, line_length=98):
-    """Print a per-layer summary table of a gluon Block (visualization.py:25)."""
+    """Print a per-layer summary table (visualization.py:25). Accepts a
+    Symbol (op-level rows, output shapes from infer_shape — the reference
+    signature) or a gluon Block (parameter rows)."""
+    from .symbol.symbol import Symbol
+    if isinstance(block, Symbol):
+        return _print_symbol_summary(block, input_shape, line_length)
     rows = []
     total_params = 0
     for name, param in block.collect_params().items():
@@ -36,6 +41,58 @@ def print_summary(block, input_shape=None, line_length=98):
     print("=" * line_length)
     for name, shape, n in rows:
         print(f"{name:<60}{str(shape):<25}{n:>12}")
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    return total_params
+
+
+def _print_symbol_summary(sym, shape, line_length):
+    """Per-node table for a Symbol: op, output shape, param count, inputs
+    (the reference print_summary layout, visualization.py:25-196)."""
+    arg_shapes = {}
+    node_shapes = {}
+    if shape:
+        try:
+            from .symbol.executor import _infer_shapes
+            shapes, _, _ = _infer_shapes(
+                sym, {k: tuple(v) for k, v in shape.items()},
+                node_shapes_out=node_shapes)
+            arg_shapes = dict(shapes)  # already {arg_name: shape}
+        except Exception:  # noqa: BLE001 — shapes are decoration only
+            pass
+    total_params = 0
+    param_suffixes = ("weight", "bias", "gamma", "beta", "moving_mean",
+                      "moving_var", "running_mean", "running_var")
+    counted = set()  # a shared variable counts once, not per consumer
+    print("=" * line_length)
+    print(f"{'Layer (type)':<36}{'Output Shape':<24}{'Param #':>10}  "
+          f"{'Previous Layer':<26}")
+    print("=" * line_length)
+    for n in sym._topo():
+        if n.is_var:
+            continue
+        params = 0
+        prev = []
+        for slot in n.inputs:
+            if slot is None:
+                continue
+            src, _ = slot
+            if src.is_var:
+                shp = arg_shapes.get(src.name)
+                if shp and src.name.endswith(param_suffixes) and \
+                        src.name not in counted:
+                    counted.add(src.name)
+                    cnt = 1
+                    for s in shp:
+                        cnt *= s
+                    params += cnt
+            else:
+                prev.append(src.name)
+        total_params += params
+        outs = node_shapes.get(id(n))
+        out_shape = "x".join(map(str, outs[0])) if outs and outs[0] else ""
+        print(f"{n.name + ' (' + n.op + ')':<36}{out_shape:<24}"
+              f"{params:>10}  {','.join(prev[:2]):<26}")
     print("=" * line_length)
     print(f"Total params: {total_params}")
     return total_params

@@ -72,3 +72,47 @@ def test_amp_lists_disjoint():
     low = set(amp.lists.TARGET_DTYPE_OPS)
     high = set(amp.lists.FP32_OPS)
     assert not (low & high)
+
+
+def test_amp_lists_cover_float_registry():
+    """Every float-facing registered op must be deliberately classified in
+    exactly one AMP list (the curation discipline of symbol_fp16.py:22-507);
+    no op may appear in two lists."""
+    from mxnet_tpu.amp import lists
+    from mxnet_tpu.ops import registry
+
+    groups = {
+        "target": set(lists.TARGET_DTYPE_OPS),
+        "fp32": set(lists.FP32_OPS),
+        "widest": set(lists.WIDEST_TYPE_CASTS),
+        "neutral": set(lists.DTYPE_NEUTRAL_OPS),
+    }
+    cond = {name for name, _, _ in lists.CONDITIONAL_FP32_OPS}
+    # no duplicates across lists (conditional overlaps widest by design)
+    names = list(groups.values())
+    for i in range(len(names)):
+        for j in range(i + 1, len(names)):
+            dup = names[i] & names[j]
+            assert not dup, f"ops in two AMP lists: {sorted(dup)}"
+
+    classified = set().union(*groups.values()) | cond
+    all_ops = set(registry._OPS)
+    # reverse containment: every listed name must be a real registered op --
+    # a typo'd pin would otherwise silently no-op at conversion time
+    phantoms = sorted(classified - all_ops)
+    assert not phantoms, "AMP lists name unregistered ops: %s" % phantoms
+    # families outside the autocast question: random samplers, optimizer
+    # update ops, quantization, sparse plumbing, numpy lazy names, internals
+    def exempt(n):
+        return (n.startswith(("_np_", "_npl_", "_random_", "_sample_",
+                              "random_", "sample_", "_sg", "quantize",
+                              "dequantize", "requantize", "quantized_")) or
+                "update" in n or n.startswith("multi_lars") or
+                n.startswith("preloaded_") or n in ("_getitem", "_shuffle",
+                                                    "_CachedSubgraph",
+                                                    "Custom"))
+    unclassified = sorted(n for n in all_ops
+                          if n not in classified and not exempt(n))
+    # allow a small unclassified tail, but it must not grow silently
+    assert len(unclassified) == 0, \
+        f"{len(unclassified)} unclassified ops: {unclassified}"

@@ -106,3 +106,36 @@ def test_histogram2d_and_digitize():
     assert h.asnumpy().sum() == 3
     bins = np.array(onp.array([0.0, 0.5, 1.0], "float32"))
     onp.testing.assert_array_equal(np.digitize(x, bins).asnumpy(), [1, 2, 2])
+
+
+def test_numpy_dispatch_protocol():
+    """NEP-13/18 interop (parity: numpy_dispatch_protocol.py): host numpy
+    ufuncs/functions applied to NDArrays run device implementations and
+    return NDArrays."""
+    x = np.array(onp.array([1.0, 4.0, 9.0], "float32"))
+    out = onp.sqrt(x)                       # ufunc -> device sqrt
+    assert isinstance(out, type(x)), type(out)
+    onp.testing.assert_allclose(out.asnumpy(), [1, 2, 3], rtol=1e-6)
+    out2 = onp.mean(x)                      # NEP-18 function -> device mean
+    assert isinstance(out2, type(x))
+    assert float(out2.asnumpy()) == pytest.approx(14 / 3)
+    out3 = onp.concatenate([x, x])
+    assert isinstance(out3, type(x)) and out3.shape == (6,)
+    # functions with no device analog still work via host fallback
+    got = onp.array_split(x, 2)
+    assert len(got) == 2
+    # ufunc paths with no device analog: reduce, out=, dtype=, augmented host
+    assert float(onp.add.reduce(x)) == pytest.approx(14.0)
+    buf = onp.zeros(3, "float32")
+    onp.sqrt(x, out=buf)
+    onp.testing.assert_allclose(buf, [1, 2, 3])
+    o = nd.zeros((3,))
+    onp.sqrt(x, out=o)
+    onp.testing.assert_allclose(o.asnumpy(), [1, 2, 3])
+    assert onp.sqrt(x, dtype="float64").dtype == onp.float64
+    host = onp.ones(3, "float32")
+    host += x
+    onp.testing.assert_allclose(host, [2, 5, 10])
+    # positional axis on a sequence-first function
+    c = onp.concatenate([x.reshape(1, 3), x.reshape(1, 3)], 1)
+    assert c.shape == (1, 6)

@@ -185,3 +185,19 @@ def test_load_json_reference_format():
     legacy["nodes"][3]["param"] = legacy["nodes"][3].pop("attrs")
     s2 = sym.load_json(json.dumps(legacy))
     assert s2.list_arguments() == s.list_arguments()
+
+
+def test_print_summary_symbol(capsys):
+    """print_summary over a Symbol: per-op rows, inferred output shapes,
+    param counts (visualization.py:25 reference signature)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="act")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    total = mx.visualization.print_summary(net, {"data": (2, 8)})
+    assert total == (8 * 16 + 16) + (16 * 4 + 4)
+    out = capsys.readouterr().out
+    assert "fc1 (FullyConnected)" in out and "2x16" in out
