@@ -1,0 +1,69 @@
+"""BERT pretraining example (parity: the gluon-nlp BERT pretraining workflow
+this fork's fused attention ops exist for — reference
+src/operator/contrib/transformer.cc).
+
+Runs masked-LM + next-sentence pretraining on synthetic token streams through
+the fused ParallelTrainStep (whole train step as one XLA computation,
+bfloat16 compute). Scale model/batch down with flags for a laptop-size smoke
+run; defaults are BERT-base shaped.
+
+Usage:
+    python examples/bert/pretrain.py --layers 2 --hidden 128 --steps 4
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.gluon.model_zoo import bert
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--layers", type=int, default=12)
+    p.add_argument("--hidden", type=int, default=768)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--vocab", type=int, default=30522)
+    args = p.parse_args()
+
+    backbone = bert.BERTModel(
+        vocab_size=args.vocab, units=args.hidden, hidden_size=4 * args.hidden,
+        num_layers=args.layers, num_heads=args.heads, max_length=args.seq_len)
+    model = bert.BERTForPretraining(backbone, vocab_size=args.vocab)
+    model.initialize(mx.init.Normal(0.02))
+
+    import jax
+    # data-parallel over the whole device set; the global batch must divide
+    # evenly, so round it up to a multiple of the device count
+    dp = jax.device_count()
+    if args.batch_size % dp:
+        args.batch_size = -(-args.batch_size // dp) * dp
+        print(f"batch size rounded up to {args.batch_size} for dp={dp}")
+    mesh = parallel.make_mesh({"dp": dp})
+    print(f"devices: {dp} ({jax.devices()[0].platform})")
+    from jax.sharding import PartitionSpec as P
+    step = parallel.ParallelTrainStep(
+        model, bert.BERTPretrainingLoss(),
+        mx.optimizer.Adam(learning_rate=args.lr), mesh,
+        compute_dtype="bfloat16", extra_specs=(P("dp"),))
+
+    rng = onp.random.RandomState(0)
+    b, s = args.batch_size, args.seq_len
+    for i in range(args.steps):
+        toks = rng.randint(0, args.vocab, (b, s)).astype("int32")
+        tt = onp.zeros((b, s), "int32")
+        mlm = onp.where(rng.rand(b, s) < 0.15,
+                        rng.randint(0, args.vocab, (b, s)), -1).astype("int32")
+        nsp = rng.randint(0, 2, (b,)).astype("int32")
+        loss = step.step(*step.place_batch(toks, (mlm, nsp), tt))
+        print(f"step {i}: loss={float(loss.asscalar()):.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

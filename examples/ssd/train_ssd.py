@@ -1,0 +1,64 @@
+"""SSD-300 detection training example (parity: example/ssd/train.py workflow
+— BASELINE config 4). Synthetic boxes by default; the model, target matching
+(MultiBoxTarget), hard-negative-mined loss and decode/NMS (detect →
+MultiBoxDetection) are the real pipeline.
+
+Usage:
+    python examples/ssd/train_ssd.py --steps 2
+"""
+import argparse
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.model_zoo.vision.ssd import SSDMultiBoxLoss
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--classes", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--detect", action="store_true",
+                   help="run decode+NMS after training")
+    args = p.parse_args()
+
+    net = vision.get_model("ssd_300_vgg16", classes=args.classes)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = SSDMultiBoxLoss()
+
+    rng = onp.random.RandomState(0)
+    b = args.batch_size
+    x = nd.array(rng.rand(b, 3, 300, 300).astype("float32"))
+    for i in range(args.steps):
+        x = nd.array(rng.rand(b, 3, 300, 300).astype("float32"))
+        # one synthetic gt box per image: [cls, x1, y1, x2, y2] + padding row
+        label = onp.full((b, 2, 5), -1.0, "float32")
+        label[:, 1, 1:] = 0.0
+        label[:, 0, 0] = rng.randint(0, args.classes, b)
+        x1y1 = rng.rand(b, 2) * 0.4
+        label[:, 0, 1:3] = x1y1
+        label[:, 0, 3:5] = x1y1 + 0.3
+        label = nd.array(label)
+        with autograd.record():
+            anchors, cls_preds, loc_preds = net(x)
+            loss = loss_fn(anchors, cls_preds, loc_preds, label)
+        loss.backward()
+        trainer.step(b)
+        print(f"step {i}: loss={float(loss.mean().asscalar()):.4f}")
+
+    if args.detect:
+        det = net.detect(x, threshold=0.1)
+        kept = det.asnumpy()
+        kept = kept[kept[:, :, 0] >= 0]
+        print(f"detections kept after NMS: {kept.shape[0]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
