@@ -68,7 +68,7 @@ WIDEST_TYPE_CASTS = [
     "sequence_mask", "sequence_last", "sequence_reverse",
     "boolean_mask_dense", "sort", "max", "min", "identity",
     "BlockGrad", "im2col", "_contrib_ROIAlign", "ROIPooling",
-    "BilinearResize2D", "AdaptiveAvgPooling2D", "_contrib_gradientmultiplier",
+    "BilinearResize2D", "AdaptiveAvgPooling2D", "GridGenerator", "BilinearSampler", "SpatialTransformer", "_contrib_gradientmultiplier",
     "_contrib_quadratic", "ldexp", "_div_scalar", "_hypot_scalar",
     "_maximum_scalar", "_minimum_scalar", "_minus_scalar", "_mod_scalar",
     "_mul_scalar", "_plus_scalar", "_power_scalar", "_scatter_set_nd",

@@ -719,3 +719,70 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None, *,
     m = jnp.maximum(a_last, a_prev)
     ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
     return (-ll).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# spatial transformer family (src/operator/spatial_transformer.cc,
+# grid_generator.cc, bilinear_sampler.cc)
+# ---------------------------------------------------------------------------
+@register("GridGenerator", jit=True)
+def grid_generator(data, *, transform_type="affine", target_shape=(0, 0)):
+    """Sampling-grid generation (grid_generator.cc). 'affine': data is
+    (N, 6) affine matrices -> grid (N, 2, H, W) of (x, y) coords in [-1, 1];
+    'warp': data is (N, 2, H, W) flow added to the identity grid."""
+    h, w = target_shape
+    if transform_type == "affine":
+        if h <= 0 or w <= 0:
+            raise ValueError("GridGenerator(affine) requires a positive "
+                             f"target_shape, got {target_shape}")
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3).astype(jnp.float32)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, H*W)
+        out = jnp.einsum("nij,jp->nip", theta, base)              # (n, 2, H*W)
+        return out.reshape(n, 2, h, w).astype(data.dtype)
+    if transform_type == "warp":
+        n, _, fh, fw = data.shape
+        ys = jnp.linspace(-1.0, 1.0, fh)
+        xs = jnp.linspace(-1.0, 1.0, fw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        # flow is in pixels; normalize to the [-1, 1] grid scale
+        norm = jnp.stack([data[:, 0] * 2.0 / jnp.maximum(fw - 1, 1),
+                          data[:, 1] * 2.0 / jnp.maximum(fh - 1, 1)], axis=1)
+        ident = jnp.stack([gx, gy])[None]
+        return (ident + norm).astype(data.dtype)
+    raise ValueError(f"GridGenerator: unknown transform_type {transform_type!r}")
+
+
+@register("BilinearSampler", jit=True)
+def bilinear_sampler(data, grid, *, cudnn_off=False):
+    """Sample data (N, C, H, W) at grid (N, 2, OH, OW) of normalized (x, y)
+    in [-1, 1], zero padding outside (bilinear_sampler.cc) — one vectorized
+    4-corner gather, shared with DeformableConvolution."""
+    from .contrib import _bilinear_sample_nchw
+    n, c, h, w = data.shape
+    oh, ow = grid.shape[2], grid.shape[3]
+    px = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    py = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    sampled = _bilinear_sample_nchw(data.astype(jnp.float32),
+                                    py.reshape(n, -1).astype(jnp.float32),
+                                    px.reshape(n, -1).astype(jnp.float32))
+    return sampled.reshape(n, oh, ow, c).transpose(0, 3, 1, 2) \
+        .astype(data.dtype)
+
+
+@register("SpatialTransformer", jit=True)
+def spatial_transformer(data, loc, *, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """Affine spatial transformer network head (spatial_transformer.cc):
+    localization output -> sampling grid -> bilinear sample."""
+    if sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer: only sampler_type='bilinear' "
+                         f"is supported (got {sampler_type!r})")
+    grid = grid_generator(loc, transform_type=transform_type,
+                          target_shape=tuple(target_shape))
+    return bilinear_sampler(data, grid)

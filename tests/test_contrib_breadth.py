@@ -188,3 +188,44 @@ def test_proposal_shapes_and_clip():
     # scores sorted descending where valid
     s = scores.asnumpy().ravel()
     assert (onp.diff(s[s > 0]) <= 1e-6).all()
+
+
+def test_spatial_transformer_family():
+    """GridGenerator/BilinearSampler/SpatialTransformer (parity pattern:
+    tests/python/unittest/test_operator.py test_stn / test_bilinear_sampler):
+    identity affine must reproduce the input; warp grid shifts pixels."""
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(9)
+    x = nd.array(rng.rand(2, 3, 5, 5).astype("float32"))
+    ident = nd.array(onp.tile(onp.array([1, 0, 0, 0, 1, 0], "float32"),
+                              (2, 1)))
+    grid = nd.GridGenerator(ident, transform_type="affine",
+                            target_shape=(5, 5))
+    assert grid.shape == (2, 2, 5, 5)
+    out = nd.BilinearSampler(x, grid)
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy(), atol=1e-5)
+    # SpatialTransformer composes the two
+    out2 = nd.SpatialTransformer(x, ident, target_shape=(5, 5),
+                                 transform_type="affine",
+                                 sampler_type="bilinear")
+    onp.testing.assert_allclose(out2.asnumpy(), x.asnumpy(), atol=1e-5)
+    # downscale to 3x3 keeps the corner pixels (linspace endpoints)
+    out3 = nd.SpatialTransformer(x, ident, target_shape=(3, 3),
+                                 transform_type="affine")
+    onp.testing.assert_allclose(out3.asnumpy()[:, :, 0, 0],
+                                x.asnumpy()[:, :, 0, 0], atol=1e-5)
+    # gradients flow to both data and the localization output
+    x.attach_grad(); ident.attach_grad()
+    with autograd.record():
+        y = nd.SpatialTransformer(x, ident, target_shape=(5, 5),
+                                  transform_type="affine")
+        y.sum().backward()
+    assert float(onp.abs(x.grad.asnumpy()).sum()) > 0
+    assert ident.grad is not None
+    # warp grid: +1 pixel x-shift samples the next column
+    flow = nd.array(onp.zeros((2, 2, 5, 5), "float32"))
+    flow[:, 0] = nd.array(onp.ones((2, 5, 5), "float32"))
+    wgrid = nd.GridGenerator(flow, transform_type="warp")
+    wout = nd.BilinearSampler(x, wgrid)
+    onp.testing.assert_allclose(wout.asnumpy()[:, :, :, :-1],
+                                x.asnumpy()[:, :, :, 1:], atol=1e-5)
