@@ -68,7 +68,7 @@ WIDEST_TYPE_CASTS = [
     "sequence_mask", "sequence_last", "sequence_reverse",
     "boolean_mask_dense", "sort", "max", "min", "identity",
     "BlockGrad", "im2col", "_contrib_ROIAlign", "ROIPooling",
-    "BilinearResize2D", "AdaptiveAvgPooling2D", "GridGenerator", "BilinearSampler", "SpatialTransformer", "_contrib_gradientmultiplier",
+    "BilinearResize2D", "AdaptiveAvgPooling2D", "GridGenerator", "BilinearSampler", "SpatialTransformer", "_contrib_gradientmultiplier", "IdentityAttachKLSparseReg",
     "_contrib_quadratic", "ldexp", "_div_scalar", "_hypot_scalar",
     "_maximum_scalar", "_minimum_scalar", "_minus_scalar", "_mod_scalar",
     "_mul_scalar", "_plus_scalar", "_power_scalar", "_scatter_set_nd",
@@ -97,7 +97,9 @@ DTYPE_NEUTRAL_OPS = [
     "broadcast_logical_or", "broadcast_logical_xor", "broadcast_not_equal",
     "_contrib_calibrate_entropy", "_contrib_quantize_v2",
     "_contrib_dequantize", "_contrib_requantize", "_contrib_quantized_conv",
-    "_contrib_quantized_fully_connected",
+    "_contrib_quantized_fully_connected", "_contrib_quantized_pooling",
+    "_contrib_quantized_act", "_contrib_quantized_flatten",
+    "_contrib_quantized_concat", "_contrib_quantized_elemwise_add",
 ]
 
 FP16_FUNCS = TARGET_DTYPE_OPS          # compat aliases (reference naming)

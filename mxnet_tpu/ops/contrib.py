@@ -849,3 +849,54 @@ def proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, out_scores.reshape(n * post, 1)
     return rois
+
+
+# ---------------------------------------------------------------------------
+# KL sparsity regularizer (identity_attach_KL_sparse_reg.cc)
+# ---------------------------------------------------------------------------
+def _make_kl_reg():
+    import jax
+
+    @jax.custom_vjp
+    def kl_reg(x, rho_hat, target, penalty):
+        return x
+
+    def fwd(x, rho_hat, target, penalty):
+        return x, (rho_hat, target, penalty, x.shape)
+
+    def bwd(res, g):
+        rho_hat, target, penalty, shape = res
+        # dKL/drho_hat per hidden unit, broadcast over the batch axis
+        grad_unit = penalty * (-(target / rho_hat)
+                               + (1 - target) / (1 - rho_hat))
+        return g + jnp.broadcast_to(grad_unit, shape), None, None, None
+
+    kl_reg.defvjp(fwd, bwd)
+    return kl_reg
+
+
+_KL_REG = None
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, moving_avg=None, *,
+                                  sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Identity forward; backward adds the KL(rho || rho_hat) sparsity
+    penalty gradient (identity_attach_KL_sparse_reg.cc — sparse
+    autoencoders). rho_hat is the EMA of each unit's mean activation;
+    returns (out, new_moving_avg) — aux write-back is the caller's, the
+    functional formulation used for BatchNorm's moving stats."""
+    global _KL_REG
+    if _KL_REG is None:
+        _KL_REG = _make_kl_reg()
+    batch_rho = jnp.clip(jnp.mean(data, axis=0), 1e-6, 1 - 1e-6)
+    if moving_avg is None:
+        rho_hat = batch_rho
+        new_avg = batch_rho
+    else:
+        new_avg = momentum * moving_avg + (1 - momentum) * batch_rho
+        rho_hat = jnp.clip(new_avg, 1e-6, 1 - 1e-6)
+    out = _KL_REG(data, lax.stop_gradient(rho_hat),
+                  jnp.float32(sparseness_target), jnp.float32(penalty))
+    return out, lax.stop_gradient(new_avg)

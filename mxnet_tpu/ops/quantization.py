@@ -17,7 +17,13 @@ from jax import lax
 
 from .registry import register
 
-INT8_QMAX = 127.0  # MinAbs(MaxValue<int8>, MinValue<int8>) — zero-centered
+INT8_QMAX = 127.0
+INT32_QMAX = 2147483647.0
+# int32 accumulator convention (quantization_utils.h): a tensor of int32
+# codes carries a range spanning the FULL int32 grid, i.e.
+# real = acc * amax / INT32_QMAX. Producers whose codes live on a
+# 127*127 grid must scale their carried range by INT32_SPAN_RATIO.
+INT32_SPAN_RATIO = INT32_QMAX / (INT8_QMAX * INT8_QMAX)  # MinAbs(MaxValue<int8>, MinValue<int8>) — zero-centered
 
 
 # ---------------------------------------------------------------------------
@@ -66,7 +72,7 @@ def dequantize(data, min_range, max_range, *, out_type="float32"):
     if data.dtype == jnp.int32:
         # accumulator dequant: range maps the int32 span back to real values
         amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-        return data.astype(jnp.float32) * (amax / 2147483647.0)
+        return data.astype(jnp.float32) * (amax / INT32_QMAX)
     raise ValueError(f"dequantize: unsupported input dtype {data.dtype}")
 
 
@@ -97,8 +103,10 @@ def quantized_fully_connected(x, weight, min_x, max_x, min_w, max_w, *,
     # out_real = acc * (sx_inv * sw_inv); ranges propagate multiplicatively
     amax_x = jnp.maximum(jnp.abs(min_x), jnp.abs(max_x))
     amax_w = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
-    k = x.shape[-1]
-    out_amax = amax_x * amax_w * k / INT8_QMAX  # |acc| <= 127*127*k
+    # int32 range convention (quantization_utils.h): the carried range maps
+    # the FULL int32 span, so real = acc * amax_out / INT32_MAX holds and
+    # requantize/dequantize compose correctly with the accumulator
+    out_amax = amax_x * amax_w * INT32_SPAN_RATIO
     return acc, -out_amax, out_amax
 
 
@@ -119,9 +127,8 @@ def quantized_conv(x, weight, min_x, max_x, min_w, max_w, *, kernel=None,
         feature_group_count=num_group, preferred_element_type=jnp.int32)
     amax_x = jnp.maximum(jnp.abs(min_x), jnp.abs(max_x))
     amax_w = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
-    import numpy as onp
-    k = int(onp.prod(weight.shape[1:]))  # C_in/g * prod(kernel)
-    out_amax = amax_x * amax_w * k / INT8_QMAX
+    # same int32-span range convention as quantized_fully_connected
+    out_amax = amax_x * amax_w * INT32_SPAN_RATIO
     return acc, -out_amax, out_amax
 
 
@@ -198,3 +205,84 @@ def _smooth_distribution(p, eps=0.0001):
         raise ValueError("all-zero distribution")
     eps1 = eps * float(n_zeros) / float(n_nonzeros)
     return p.astype(onp.float32) + eps * is_zeros - eps1 * is_nonzeros
+
+
+# ---------------------------------------------------------------------------
+# quantized data-movement / activation ops (quantized_pooling.cc,
+# quantized_activation.cc, quantized_flatten.cc, quantized_concat.cc,
+# quantized_elemwise_add.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_quantized_pooling", jit=True, differentiable=False)
+def quantized_pooling(x, min_x, max_x, **attrs):
+    """Pooling on int8 data (quantized_pooling.cc): max pooling operates on
+    the codes directly (monotone), avg accumulates in int32 and rounds back —
+    both preserve the input ranges."""
+    from .nn import pooling
+    pool_type = attrs.get("pool_type", "max")
+    if pool_type == "max":
+        out = pooling(x.astype(jnp.int32), **attrs).astype(x.dtype)
+    else:
+        acc = pooling(x.astype(jnp.float32), **attrs)
+        info = jnp.iinfo(x.dtype)
+        out = jnp.clip(jnp.round(acc), info.min, info.max).astype(x.dtype)
+    return out, min_x, max_x
+
+
+@register("_contrib_quantized_act", jit=True, differentiable=False)
+def quantized_act(x, min_x, max_x, *, act_type="relu"):
+    """ReLU on zero-centered int8 codes is a plain max(x, 0)
+    (quantized_activation.cc); ranges pass through (the negative half simply
+    never decodes)."""
+    if act_type != "relu":
+        raise ValueError("quantized_act supports act_type='relu' only "
+                         f"(got {act_type!r})")
+    if x.dtype != jnp.int8:
+        raise ValueError("quantized_act expects zero-centered int8 codes "
+                         f"(got {x.dtype}); uint8 affine codes need the "
+                         "zero-point form")
+    return jnp.maximum(x, 0).astype(x.dtype), min_x, max_x
+
+
+@register("_contrib_quantized_flatten", jit=True, differentiable=False)
+def quantized_flatten(x, min_x, max_x):
+    return x.reshape(x.shape[0], -1), min_x, max_x
+
+
+@register("_contrib_quantized_concat", jit=True, differentiable=False)
+def quantized_concat(*arrays, dim=1, num_args=0):
+    """Concat int8 tensors with differing scales (quantized_concat.cc):
+    rescale every input's codes to the widest range, then concatenate.
+    Inputs interleave as (x0..xn-1, min0..minn-1, max0..maxn-1)."""
+    n = len(arrays) // 3
+    xs, mins, maxs = arrays[:n], arrays[n:2 * n], arrays[2 * n:]
+    if any(x.dtype != jnp.int8 for x in xs):
+        raise ValueError("quantized_concat expects zero-centered int8 codes")
+    amaxs = [jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+             for mn, mx in zip(mins, maxs)]
+    out_amax = amaxs[0]
+    for a in amaxs[1:]:
+        out_amax = jnp.maximum(out_amax, a)
+    scaled = [jnp.clip(jnp.round(x.astype(jnp.float32) * (a / out_amax)),
+                       -INT8_QMAX, INT8_QMAX).astype(x.dtype)
+              for x, a in zip(xs, amaxs)]
+    return jnp.concatenate(scaled, axis=dim), -out_amax, out_amax
+
+
+@register("_contrib_quantized_elemwise_add", jit=True, differentiable=False)
+def quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b):
+    """int8 + int8 with independent scales (quantized_elemwise_add.cc):
+    decode both into a shared int32 grid, add, report the exact combined
+    range (sum of the operand ranges)."""
+    if a.dtype != jnp.int8 or b.dtype != jnp.int8:
+        raise ValueError("quantized_elemwise_add expects zero-centered int8")
+    amax_a = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a))
+    amax_b = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b))
+    real_amax = amax_a + amax_b
+    # acc codes live on a real_amax/(127*127) grid; the carried range maps
+    # the full int32 span (INT32_SPAN_RATIO) so dequantize/requantize decode
+    # at the right scale
+    ca = jnp.round(a.astype(jnp.float32) * amax_a * INT8_QMAX / real_amax)
+    cb = jnp.round(b.astype(jnp.float32) * amax_b * INT8_QMAX / real_amax)
+    acc = (ca + cb).astype(jnp.int32)
+    out_amax = real_amax * INT32_SPAN_RATIO
+    return acc, -out_amax, out_amax

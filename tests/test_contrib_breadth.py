@@ -229,3 +229,27 @@ def test_spatial_transformer_family():
     wout = nd.BilinearSampler(x, wgrid)
     onp.testing.assert_allclose(wout.asnumpy()[:, :, :, :-1],
                                 x.asnumpy()[:, :, :, 1:], atol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Identity forward; gradient carries the KL sparsity penalty
+    (identity_attach_KL_sparse_reg.cc)."""
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(11)
+    act = rng.uniform(0.05, 0.95, (8, 4)).astype("float32")
+    x = nd.array(act)
+    x.attach_grad()
+    with autograd.record():
+        out, avg = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                                penalty=0.01)
+        out.sum().backward()
+    onp.testing.assert_allclose(out.asnumpy(), act, rtol=1e-6)
+    rho = onp.clip(act.mean(axis=0), 1e-6, 1 - 1e-6)
+    want = 1.0 + 0.01 * (-(0.1 / rho) + 0.9 / (1 - rho))
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.tile(want, (8, 1)), rtol=1e-5)
+    # EMA with explicit moving average input
+    prev = nd.array(onp.full(4, 0.5, "float32"))
+    _, new_avg = nd.IdentityAttachKLSparseReg(x, prev, momentum=0.9)
+    onp.testing.assert_allclose(new_avg.asnumpy(),
+                                0.9 * 0.5 + 0.1 * act.mean(axis=0), rtol=1e-5)

@@ -199,3 +199,70 @@ def test_quantize_net_excludes_layers():
                         exclude_layers=[first_name])
     assert type(qnet._children["0"]).__name__ == "Dense"
     assert type(qnet._children["1"]).__name__ == "QuantizedDense"
+
+
+def test_quantized_pooling_and_act():
+    """Quantized max pool on codes equals quantize(pool(real)); relu clamps
+    the negative codes (quantized_pooling.cc / quantized_activation.cc)."""
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-1, 1, (1, 2, 4, 4)).astype("float32")
+    q, mn, mx = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    pq, pmn, pmx = nd.contrib.quantized_pooling(q, mn, mx, kernel=(2, 2),
+                                                stride=(2, 2),
+                                                pool_type="max")
+    real_pool = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+    back = nd.contrib.dequantize(pq, pmn, pmx)
+    onp.testing.assert_allclose(back.asnumpy(), real_pool.asnumpy(),
+                                atol=2.0 / 127)
+    aq, _, _ = nd.contrib.quantized_act(q, mn, mx, act_type="relu")
+    assert (aq.asnumpy() >= 0).all()
+
+
+def test_quantized_concat_rescales():
+    a = nd.array(onp.array([0.5, -0.5], "float32"))
+    b = nd.array(onp.array([2.0, -2.0], "float32"))
+    qa, mna, mxa = nd.contrib.quantize_v2(a, out_type="int8")
+    qb, mnb, mxb = nd.contrib.quantize_v2(b, out_type="int8")
+    out, mn, mx = nd.contrib.quantized_concat(qa, qb, mna, mnb, mxa, mxb,
+                                              dim=0)
+    back = nd.contrib.dequantize(out, mn, mx).asnumpy()
+    onp.testing.assert_allclose(back, [0.5, -0.5, 2.0, -2.0], atol=2.0 * 2 / 127)
+
+
+def test_quantized_elemwise_add_exact_range():
+    a = nd.array(onp.array([0.9, -0.3], "float32"))
+    b = nd.array(onp.array([0.2, 0.7], "float32"))
+    qa, mna, mxa = nd.contrib.quantize_v2(a, out_type="int8")
+    qb, mnb, mxb = nd.contrib.quantize_v2(b, out_type="int8")
+    acc, mn, mx = nd.contrib.quantized_elemwise_add(qa, qb, mna, mxa, mnb,
+                                                    mxb)
+    # the standard int32 decode must give the real sum (range convention)
+    real = nd.contrib.dequantize(acc, mn, mx).asnumpy()
+    onp.testing.assert_allclose(real, [1.1, 0.4], atol=0.03)
+
+
+def test_quantized_pipeline_composes():
+    """conv -> requantize -> relu -> pool -> flatten entirely in int8 must
+    track the fp32 pipeline (regression: the conv/fc accumulator range
+    convention must match the int32 dequantize rule or requantize decodes
+    at the wrong scale)."""
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-1, 1, (1, 3, 8, 8)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("float32")
+    qx, mnx, mxx = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    qw, mnw, mxw = nd.contrib.quantize_v2(nd.array(w), out_type="int8")
+    acc, mno, mxo = nd.contrib.quantized_conv(
+        qx, qw, mnx, mxx, mnw, mxw, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    q8, mn8, mx8 = nd.contrib.requantize(acc, mno, mxo)
+    a8, _, _ = nd.contrib.quantized_act(q8, mn8, mx8, act_type="relu")
+    p8, mnp, mxp = nd.contrib.quantized_pooling(
+        a8, mn8, mx8, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f8, _, _ = nd.contrib.quantized_flatten(p8, mnp, mxp)
+    real = nd.contrib.dequantize(f8, mnp, mxp).asnumpy()
+    ref = nd.Pooling(
+        nd.relu(nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                               num_filter=4, pad=(1, 1), no_bias=True)),
+        kernel=(2, 2), stride=(2, 2),
+        pool_type="max").asnumpy().reshape(1, -1)
+    assert onp.abs(real - ref).max() < 0.1
