@@ -142,7 +142,7 @@ def bench_resnet_inference():
 
 
 def bench_bert():
-    batch = int(os.environ.get("BENCH_BERT_BATCH", 32))
+    batch = int(os.environ.get("BENCH_BERT_BATCH", 64))
     seq = int(os.environ.get("BENCH_BERT_SEQ", 128))
     k = int(os.environ.get("BENCH_STEPS_PER_CALL", 20))
     calls = int(os.environ.get("BENCH_CALLS", 2))

@@ -83,6 +83,7 @@ def _configure(lib):
         c.c_int, c.c_int, c.c_int]
     lib.mxtpu_engine_wait_for_var.argtypes = [c.c_void_p, c.c_int64]
     lib.mxtpu_engine_wait_all.argtypes = [c.c_void_p]
+    lib.mxtpu_engine_stats.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
     lib.mxtpu_engine_last_error.restype = c.c_char_p
     lib.mxtpu_engine_last_error.argtypes = [c.c_void_p]
     lib.mxtpu_engine_set_error.argtypes = [c.c_void_p, c.c_char_p]
@@ -184,6 +185,14 @@ class NativeEngine:
     def wait_all(self):
         self._lib.mxtpu_engine_wait_all(self._h)
         self._check_error()
+
+    def stats(self):
+        """Debug counters (MXNET_ENGINE_DEBUG accounting analog):
+        pushed/completed totals, live pending gauge, worker-pool count."""
+        buf = (ctypes.c_int64 * 4)()
+        self._lib.mxtpu_engine_stats(self._h, buf)
+        return {"pushed": buf[0], "completed": buf[1], "pending": buf[2],
+                "pools": buf[3]}
 
     def close(self):
         if self._h:

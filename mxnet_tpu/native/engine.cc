@@ -103,6 +103,7 @@ class Engine {
     task->lane = lane;
     task->priority = priority;
     std::unique_lock<std::mutex> lk(mu_);
+    ++pushed_;  // under mu_: Stats snapshots pushed == completed + pending
     GetPool(device, lane);  // spin the pool up before work can be granted
     ++pending_;
     int ndeps = static_cast<int>(task->reads.size() + task->writes.size());
@@ -225,6 +226,7 @@ class Engine {
       TryGrant(vid);
     }
     --pending_;
+    ++completed_;
     done_cv_.notify_all();
     delete t;
   }
@@ -262,10 +264,22 @@ class Engine {
   int64_t next_var_ = 1;
   bool stop_;
   int64_t pending_;
+  int64_t pushed_ = 0;     // guarded by mu_ (consistent Stats snapshots)
+  int64_t completed_ = 0;  // guarded by mu_
   int num_workers_;
   std::string error_;
 
  public:
+  // debug counters (the reference engine's verbose/debug accounting,
+  // MXNET_ENGINE_DEBUG): pushed / completed totals + live pending gauge
+  void Stats(int64_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    out[0] = pushed_;
+    out[1] = completed_;
+    out[2] = pending_;
+    out[3] = static_cast<int64_t>(pools_.size());
+  }
+
   void SetError(const char* msg) {
     std::unique_lock<std::mutex> lk(mu_);
     if (error_.empty()) error_ = msg ? msg : "unknown error";
@@ -305,6 +319,10 @@ void mxtpu_engine_wait_for_var(void* e, int64_t var) {
 }
 
 void mxtpu_engine_wait_all(void* e) { static_cast<Engine*>(e)->WaitAll(); }
+
+void mxtpu_engine_stats(void* e, int64_t* out) {
+  static_cast<Engine*>(e)->Stats(out);
+}
 
 const char* mxtpu_engine_last_error(void* e) {
   return static_cast<Engine*>(e)->LastError();

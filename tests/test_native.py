@@ -298,3 +298,18 @@ def test_engine_perdevice_lanes_and_priority():
     eng.wait_all()
     assert order == ["high", "mid", "low"], order
     eng.close()
+
+
+def test_engine_stats_counters():
+    """pushed/completed/pending debug counters (engine verbose accounting)."""
+    eng = native.NativeEngine(num_workers=2)
+    s0 = eng.stats()
+    assert s0["pushed"] == 0 and s0["pending"] == 0 and s0["pools"] >= 1
+    for i in range(5):
+        eng.push(lambda: None)
+    eng.push(lambda: None, lane=native.NativeEngine.LANE_COPY)
+    eng.wait_all()
+    s = eng.stats()
+    assert s["pushed"] == 6 and s["completed"] == 6 and s["pending"] == 0
+    assert s["pools"] >= 2  # copy lane spun up its own pool
+    eng.close()
