@@ -97,6 +97,8 @@ register("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True, bool,
          "Log when a sparse op densifies an operand (executor fallback log).")
 register("MXNET_HOME", os.path.join("~", ".mxnet"), str,
          "Root for datasets/model downloads.")
+register("MXNET_P3_SLICE_SIZE", 1 << 20, int,
+         "p3 kvstore: elements per wire slice (priority propagation).")
 register("MXNET_KVSTORE_ASYNC_AVG_PERIOD", 16, int,
          "dist_async: pushes per key between parameter-averaging allreduces.")
 register("MXNET_KVSTORE_HEARTBEAT_DIR", "", str,

@@ -53,7 +53,7 @@ class KVStore(KVStoreBase):
         self._optimizer = None
         self._compression: Optional[GradientCompression] = None
         self._multi_host = False
-        if kv_type.startswith("dist"):
+        if kv_type.startswith("dist") or kv_type == "p3":
             # join the job first if the launcher provided env bootstrapping
             # (tools/launch.py); no-op when already initialized or standalone
             from ..parallel.collectives import initialize_distributed
@@ -218,6 +218,26 @@ class KVStore(KVStoreBase):
                 out = sum(comp.dequantize(packed_all[w], scale_all[w],
                                           out.shape, out.dtype)
                           for w in range(packed_all.shape[0]))
+            elif self._type == "p3":
+                # p3 wire slicing (p3store_dist.h): big tensors cross in
+                # MXNET_P3_SLICE_SIZE chunks, bounding per-transfer latency.
+                # HONEST SCOPE: the reference's priority *scheduling* between
+                # concurrent transfers is subsumed here by XLA's collective
+                # scheduler — this path demonstrates the wire-slicing
+                # semantics (and keeps slice-size knob parity), it is not a
+                # throughput optimization; sliced allreduces run sequentially.
+                from .. import config
+                import jax.numpy as _jnp
+                slice_elems = max(1, int(config.get("MXNET_P3_SLICE_SIZE")))
+                flat = out.reshape(-1)
+                if flat.shape[0] > slice_elems:
+                    parts = []
+                    for start in range(0, flat.shape[0], slice_elems):
+                        parts.append(self._allreduce_sum(
+                            flat[start:start + slice_elems]))
+                    out = _jnp.concatenate(parts).reshape(out.shape)
+                else:
+                    out = self._allreduce_sum(out)
             else:
                 out = self._allreduce_sum(out)
         elif comp is not None and len(values) == 1:

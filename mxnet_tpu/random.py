@@ -45,3 +45,19 @@ def push_key_source(fn: Callable):
 
 def pop_key_source():
     _STATE.sources.pop()
+
+
+_SAMPLERS = ("normal", "uniform", "randn", "randint", "poisson",
+             "exponential", "gamma", "multinomial", "negative_binomial",
+             "bernoulli", "shuffle")
+
+
+def __getattr__(name):
+    """Sampler parity surface (python/mxnet/random.py re-exports the ndarray
+    samplers): delegate the allowlisted sampler names to nd.random so
+    mx.random.normal(...) works like the reference — an open delegation
+    would leak nd.random's helper imports onto this module."""
+    if name in _SAMPLERS:
+        from .ndarray import random as _nd_random
+        return getattr(_nd_random, name)
+    raise AttributeError(f"module 'mxnet_tpu.random' has no attribute {name!r}")

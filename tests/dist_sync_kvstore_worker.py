@@ -94,6 +94,26 @@ def main():
     # (-4*1 + -4*2)/2 = -6 on BOTH workers after reconciliation
     onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), -6.0), rtol=1e-6)
 
+    # --- collective backend (horovod.py pattern) across processes ----------
+    kvc = mx.kv.create("collective")
+    bout = nd.zeros((3,))
+    kvc.broadcast("cw", nd.array([7.0, 8.0, 9.0]), out=bout)
+    onp.testing.assert_allclose(bout.asnumpy(), [7.0, 8.0, 9.0])
+    pv = nd.ones((3,)) * (rank + 1)
+    kvc.pushpull("cg", pv, out=pv)
+    onp.testing.assert_allclose(pv.asnumpy(), onp.full(3, 3.0))
+
+    # --- p3: sliced wire transfers must still sum correctly ----------------
+    prev_slice = mx.config.get("MXNET_P3_SLICE_SIZE")
+    mx.config.set("MXNET_P3_SLICE_SIZE", 8)   # force multiple slices
+    kvp = mx.kv.create("p3")
+    kvp.init("pw", nd.zeros((5, 5)))
+    kvp.push("pw", nd.ones((5, 5)) * (rank + 1))
+    pout = nd.zeros((5, 5))
+    kvp.pull("pw", out=pout)
+    onp.testing.assert_allclose(pout.asnumpy(), onp.full((5, 5), 3.0))
+    mx.config.set("MXNET_P3_SLICE_SIZE", prev_slice)
+
     kv.barrier()
     print(f"worker {rank}: OK", flush=True)
 

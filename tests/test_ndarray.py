@@ -235,3 +235,17 @@ def test_numpy_interop():
     assert np_view.shape == (1, 2)
     b = a + onp.array([[1.0, 1.0]])
     assert_almost_equal(b, onp.array([[2, 3]]))
+
+
+def test_mx_random_module_samplers():
+    """mx.random re-exports the nd samplers (python/mxnet/random.py parity)."""
+    import mxnet_tpu as mx
+    mx.random.seed(7)
+    a = mx.random.normal(shape=(4,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.random.normal(shape=(4,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    u = mx.random.uniform(low=-1, high=1, shape=(8,)).asnumpy()
+    assert ((u >= -1) & (u <= 1)).all()
+    with pytest.raises(AttributeError):
+        mx.random.not_a_sampler
