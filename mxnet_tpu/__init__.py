@@ -42,6 +42,7 @@ from .tensor_inspector import TensorInspector
 
 from . import library
 from . import rtc
+from . import resource
 library.initialize()  # atfork discipline + SIGSEGV logger (initialize.cc)
 
 if config.get("MXNET_PROFILER_AUTOSTART"):

@@ -1,0 +1,62 @@
+"""Resource manager (parity: include/mxnet/resource.h:43-241 ResourceRequest/
+ResourceManager over src/resource.cc).
+
+TPU-native mapping — most reference resources are subsumed:
+  - kTempSpace (scratch workspace): XLA allocates fused-kernel scratch
+    itself; ``Resource.get_space`` hands back a host numpy scratch buffer
+    for host-side ops (the only place user code still needs one).
+  - kRandom / kParallelRandom (per-device RNG streams): the threefry key
+    chain in ``mxnet_tpu.random`` — ``Resource.get_random`` returns a fresh
+    split key, the per-op stream discipline of the reference's
+    ResourceRequest{kRandom}.
+  - kCuDNNDropoutDesc: N/A (dropout is a jitted mask draw).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["ResourceRequest", "Resource", "request"]
+
+
+class ResourceRequest:
+    """Request tags (resource.h:43-51)."""
+    kRandom = "random"
+    kTempSpace = "temp_space"
+    kParallelRandom = "parallel_random"
+    kCuDNNDropoutDesc = "cudnn_dropout_desc"
+
+    def __init__(self, type_=kTempSpace):
+        self.type = type_
+
+
+class Resource:
+    """A granted resource handle (resource.h Resource)."""
+
+    def __init__(self, req: ResourceRequest):
+        self.req = req
+
+    def get_random(self):
+        """Fresh PRNG key from the global threefry chain (kRandom)."""
+        from . import random as _random
+        return _random.take_key()
+
+    def get_space(self, shape, dtype="float32"):
+        """Host scratch buffer (kTempSpace). Device scratch is XLA's job —
+        this exists for host-side ops (decode staging, custom op buffers)."""
+        import numpy as onp
+        return onp.empty(shape, dtype)
+
+    def get_parallel_random(self, n):
+        """n independent keys (kParallelRandom): one split, n streams."""
+        import jax
+        return jax.random.split(self.get_random(), n)
+
+
+def request(req: ResourceRequest) -> Resource:
+    """ResourceManager::Request analog."""
+    if not isinstance(req, ResourceRequest):
+        req = ResourceRequest(req)
+    if req.type == ResourceRequest.kCuDNNDropoutDesc:
+        raise MXNetError("cudnn_dropout_desc has no TPU analog "
+                         "(dropout draws jitted masks)")
+    return Resource(req)

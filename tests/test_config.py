@@ -117,3 +117,21 @@ print("fork ok")
                          capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
     assert "fork ok" in out.stdout
+
+
+def test_resource_manager():
+    """ResourceRequest/Resource mapping (resource.h parity): RNG streams from
+    the global key chain, host temp space, cudnn desc rejected."""
+    import mxnet_tpu as mx
+    import numpy as onp
+    import pytest as _pytest
+
+    r = mx.resource.request(mx.resource.ResourceRequest.kRandom)
+    k1, k2 = r.get_random(), r.get_random()
+    assert not onp.array_equal(onp.asarray(k1), onp.asarray(k2))  # split chain
+    keys = r.get_parallel_random(4)
+    assert len(keys) == 4
+    space = mx.resource.request("temp_space").get_space((8, 8))
+    assert space.shape == (8, 8)
+    with _pytest.raises(mx.MXNetError):
+        mx.resource.request(mx.resource.ResourceRequest.kCuDNNDropoutDesc)

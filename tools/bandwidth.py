@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Collective-bandwidth measurement (parity: tools/bandwidth/measure.py — the
+reference measures kvstore push/pull bandwidth across GPUs; here the
+measured primitive is the GSPMD allreduce over the device mesh, the transport
+every dist kvstore and fused train step rides).
+
+Reports per-size: achieved algorithmic bandwidth (2*(n-1)/n * bytes / time,
+the standard ring-allreduce accounting) and wall time. Runs on whatever
+devices are visible — one TPU chip (loopback, measures dispatch floor), a
+virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8), or a
+real pod slice.
+
+Usage:
+    python tools/bandwidth.py [--sizes-mb 1 4 16 64] [--iters 10]
+"""
+import argparse
+import json
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes-mb", type=float, nargs="+",
+                   default=[1.0, 4.0, 16.0, 64.0])
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--dtype", default="float32")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = onp.asarray(jax.devices())
+    n = len(devs)
+    mesh = Mesh(devs, ("d",))
+    psum = jax.jit(lambda x: jnp.sum(x, axis=0),
+                   out_shardings=NamedSharding(mesh, P()))
+    print(f"# devices: {n} ({devs[0].platform})")
+
+    itemsize = onp.dtype(args.dtype).itemsize
+    for size_mb in args.sizes_mb:
+        elems_per_dev = max(1, int(size_mb * 1e6 / itemsize))
+        x = jax.device_put(
+            jnp.ones((n, elems_per_dev), args.dtype),
+            NamedSharding(mesh, P("d")))
+        out = psum(x)
+        float(out[0])  # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = psum(x)
+        float(out[0])   # value fetch closes the timing window
+        dt = (time.perf_counter() - t0) / args.iters
+        nbytes = elems_per_dev * itemsize
+        algo_bw = (2 * (n - 1) / n) * nbytes / dt if n > 1 else nbytes / dt
+        print(json.dumps({"size_mb": size_mb, "time_ms": round(dt * 1e3, 3),
+                          "algo_gbps": round(algo_bw / 1e9, 3),
+                          "devices": n}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
