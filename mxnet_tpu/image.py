@@ -21,7 +21,10 @@ __all__ = ["imdecode", "imresize", "imread", "fixed_crop", "center_crop",
            "RandomCropAug", "RandomSizedCropAug", "HorizontalFlipAug",
            "CastAug", "BrightnessJitterAug", "ContrastJitterAug",
            "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
-           "LightingAug", "ColorNormalizeAug", "RandomGrayAug"]
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 def imdecode(buf, flag=1, to_rgb=True):
@@ -466,6 +469,262 @@ class ImageIter:
                 arr = arr.transpose(2, 0, 1)
             batch_data.append(arr)
             batch_label.append(label)
+        data = NDArray(onp.asarray(batch_data, dtype=onp.float32))
+        label = NDArray(onp.asarray(batch_label, dtype=onp.float32))
+        return DataBatch(data=[data], label=[label])
+
+    next = __next__
+
+
+# ---------------------------------------------------------------------------
+# detection augmenters (parity: python/mxnet/image/detection.py — Det*Aug
+# family + CreateDetAugmenter + ImageDetIter). Labels are (N, 5+) rows of
+# [cls, x1, y1, x2, y2] in normalized [0, 1] corner coords; every augmenter
+# transforms image AND label together.
+# ---------------------------------------------------------------------------
+class DetAugmenter:
+    """Base detection augmenter (detection.py DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection pipeline
+    (detection.py DetBorrowAug) — the label passes through."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates together with probability p."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = NDArray(src.data[:, ::-1])
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Sample a crop whose IOU with some ground-truth box exceeds a random
+    constraint (SSD data augmentation, detection.py DetRandomCropAug);
+    boxes are clipped into the crop and re-normalized, fully-cropped-out
+    boxes get class -1."""
+
+    def __init__(self, min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.3, 1.0), max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range) * h * w
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            ch = int(round((area / ratio) ** 0.5))
+            cw = int(round((area * ratio) ** 0.5))
+            if ch > h or cw > w or ch <= 0 or cw <= 0:
+                continue
+            y0 = pyrandom.randint(0, h - ch)
+            x0 = pyrandom.randint(0, w - cw)
+            # crop box in normalized coords
+            nx0, ny0 = x0 / w, y0 / h
+            nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+            valid = label[:, 0] >= 0
+            if valid.any():
+                bx1, by1 = label[valid, 1], label[valid, 2]
+                bx2, by2 = label[valid, 3], label[valid, 4]
+                ix = onp.maximum(0, onp.minimum(bx2, nx1) - onp.maximum(bx1, nx0))
+                iy = onp.maximum(0, onp.minimum(by2, ny1) - onp.maximum(by1, ny0))
+                barea = onp.maximum((bx2 - bx1) * (by2 - by1), 1e-12)
+                cover = (ix * iy) / barea
+                if cover.max() < self.min_object_covered:
+                    continue
+            out = fixed_crop(src, x0, y0, cw, ch)
+            new = label.copy()
+            # re-express boxes in crop coords, clip, drop the vanished
+            for c, (lo, span) in ((1, (nx0, nx1 - nx0)), (2, (ny0, ny1 - ny0)),
+                                  (3, (nx0, nx1 - nx0)), (4, (ny0, ny1 - ny0))):
+                new[:, c] = onp.clip((new[:, c] - lo) / max(span, 1e-12), 0, 1)
+            gone = ((new[:, 3] - new[:, 1]) <= 1e-3) | \
+                   ((new[:, 4] - new[:, 2]) <= 1e-3)
+            new[gone, 0] = -1
+            return out, new
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out padding (detection.py DetRandomPadAug): place the image on a
+    larger mean-filled canvas and shrink the boxes accordingly."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=25, pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            scale = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            if scale <= 1.0:
+                return src, label
+            # canvas area = scale*h*w with the sampled aspect ratio
+            nh = int(round((scale * h * w / ratio) ** 0.5))
+            nw = int(round((scale * h * w * ratio) ** 0.5))
+            if nh >= h and nw >= w:
+                break
+        else:
+            return src, label
+        y0 = pyrandom.randint(0, nh - h)
+        x0 = pyrandom.randint(0, nw - w)
+        # float canvas: wrapping through uint8 would corrupt jittered pixels
+        canvas = onp.empty((nh, nw, src.shape[2]), onp.float32)
+        canvas[...] = onp.asarray(self.pad_val, onp.float32)
+        canvas[y0:y0 + h, x0:x0 + w] = src.asnumpy().astype(onp.float32)
+        new = label.copy()
+        new[:, 1] = (new[:, 1] * w + x0) / nw
+        new[:, 3] = (new[:, 3] * w + x0) / nw
+        new[:, 2] = (new[:, 2] * h + y0) / nh
+        new[:, 4] = (new[:, 4] * h + y0) / nh
+        return NDArray(canvas), new
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter from a list (or skip, detection.py
+    DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0., rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       inter_method=2, min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.3, 3.0),
+                       max_attempts=25, pad_val=(127, 127, 127)):
+    """Standard SSD augmentation chain (detection.py CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(area_range[1], 1.0)),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(area_range[0], 1.0), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
+                                               inter_method)))
+    for jitter, cls in ((brightness, BrightnessJitterAug),
+                        (contrast, ContrastJitterAug),
+                        (saturation, SaturationJitterAug)):
+        if jitter > 0:
+            auglist.append(DetBorrowAug(cls(jitter)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is not None or std is not None:
+        if isinstance(mean, bool) and mean:
+            mean = onp.array([123.68, 116.28, 103.53])
+        if isinstance(std, bool) and std:
+            std = onp.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (detection.py ImageDetIter): batches of images with
+    padded (B, M, 5) label tensors, label rows [cls, x1, y1, x2, y2]."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, label_pad=8,
+                 aug_list=None, **kwargs):
+        self.det_auglist = aug_list if aug_list is not None else []
+        self.label_pad = label_pad
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         aug_list=[], **kwargs)
+        if path_imglist:
+            # the classifier-side list parser keeps one float label; a
+            # detection .lst carries the full [A, B, header..., rows] vector
+            self._records = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if not parts or len(parts) < 3:
+                        continue
+                    vec = onp.asarray([float(v) for v in parts[1:-1]],
+                                      onp.float32)
+                    self._records.append(
+                        (vec, os.path.join(path_root or "", parts[-1])))
+            self._keys = list(range(len(self._records)))
+            self.reset()
+
+    def _parse_label(self, raw):
+        """Reference det-label layout (detection.py ImageDetIter): flat
+        [A, B, ...A-2 extras..., rows x B] — A = header length, B = object
+        width (>= 5). A plain multiple-of-5 array is taken as raw rows."""
+        arr = onp.asarray(raw, onp.float32).reshape(-1)
+        if arr.size >= 2:
+            a, b = int(arr[0]), int(arr[1])
+            if a >= 2 and b >= 5 and arr.size >= a \
+                    and (arr.size - a) % b == 0:
+                # header-only (zero objects, arr.size == a) -> no rows
+                return arr[a:].reshape(-1, b)[:, :5]
+        if arr.size % 5:
+            raise MXNetError(
+                f"ImageDetIter: cannot parse detection label of size "
+                f"{arr.size} (expected [A, B, ...header..., rows x B] or a "
+                "multiple-of-5 flat array)")
+        return arr.reshape(-1, 5)
+
+    def __next__(self):
+        from .io import DataBatch
+        batch_data, batch_label = [], []
+        for _ in range(self.batch_size):
+            label, img = self._next_sample()
+            rows = self._parse_label(label)
+            for aug in self.det_auglist:
+                img, rows = aug(img, rows)
+            arr = img.asnumpy()
+            if arr.ndim == 3:
+                arr = arr.transpose(2, 0, 1)
+            batch_data.append(arr)
+            if len(rows) > self.label_pad:
+                raise MXNetError(
+                    f"ImageDetIter: {len(rows)} objects exceed "
+                    f"label_pad={self.label_pad}; raise label_pad (silent "
+                    "truncation would train those regions as background)")
+            padded = onp.full((self.label_pad, 5), -1.0, onp.float32)
+            padded[:len(rows)] = rows
+            batch_label.append(padded)
         data = NDArray(onp.asarray(batch_data, dtype=onp.float32))
         label = NDArray(onp.asarray(batch_label, dtype=onp.float32))
         return DataBatch(data=[data], label=[label])

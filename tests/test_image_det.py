@@ -1,0 +1,91 @@
+"""Detection augmenter / ImageDetIter tests (parity pattern:
+tests/python/unittest/test_image.py TestImageDetIter + det augmenters)."""
+import io as _io
+import os
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, nd, recordio
+
+
+def _label(rows):
+    return onp.asarray(rows, onp.float32)
+
+
+def test_det_horizontal_flip():
+    pyrandom.seed(0)
+    img = nd.array(onp.arange(2 * 4 * 3, dtype="float32").reshape(2, 4, 3))
+    lab = _label([[0, 0.1, 0.2, 0.5, 0.8]])
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    out, new = aug(img, lab)
+    onp.testing.assert_allclose(out.asnumpy(), img.asnumpy()[:, ::-1])
+    onp.testing.assert_allclose(new[0, [1, 3]], [0.5, 0.9], atol=1e-6)
+    onp.testing.assert_allclose(new[0, [2, 4]], [0.2, 0.8], atol=1e-6)
+
+
+def test_det_random_crop_keeps_coverage():
+    pyrandom.seed(3)
+    img = nd.array(onp.random.RandomState(0).rand(40, 40, 3).astype("float32"))
+    lab = _label([[1, 0.3, 0.3, 0.7, 0.7]])
+    aug = image.DetRandomCropAug(min_object_covered=0.5,
+                                 area_range=(0.5, 1.0))
+    out, new = aug(img, lab)
+    kept = new[new[:, 0] >= 0]
+    assert kept.shape[0] >= 1
+    assert ((kept[:, 1:] >= -1e-6) & (kept[:, 1:] <= 1 + 1e-6)).all()
+    assert (kept[:, 3] > kept[:, 1]).all() and (kept[:, 4] > kept[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    pyrandom.seed(1)
+    img = nd.array(onp.full((20, 20, 3), 200.0, "float32"))
+    lab = _label([[0, 0.0, 0.0, 1.0, 1.0]])
+    aug = image.DetRandomPadAug(area_range=(2.0, 2.0))
+    out, new = aug(img, lab)
+    assert out.shape[0] > 20 and out.shape[1] > 20
+    # the box now covers less than the full canvas
+    assert (new[0, 3] - new[0, 1]) < 1.0 and (new[0, 4] - new[0, 2]) < 1.0
+
+
+def test_create_det_augmenter_and_iter(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image as PILImage
+    # build a tiny detection record file: label = [A=4, B=5, extra, extra, row]
+    path = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(4):
+        arr = rng.randint(0, 255, (24, 24, 3), dtype=onp.uint8)
+        bio = _io.BytesIO()
+        PILImage.fromarray(arr).save(bio, format="JPEG")
+        label = onp.array([4, 5, 24, 24,
+                           i % 2, 0.1, 0.1, 0.6, 0.6], onp.float32)
+        w.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                              bio.getvalue()))
+    w.close()
+    # build .idx by re-reading sequentially
+    idx_path = str(tmp_path / "det.idx")
+    r = recordio.MXRecordIO(path, "r")
+    with open(idx_path, "w") as f:
+        i = 0
+        pos = r.tell()
+        while r.read() is not None:
+            f.write(f"{i}\t{pos}\n")
+            i += 1
+            pos = r.tell()
+    r.close()
+
+    augs = image.CreateDetAugmenter((3, 16, 16), rand_mirror=True,
+                                    rand_crop=0.5, rand_pad=0.5)
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                            path_imgrec=path, label_pad=4, aug_list=augs,
+                            seed=0)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert batch.label[0].shape == (2, 4, 5)
+    lab = batch.label[0].asnumpy()
+    real = lab[lab[:, :, 0] >= 0]
+    assert ((real[:, 1:] >= -1e-6) & (real[:, 1:] <= 1 + 1e-6)).all()
