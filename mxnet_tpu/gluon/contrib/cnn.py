@@ -1,0 +1,89 @@
+"""gluon.contrib.cnn (parity: python/mxnet/gluon/contrib/cnn/conv_layers.py
+DeformableConvolution): a learned offset branch (plain conv) feeding the
+deformable sampling op — offsets initialize to zero so training starts from
+the plain-convolution solution."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn.basic_layers import _init_by_name
+from ..nn.conv_layers import _tup
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 layer: offsets predicted by an internal conv.
+
+    Output = DeformableConvolution(x, offset_conv(x), weight, bias)."""
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, layout="NCHW", weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        if layout != "NCHW":
+            raise MXNetError("DeformableConvolution supports layout='NCHW'")
+        self._channels = channels
+        self._kernel = _tup(kernel_size, 2)
+        self._strides = _tup(strides, 2)
+        self._padding = _tup(padding, 2)
+        self._dilation = _tup(dilation, 2)
+        self._groups = groups
+        self._act = activation
+        self._ndg = num_deformable_group
+        self._use_bias = use_bias
+        koff = 2 * self._kernel[0] * self._kernel[1] * num_deformable_group
+        self._koff = koff
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels // groups if in_channels
+                                 else 0) + self._kernel,
+                init=weight_initializer, allow_deferred_init=True)
+            self.bias = self.params.get(
+                "bias", shape=(channels,),
+                init=_init_by_name(bias_initializer),
+                allow_deferred_init=True) if use_bias else None
+            # zero-initialized offset branch: the layer starts as a plain conv
+            self.offset_weight = self.params.get(
+                "offset_weight", shape=(koff, in_channels if in_channels
+                                        else 0) + self._kernel,
+                init=_init_by_name(offset_weight_initializer),
+                allow_deferred_init=True)
+            self.offset_bias = self.params.get(
+                "offset_bias", shape=(koff,),
+                init=_init_by_name(offset_bias_initializer),
+                allow_deferred_init=True)
+
+    def infer_shape(self, x):
+        c = x.shape[1]
+        if self._groups and c % self._groups:
+            raise MXNetError(f"in_channels {c} not divisible by groups")
+        if self._ndg and c % self._ndg:
+            raise MXNetError(f"in_channels {c} not divisible by "
+                             f"num_deformable_group={self._ndg}")
+        self.weight.shape = (self._channels, c // self._groups) + self._kernel
+        self.offset_weight.shape = (self._koff, c) + self._kernel
+
+    def hybrid_forward(self, F, x, weight, offset_weight, offset_bias,
+                       bias=None):
+        offset = F.Convolution(
+            x, offset_weight, offset_bias, kernel=self._kernel,
+            stride=self._strides, dilate=self._dilation, pad=self._padding,
+            num_filter=self._koff, no_bias=False)
+        out = F.DeformableConvolution(
+            x, offset, weight, bias, kernel=self._kernel,
+            stride=self._strides, dilate=self._dilation, pad=self._padding,
+            num_filter=self._channels, num_group=self._groups,
+            num_deformable_group=self._ndg,
+            no_bias=not self._use_bias)
+        if self._act is not None:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return (f"DeformableConvolution({self._channels}, "
+                f"kernel_size={self._kernel}, num_deformable_group={self._ndg})")

@@ -322,3 +322,26 @@ def test_estimator_fit_and_handlers(tmp_path):
     # evaluate returns metric pairs
     out = est.evaluate(loader)
     assert any(n == "accuracy" for n, _ in out)
+
+
+def test_gluon_deformable_convolution_layer():
+    """contrib.cnn.DeformableConvolution: zero-init offsets make the layer
+    equal a plain conv at init; offsets learn (conv_layers.py parity)."""
+    from mxnet_tpu.gluon.contrib.cnn import DeformableConvolution
+    rng = onp.random.RandomState(0)
+    x = nd.array(rng.rand(1, 3, 8, 8).astype("float32"))
+    layer = DeformableConvolution(4, kernel_size=3, padding=1)
+    layer.initialize(mx.init.Xavier())
+    out = layer(x)
+    assert out.shape == (1, 4, 8, 8)
+    # zero offsets at init: equals plain conv with the same weight
+    want = nd.Convolution(x, layer.weight.data(), layer.bias.data(),
+                          kernel=(3, 3), pad=(1, 1), num_filter=4)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(), rtol=1e-4,
+                                atol=1e-4)
+    # gradient reaches the offset branch
+    from mxnet_tpu import autograd
+    with autograd.record():
+        y = layer(x).sum()
+    y.backward()
+    assert onp.abs(layer.offset_weight.grad().asnumpy()).sum() > 0
