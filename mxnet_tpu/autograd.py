@@ -159,16 +159,35 @@ def _node_vjp(node: TapeNode, out_cots: List):
         return [None, SparseCotangent([(idx, cot)], node.inputs[1].shape)]
 
     from .ops import registry as _reg
-    jax_inputs = tuple(x.data for x in node.inputs)
+    # None inputs are static absent optionals (e.g. a positional bias=None):
+    # they carry no cotangent and must not enter jax.vjp as primals. Other
+    # non-NDArray inputs (e.g. the raw PRNG key Dropout records) DO enter as
+    # primals — jax.vjp yields float0 for integer dtypes, and keeping them
+    # as arguments (not closure constants) means the cached jitted VJP
+    # replays with the call's actual key instead of a stale baked-in one.
+    none_slots = tuple(i for i, x in enumerate(node.inputs) if x is None)
+    nondiff_slots = tuple(i for i, x in enumerate(node.inputs)
+                          if x is not None and not isinstance(x, NDArray))
+    jax_inputs = tuple(x.data if isinstance(x, NDArray) else x
+                       for x in node.inputs if x is not None)
     try:
-        key = (node.op.name, _reg._freeze(node.attrs),
-               tuple((a.shape, str(a.dtype)) for a in jax_inputs))
+        key = (node.op.name, _reg._freeze(node.attrs), none_slots,
+               nondiff_slots,
+               tuple((getattr(a, "shape", ()), str(getattr(a, "dtype", type(a))))
+                     for a in jax_inputs))
         hash(key)
     except TypeError:  # unhashable attrs (e.g. advanced-index arrays): no cache
         key = None
     vjp_exec = _VJP_CACHE.get(key) if key is not None else None
     if vjp_exec is None:
         fn = functools.partial(node.op.fn, **node.attrs) if node.attrs else node.op.fn
+        if none_slots:
+            base_fn, n_total = fn, len(node.inputs)
+
+            def fn(*primals, _base=base_fn, _slots=none_slots, _n=n_total):
+                it = iter(primals)
+                full = [None if i in _slots else next(it) for i in range(_n)]
+                return _base(*full)
 
         def vjp_all(primals, cots):
             out, pullback = jax.vjp(fn, *primals)
@@ -189,7 +208,19 @@ def _node_vjp(node: TapeNode, out_cots: List):
         out_cots[i] if out_cots[i] is not None
         else jnp.zeros(outs[i].shape, outs[i].data.dtype)
         for i in range(len(outs)))
-    return list(vjp_exec(jax_inputs, cots))
+    dense = list(vjp_exec(jax_inputs, cots))
+    if none_slots or nondiff_slots:
+        it = iter(dense)
+        out = []
+        for i in range(len(node.inputs)):
+            if i in none_slots:
+                out.append(None)
+            else:
+                g = next(it)
+                # float0 / integer-primal cotangents carry no information
+                out.append(None if i in nondiff_slots else g)
+        return out
+    return dense
 
 
 def _write_grad(x, val):

@@ -1,0 +1,112 @@
+"""gluon.contrib.rnn (parity: python/mxnet/gluon/contrib/rnn/rnn_cell.py —
+VariationalDropoutCell, LSTMPCell)."""
+from __future__ import annotations
+
+from ..rnn.rnn_cell import LSTMCell, ModifierCell, RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "LSTMPCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout (contrib rnn_cell.py VariationalDropoutCell):
+    ONE dropout mask per sequence, reused at every time step, applied to
+    inputs/states/outputs — the Gal & Ghahramani recurrent-dropout recipe
+    (ordinary DropoutCell redraws per step)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, p, like):
+        # a dropout of an all-ones tensor IS the (scaled) bernoulli mask;
+        # caching it across steps locks the pattern for the whole sequence
+        return F.Dropout(like.ones_like(), p=p)
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs > 0.0:
+            if self._input_mask is None:
+                self._input_mask = self._mask(F, self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self.drop_states > 0.0:
+            if self._state_mask is None:
+                self._state_mask = self._mask(F, self.drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if self.drop_outputs > 0.0:
+            if self._output_mask is None:
+                self._output_mask = self._mask(F, self.drop_outputs, output)
+            output = output * self._output_mask
+        return output, next_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell(in={self.drop_inputs}, "
+                f"state={self.drop_states}, out={self.drop_outputs})")
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a projected hidden state (contrib rnn_cell.py LSTMPCell,
+    the LSTMP of Sak et al.): cell state has ``hidden_size``, the recurrent/
+    output h is projected down to ``projection_size``."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *a):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = F.tanh(slices[2])
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        hidden = out_gate * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]
+
+    def __repr__(self):
+        return (f"LSTMPCell({self._hidden_size} -> {self._projection_size})")

@@ -162,3 +162,19 @@ def test_rnn_op_grad_flows():
     loss.backward()
     assert params.grad.shape == (size,)
     assert float(nd.abs(params.grad).sum().asscalar()) > 0
+
+
+def test_backward_through_positional_none_input():
+    """A positional None optional (e.g. bias with no_bias=True) must be
+    treated as a static placeholder on the tape, not a differentiable
+    primal (regression: _node_vjp crashed on None inputs)."""
+    rng = onp.random.RandomState(0)
+    w = nd.array(rng.rand(4, 5).astype("float32"))
+    w.attach_grad()
+    x = nd.array(rng.rand(2, 5).astype("float32"))
+    with autograd.record():
+        out = nd.FullyConnected(x, w, None, no_bias=True, num_hidden=4)
+        out.sum().backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(),
+                                onp.tile(x.asnumpy().sum(0), (4, 1)),
+                                rtol=1e-5)

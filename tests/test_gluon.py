@@ -345,3 +345,54 @@ def test_gluon_deformable_convolution_layer():
         y = layer(x).sum()
     y.backward()
     assert onp.abs(layer.offset_weight.grad().asnumpy()).sum() > 0
+
+
+def test_variational_dropout_cell_locked_mask():
+    """The SAME dropout mask applies at every step of a sequence
+    (contrib rnn_cell.py VariationalDropoutCell), unlike DropoutCell."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import rnn
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+
+    # identity base cell exposes the masked inputs directly
+    class _Identity(rnn.RecurrentCell):
+        def state_info(self, batch_size=0):
+            return []
+
+        def hybrid_forward(self, F, inputs, states):
+            return inputs, states
+
+    cell = VariationalDropoutCell(_Identity(), drop_inputs=0.5)
+    cell.initialize()
+    x = nd.ones((2, 6, 4))
+    with autograd.record():   # dropout active
+        out, _ = cell.unroll(6, x, merge_outputs=True)
+    o = out.asnumpy()
+    m1 = cell._input_mask.asnumpy()
+    assert set(onp.unique(o)) <= {0.0, 2.0}   # p=0.5 scaling
+    # LOCKED: every time step shows the identical mask pattern
+    for t in range(6):
+        onp.testing.assert_array_equal(o[:, t, :], m1)
+    cell.reset()
+    assert cell._input_mask is None  # reset clears the locked mask
+    # backward works with the PRNG-keyed mask on the tape
+    lstm = VariationalDropoutCell(rnn.LSTMCell(8), drop_inputs=0.5)
+    lstm.initialize(mx.init.Xavier())
+    with autograd.record():
+        out2, _ = lstm.unroll(4, nd.ones((2, 4, 3)), merge_outputs=True)
+        out2.sum().backward()
+    g = list(lstm.base_cell.collect_params().values())[0].grad()
+    assert float(onp.abs(g.asnumpy()).sum()) > 0
+
+
+def test_lstmp_cell_projection():
+    """LSTMPCell: state h has projection_size, cell state hidden_size
+    (contrib rnn_cell.py LSTMPCell)."""
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+    cell = LSTMPCell(hidden_size=16, projection_size=4)
+    cell.initialize(mx.init.Xavier())
+    x = nd.ones((3, 5, 2))
+    out, states = cell.unroll(5, x, merge_outputs=True)
+    assert out.shape == (3, 5, 4)           # projected outputs
+    assert states[0].shape == (3, 4)        # projected h
+    assert states[1].shape == (3, 16)       # full cell state
