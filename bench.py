@@ -56,7 +56,7 @@ def _time_steps(step, args, steps, warmup, reps=3,
 
 def bench_resnet(batches=None):
     batch = int(os.environ.get("BENCH_BATCH", 32))
-    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 20))
+    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 80))
     calls = int(os.environ.get("BENCH_CALLS", 2))
     warmup = int(os.environ.get("BENCH_WARMUP", 1))
     model = os.environ.get("BENCH_MODEL", "resnet50_v1")
@@ -144,7 +144,7 @@ def bench_resnet_inference():
 def bench_bert():
     batch = int(os.environ.get("BENCH_BERT_BATCH", 64))
     seq = int(os.environ.get("BENCH_BERT_SEQ", 128))
-    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 20))
+    k = int(os.environ.get("BENCH_STEPS_PER_CALL", 80))
     calls = int(os.environ.get("BENCH_CALLS", 2))
     warmup = int(os.environ.get("BENCH_WARMUP", 1))
 

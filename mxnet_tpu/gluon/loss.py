@@ -1,7 +1,7 @@
 """Loss blocks (parity: python/mxnet/gluon/loss.py — L2Loss, L1Loss,
 SigmoidBinaryCrossEntropyLoss, SoftmaxCrossEntropyLoss, KLDivLoss, CTCLoss,
 HuberLoss, HingeLoss, SquaredHingeLoss, LogisticLoss, TripletLoss, PoissonNLLLoss,
-CosineEmbeddingLoss)."""
+CosineEmbeddingLoss, SDMLLoss)."""
 from __future__ import annotations
 
 from ..base import MXNetError
@@ -10,7 +10,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "PoissonNLLLoss",
+           "CosineEmbeddingLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -257,3 +258,30 @@ class CosineEmbeddingLoss(Loss):
         loss = F.where(label == 1, 1.0 - cos, F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (loss.py:934): paired batches
+    (x1[i] matches x2[i]) train with a smoothed-softmax over pairwise
+    euclidean distances — in-batch negatives with label smoothing."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2):
+        n = x1.shape[0]
+        x1 = x1.reshape((n, -1))
+        x2 = x2.reshape((n, -1))
+        # pairwise squared euclidean distances (N, N)
+        sq1 = F.sum(x1 * x1, axis=1).reshape((n, 1))
+        sq2 = F.sum(x2 * x2, axis=1).reshape((1, n))
+        dist = sq1 + sq2 - 2.0 * F.dot(x1, x2, transpose_b=True)
+        # smoothed identity targets: diagonal matches, uniform elsewhere
+        eye = F.eye(n)
+        labels = (1 - self._smoothing) * eye \
+            + self._smoothing / max(n - 1, 1) * (1.0 - eye)
+        logp = F.log_softmax(-dist, axis=1)
+        loss = -F.sum(labels * logp, axis=1)
+        return _apply_weighting(F, loss, self._weight, None)

@@ -396,3 +396,23 @@ def test_lstmp_cell_projection():
     assert out.shape == (3, 5, 4)           # projected outputs
     assert states[0].shape == (3, 4)        # projected h
     assert states[1].shape == (3, 16)       # full cell state
+
+
+def test_sdml_loss():
+    """SDMLLoss (loss.py:934): aligned pairs yield lower loss than shuffled
+    pairs; gradients flow."""
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(0)
+    emb = rng.rand(6, 8).astype("float32")
+    x1 = nd.array(emb)
+    x2_aligned = nd.array(emb + 0.01 * rng.rand(6, 8).astype("float32"))
+    x2_shuffled = nd.array(emb[::-1].copy())
+    loss_fn = gluon.loss.SDMLLoss(smoothing_parameter=0.1)
+    aligned = float(loss_fn(x1, x2_aligned).mean().asscalar())
+    shuffled = float(loss_fn(x1, x2_shuffled).mean().asscalar())
+    assert aligned < shuffled
+    x1.attach_grad()
+    with autograd.record():
+        l = loss_fn(x1, x2_aligned).sum()
+    l.backward()
+    assert float(onp.abs(x1.grad.asnumpy()).sum()) > 0
