@@ -67,7 +67,7 @@ WIDEST_TYPE_CASTS = [
     "index_add", "index_copy", "slice_assign", "slice_assign_scalar",
     "sequence_mask", "sequence_last", "sequence_reverse",
     "boolean_mask_dense", "sort", "max", "min", "identity",
-    "BlockGrad", "im2col", "_contrib_ROIAlign", "ROIPooling",
+    "BlockGrad", "im2col", "_contrib_ROIAlign", "_contrib_RROIAlign", "ROIPooling",
     "BilinearResize2D", "AdaptiveAvgPooling2D", "GridGenerator", "BilinearSampler", "SpatialTransformer", "_contrib_gradientmultiplier", "IdentityAttachKLSparseReg",
     "_contrib_quadratic", "ldexp", "_div_scalar", "_hypot_scalar",
     "_maximum_scalar", "_minimum_scalar", "_minus_scalar", "_mod_scalar",
@@ -87,7 +87,8 @@ DTYPE_NEUTRAL_OPS = [
     "arange_like", "logical_not",
     "isnan", "isinf", "isfinite", "all_finite", "multi_all_finite",
     "multi_sum_sq", "reset_arrays", "allclose", "bipartite_matching",
-    "edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
+    "edge_id", "dgl_adjacency", "dgl_subgraph", "dgl_graph_compact",
+    "dgl_csr_neighbor_uniform_sample",
     "dgl_csr_neighbor_non_uniform_sample", "_contrib_index_array",
     "_contrib_getnnz", "_contrib_box_iou", "_contrib_box_nms",
     "_contrib_box_encode", "_contrib_box_decode", "MultiBoxPrior",

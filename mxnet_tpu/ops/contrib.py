@@ -900,3 +900,108 @@ def identity_attach_kl_sparse_reg(data, moving_avg=None, *,
     out = _KL_REG(data, lax.stop_gradient(rho_hat),
                   jnp.float32(sparseness_target), jnp.float32(penalty))
     return out, lax.stop_gradient(new_avg)
+
+
+@register("dgl_subgraph", differentiable=False)
+def dgl_subgraph(indptr, indices, data, vids, *, return_mapping=False):
+    """Vertex-induced subgraph of a CSR graph (contrib/dgl_graph.cc
+    DGLSubgraph): keep edges whose endpoints BOTH lie in ``vids``; vertices
+    renumber to their position in vids. Host-side graph prep (like the
+    neighbor samplers). Returns (sub_indptr, sub_indices, sub_data[,
+    edge_mapping])."""
+    import numpy as onp
+    ip = onp.asarray(indptr, onp.int64)
+    ind = onp.asarray(indices, onp.int64)
+    dat = onp.asarray(data)
+    vs = onp.asarray(vids, onp.int64).reshape(-1)
+    pos = {int(v): i for i, v in enumerate(vs)}
+    new_ip = [0]
+    new_ind, new_dat, mapping = [], [], []
+    for v in vs:
+        s, e = int(ip[v]), int(ip[v + 1])
+        for eid in range(s, e):
+            dst = int(ind[eid])
+            if dst in pos:
+                new_ind.append(pos[dst])
+                new_dat.append(dat[eid])
+                mapping.append(eid)
+        new_ip.append(len(new_ind))
+    outs = (jnp.asarray(onp.asarray(new_ip, onp.int32)),
+            jnp.asarray(onp.asarray(new_ind, onp.int32)),
+            jnp.asarray(onp.asarray(new_dat, onp.float32)))
+    if return_mapping:
+        # int32 ids: float32 would corrupt edge ids past 2^24
+        return outs + (jnp.asarray(onp.asarray(mapping, onp.int32)),)
+    return outs
+
+
+@register("dgl_graph_compact", differentiable=False)
+def dgl_graph_compact(indptr, indices, data, *, graph_sizes=None,
+                      return_mapping=False):
+    """Truncate a padded sampled subgraph to its valid prefix
+    (contrib/dgl_graph.cc CompactSubgraph semantics): keep the FIRST
+    ``graph_sizes`` vertices verbatim — isolated-but-valid vertices are
+    retained so per-vertex feature arrays stay aligned — and drop edges
+    whose endpoint is padding (negative or >= graph_sizes)."""
+    import numpy as onp
+    ip = onp.asarray(indptr, onp.int64)
+    ind = onp.asarray(indices, onp.int64)
+    dat = onp.asarray(data)
+    n = len(ip) - 1
+    size = n if graph_sizes is None else int(graph_sizes)
+    size = min(size, n)
+    new_ip = [0]
+    new_ind, new_dat = [], []
+    for v in range(size):
+        s, e = int(ip[v]), int(ip[v + 1])
+        for eid in range(s, e):
+            dst = int(ind[eid])
+            if 0 <= dst < size:   # drop -1 padding / out-of-range edges
+                new_ind.append(dst)
+                new_dat.append(dat[eid])
+        new_ip.append(len(new_ind))
+    outs = (jnp.asarray(onp.asarray(new_ip, onp.int32)),
+            jnp.asarray(onp.asarray(new_ind, onp.int32)),
+            jnp.asarray(onp.asarray(new_dat, onp.float32)))
+    if return_mapping:
+        return outs + (jnp.asarray(onp.arange(size, dtype=onp.int32)),)
+    return outs
+
+
+@register("_contrib_RROIAlign", jit=True, differentiable=False)
+def rroi_align(data, rois, *, pooled_size, spatial_scale, sampling_ratio=2):
+    """Rotated ROI align (contrib/rroi_align.cc): rois are
+    (N, 6) [batch_idx, cx, cy, w, h, angle_degrees]. Each pooled bin
+    averages a sampling_ratio x sampling_ratio bilinear sample grid, and the
+    grid rotates by -theta exactly as the reference kernel
+    (x = lx*cos + ly*sin + cx, y = ly*cos - lx*sin + cy). sampling_ratio is
+    a STATIC count (default 2): the reference's adaptive ceil(roi_h/ph)
+    would make shapes data-dependent, which XLA cannot compile."""
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    sr = max(int(sampling_ratio), 1)
+    n_rois = rois.shape[0]
+    c = data.shape[1]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    cx = rois[:, 1] * spatial_scale
+    cy = rois[:, 2] * spatial_scale
+    w = jnp.maximum(rois[:, 3] * spatial_scale, 1.0)
+    h = jnp.maximum(rois[:, 4] * spatial_scale, 1.0)
+    theta = rois[:, 5] * (jnp.pi / 180.0)
+
+    # sub-bin sample grid over the pooled window, centered in [-0.5, 0.5]
+    gy, gx = jnp.meshgrid(
+        (jnp.arange(ph * sr) + 0.5) / (ph * sr) - 0.5,
+        (jnp.arange(pw * sr) + 0.5) / (pw * sr) - 0.5, indexing="ij")
+    cos_t = jnp.cos(theta)[:, None, None]
+    sin_t = jnp.sin(theta)[:, None, None]
+    lx = gx[None] * w[:, None, None]
+    ly = gy[None] * h[:, None, None]
+    px = cx[:, None, None] + lx * cos_t + ly * sin_t   # (n, ph*sr, pw*sr)
+    py = cy[:, None, None] - lx * sin_t + ly * cos_t
+
+    gathered = _bilinear_sample_nchw(
+        data[batch_idx], py.reshape(n_rois, -1),
+        px.reshape(n_rois, -1))                        # (n, P, c)
+    full = gathered.reshape(n_rois, ph, sr, pw, sr, c)
+    return full.mean(axis=(2, 4)).transpose(0, 3, 1, 2)

@@ -253,3 +253,59 @@ def test_identity_attach_kl_sparse_reg():
     _, new_avg = nd.IdentityAttachKLSparseReg(x, prev, momentum=0.9)
     onp.testing.assert_allclose(new_avg.asnumpy(),
                                 0.9 * 0.5 + 0.1 * act.mean(axis=0), rtol=1e-5)
+
+
+def test_dgl_subgraph_and_compact():
+    """Induced subgraph keeps only intra-set edges with renumbered ids;
+    compact drops isolated vertices (contrib/dgl_graph.cc)."""
+    # graph: 0->1, 0->2, 1->2, 3->0 ; edge data = edge id
+    indptr = nd.array(onp.array([0, 2, 3, 3, 4], "float32"))
+    indices = nd.array(onp.array([1, 2, 2, 0], "float32"))
+    data = nd.array(onp.array([0, 1, 2, 3], "float32"))
+    # induced on {0, 2}: only edge 0->2 survives, renumbered 0->1
+    ip, ind, dat, emap = nd.dgl_subgraph(indptr, indices, data,
+                                         nd.array(onp.array([0, 2], "float32")),
+                                         return_mapping=True)
+    onp.testing.assert_array_equal(ip.asnumpy(), [0, 1, 1])
+    onp.testing.assert_array_equal(ind.asnumpy(), [1])
+    onp.testing.assert_array_equal(emap.asnumpy(), [1])
+    # compact a padded 4-vertex graph to its valid 3-vertex prefix: the
+    # isolated-but-valid vertex 1 is KEPT (feature alignment), the padding
+    # vertex and the -1 edge are dropped
+    ip2 = nd.array(onp.array([0, 2, 2, 2, 2], "float32"))
+    ind2 = nd.array(onp.array([2, -1], "float32"))
+    dat2 = nd.array(onp.array([7, 9], "float32"))
+    cip, cind, cdat, vmap = nd.dgl_graph_compact(ip2, ind2, dat2,
+                                                 graph_sizes=3,
+                                                 return_mapping=True)
+    onp.testing.assert_array_equal(vmap.asnumpy(), [0, 1, 2])
+    onp.testing.assert_array_equal(cip.asnumpy(), [0, 1, 1, 1])
+    onp.testing.assert_array_equal(cind.asnumpy(), [2])
+    onp.testing.assert_array_equal(cdat.asnumpy(), [7])
+
+
+def test_rroi_align_zero_angle_matches_crop():
+    """angle=0 RROIAlign over an axis-aligned box equals a bilinear crop."""
+    rng = onp.random.RandomState(0)
+    img = onp.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    rois = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], "float32")
+    out = nd.contrib.RROIAlign(nd.array(img), nd.array(rois),
+                               pooled_size=2, spatial_scale=1.0,
+                               sampling_ratio=1)
+    assert out.shape == (1, 1, 2, 2)
+    # sample centers at cx±w/4 = {3,5}, cy±h/4 = {3,5}
+    want = onp.array([[img[0, 0, 3, 3], img[0, 0, 3, 5]],
+                      [img[0, 0, 5, 3], img[0, 0, 5, 5]]], "float32")
+    onp.testing.assert_allclose(out.asnumpy()[0, 0], want, atol=1e-4)
+    # rotation direction matches the reference kernel (x = lx*cos + ly*sin
+    # + cx, y = ly*cos - lx*sin + cy): at theta=90 the bin at pooled (0,0)
+    # samples the grid point that the un-rotated roi had at (lx=-1, ly=-1)
+    # mapped to (cx - 1, cy + 1)
+    rois90 = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 90.0]], "float32")
+    out90 = nd.contrib.RROIAlign(nd.array(img), nd.array(rois90),
+                                 pooled_size=2, spatial_scale=1.0,
+                                 sampling_ratio=1)
+    onp.testing.assert_allclose(out90.asnumpy()[0, 0, 0, 0],
+                                img[0, 0, 5, 3], atol=1e-4)
+    onp.testing.assert_allclose(sorted(out90.asnumpy().ravel()),
+                                sorted(out.asnumpy().ravel()), atol=1e-4)
