@@ -22,12 +22,12 @@ TARGET_DTYPE_OPS = [
     "_contrib_interleaved_matmul_selfatt_valatt",
     "_contrib_interleaved_matmul_encdec_qk",
     "_contrib_interleaved_matmul_encdec_valatt", "multi_head_attention",
-    "flash_attention", "Embedding",
+    "flash_attention", "Embedding", "_contrib_SparseEmbedding",
 ]
 
 # numerically sensitive ops pinned to fp32
 FP32_OPS = [
-    "BatchNorm", "SyncBatchNorm", "BatchNormWithReLU", "LayerNorm",
+    "BatchNorm", "BatchNorm_v1", "SyncBatchNorm", "BatchNormWithReLU", "LayerNorm",
     "GroupNorm", "InstanceNorm", "L2Normalization", "LRN", "SoftmaxOutput",
     "softmax", "log_softmax", "masked_softmax", "softmin", "softmax_cross_entropy", "CTCLoss", "exp", "log", "log2",
     "log10", "log1p", "expm1", "sum", "mean", "prod", "nansum", "nanprod",

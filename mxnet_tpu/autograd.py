@@ -150,8 +150,10 @@ def _node_vjp(node: TapeNode, out_cots: List):
     # Embedding with sparse_grad: the weight cotangent stays as (ids, rows)
     # parts instead of a dense scatter into the full (vocab, dim) table
     # (indexing_op.cc row_sparse Embedding gradient; SURVEY §7(d)).
-    if node.op is not None and node.op.name == "Embedding" \
-            and node.attrs.get("sparse_grad") and out_cots[0] is not None:
+    if node.op is not None and out_cots[0] is not None \
+            and (node.op.name == "_contrib_SparseEmbedding"
+                 or (node.op.name == "Embedding"
+                     and node.attrs.get("sparse_grad"))):
         from .sparse import SparseCotangent
         idx = node.inputs[0].data.reshape(-1).astype(jnp.int32)
         dim = node.outputs[0].shape[-1]

@@ -118,6 +118,17 @@ def SyncBatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                          key=key, axis_name=axis_name)
 
 
+def BatchNorm_v1(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                 momentum=0.9, fix_gamma=True, use_global_stats=False,
+                 output_mean_var=False, axis=1, **kwargs):
+    """Legacy alias (batch_norm_v1.cc): same write-back wrapper as
+    BatchNorm — a bare alias would skip train-mode detection and the
+    moving-stat write-back."""
+    return _bn_writeback("BatchNorm_v1", data, gamma, beta, moving_mean,
+                         moving_var, use_global_stats, eps=eps,
+                         momentum=momentum, fix_gamma=fix_gamma, axis=axis)
+
+
 def BatchNormWithReLU(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
                       momentum=0.9, fix_gamma=True, use_global_stats=False,
                       axis=1, **kwargs):

@@ -786,3 +786,20 @@ def spatial_transformer(data, loc, *, target_shape=(0, 0),
     grid = grid_generator(loc, transform_type=transform_type,
                           target_shape=tuple(target_shape))
     return bilinear_sampler(data, grid)
+
+
+@register("BatchNorm_v1", jit=True)
+def batch_norm_v1(x, gamma, beta, moving_mean, moving_var, **attrs):
+    """Legacy alias kept for backcompat (src/operator/batch_norm_v1.cc);
+    identical semantics to BatchNorm on this stack."""
+    return batch_norm(x, gamma, beta, moving_mean, moving_var, **attrs)
+
+
+@register("_contrib_SparseEmbedding", jit=True)
+def sparse_embedding(indices, weight, *, input_dim=0, output_dim=0,
+                     dtype="float32", deterministic=False, **legacy_attrs):
+    """Deprecated alias (contrib SparseEmbedding): Embedding with
+    sparse_grad=True. Tolerates legacy serialized attrs (deterministic);
+    the tape's sparse-cotangent path recognizes this op name directly."""
+    return embedding(indices, weight, input_dim=input_dim,
+                     output_dim=output_dim, dtype=dtype, sparse_grad=True)

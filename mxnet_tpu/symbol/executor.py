@@ -50,7 +50,8 @@ def _param_shape_hook(op, attrs, in_shapes, arg_names):
             out[1] = (data[1], nf // g) + k
         if len(arg_names) > 2:
             out[2] = (nf,)
-    elif op == "BatchNorm":
+    elif op in ("BatchNorm", "BatchNorm_v1", "SyncBatchNorm",
+                "BatchNormWithReLU"):
         axis = int(attrs.get("axis", 1))
         c = data[axis]
         for slot in (1, 2, 3, 4):
@@ -60,7 +61,7 @@ def _param_shape_hook(op, attrs, in_shapes, arg_names):
         c = data[axis]
         for slot in range(1, len(arg_names)):
             out[slot] = (c,)
-    elif op == "Embedding":
+    elif op in ("Embedding", "_contrib_SparseEmbedding"):
         out[1] = (int(attrs.get("input_dim", 0)), int(attrs.get("output_dim", 0)))
     return out
 
