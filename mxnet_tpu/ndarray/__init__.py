@@ -268,6 +268,7 @@ def _install_wrappers():
 _install_wrappers()
 
 from . import random  # noqa: E402  (nd.random namespace)
+from . import image  # noqa: E402  (nd.image namespace, src/operator/image/)
 from . import contrib  # noqa: E402  (nd.contrib: control flow + contrib ops)
 from .utils import save, load  # noqa: E402
 from .. import sparse  # noqa: E402  (nd.sparse namespace, reference parity)
@@ -285,3 +286,17 @@ def waitall_impl():
 
 
 waitall = waitall_impl
+
+# storage-type conversion surface (tensor/cast_storage-inl.h,
+# tensor/square_sum-inl.h): exposed at nd level like the reference
+cast_storage = sparse.cast_storage
+_square_sum = sparse.square_sum
+
+# top-level sample_* surface (reference exposes multisample ops on mx.nd too)
+sample_uniform = random.sample_uniform
+sample_normal = random.sample_normal
+sample_gamma = random.sample_gamma
+sample_exponential = random.sample_exponential
+sample_poisson = random.sample_poisson
+sample_negative_binomial = random.sample_negative_binomial
+sample_generalized_negative_binomial = random.sample_generalized_negative_binomial

@@ -1,0 +1,90 @@
+"""``nd.image`` namespace (parity: python/mxnet/ndarray/image.py — the
+generated frontend of src/operator/image/). Random ops draw a key from the
+global threefry chain, like nd.random does."""
+from __future__ import annotations
+
+from ..ops.registry import apply_op as _apply_op
+from .. import random as _rng
+
+
+def to_tensor(data):
+    return _apply_op("_image_to_tensor", data)
+
+
+def normalize(data, mean=0.0, std=1.0):
+    mean = (mean,) if isinstance(mean, (int, float)) else tuple(mean)
+    std = (std,) if isinstance(std, (int, float)) else tuple(std)
+    return _apply_op("_image_normalize", data, mean=mean, std=std)
+
+
+def imresize(data, w, h, interp=1):
+    return _apply_op("_image_resize", data, size=(int(w), int(h)), interp=interp)
+
+
+def resize(data, size=0, keep_ratio=False, interp=1):
+    # a single int stays 1-element so the op can apply keep_ratio
+    # (GetHeightAndWidth distinguishes size.ndim 1 vs 2)
+    size = (int(size),) if isinstance(size, int) else tuple(size)
+    return _apply_op("_image_resize", data, size=size, keep_ratio=keep_ratio,
+                     interp=interp)
+
+
+def crop(data, x, y, width, height):
+    return _apply_op("_image_crop", data, x=int(x), y=int(y),
+                     width=int(width), height=int(height))
+
+
+def fixed_crop(data, x0, y0, w, h):
+    return crop(data, x0, y0, w, h)
+
+
+def flip_left_right(data):
+    return _apply_op("_image_flip_left_right", data)
+
+
+def flip_top_bottom(data):
+    return _apply_op("_image_flip_top_bottom", data)
+
+
+def random_flip_left_right(data):
+    return _apply_op("_image_random_flip_left_right", data, _rng.take_key())
+
+
+def random_flip_top_bottom(data):
+    return _apply_op("_image_random_flip_top_bottom", data, _rng.take_key())
+
+
+def random_brightness(data, min_factor, max_factor):
+    return _apply_op("_image_random_brightness", data, _rng.take_key(),
+                     min_factor=float(min_factor), max_factor=float(max_factor))
+
+
+def random_contrast(data, min_factor, max_factor):
+    return _apply_op("_image_random_contrast", data, _rng.take_key(),
+                     min_factor=float(min_factor), max_factor=float(max_factor))
+
+
+def random_saturation(data, min_factor, max_factor):
+    return _apply_op("_image_random_saturation", data, _rng.take_key(),
+                     min_factor=float(min_factor), max_factor=float(max_factor))
+
+
+def random_hue(data, min_factor, max_factor):
+    return _apply_op("_image_random_hue", data, _rng.take_key(),
+                     min_factor=float(min_factor), max_factor=float(max_factor))
+
+
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    return _apply_op("_image_random_color_jitter", data, _rng.take_key(),
+                     brightness=float(brightness), contrast=float(contrast),
+                     saturation=float(saturation), hue=float(hue))
+
+
+def adjust_lighting(data, alpha):
+    return _apply_op("_image_adjust_lighting", data, alpha=tuple(alpha))
+
+
+def random_lighting(data, alpha_std=0.05):
+    return _apply_op("_image_random_lighting", data, _rng.take_key(),
+                     alpha_std=float(alpha_std))

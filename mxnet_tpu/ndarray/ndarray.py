@@ -210,13 +210,50 @@ class NDArray:
     # ------------------------------------------------------------------
     # indexing
     # ------------------------------------------------------------------
+    def _mask_index(self, key):
+        """A same-shaped boolean (or 0/1-valued float — the comparison dunders
+        return 0/1 floats for nd parity) NDArray index is a boolean mask:
+        np-style ``x[x > 2]`` / ``x[x > 2] = v``
+        (_npi_boolean_mask_assign_* semantics). A float index containing any
+        value outside {0, 1} is a gather index, not a mask."""
+        if not (isinstance(key, NDArray) and key.shape == self.shape):
+            return None
+        kd = key._data
+        if kd.dtype == bool:
+            return kd
+        if kd.dtype.kind == "f":
+            # host check is fine: mask indexing has a data-dependent output
+            # shape, so it can only ever run eagerly anyway
+            vals = onp.asarray(kd)
+            if ((vals == 0) | (vals == 1)).all():
+                return kd.astype(bool)
+        return None
+
     def __getitem__(self, key) -> "NDArray":
         from ..ops.registry import apply_op
+        mask = self._mask_index(key)
+        if mask is not None:
+            return NDArray(self._data[mask], ctx=self._ctx)
         key = _canon_index(key)
         return apply_op("_getitem", self, key=key)
 
     def __setitem__(self, key, value):
         jnp = _jnp()
+        mask = self._mask_index(key)
+        if mask is not None:
+            if isinstance(value, NDArray):
+                value = value._data
+            if onp.ndim(value) == 0:
+                self._set_data(jnp.where(
+                    mask, jnp.asarray(value, self._data.dtype), self._data))
+            else:
+                # non-scalar value: numpy semantics fill the masked positions
+                # in row-major order (never a broadcast across the full
+                # shape) — data-dependent scatter, host boundary
+                host = onp.array(self.asnumpy())
+                host[onp.asarray(mask)] = onp.asarray(value)
+                self._set_data(jnp.asarray(host))
+            return
         key = _canon_index(key, raw=True)
         if isinstance(value, NDArray):
             value = value._data.astype(self._data.dtype)
@@ -571,6 +608,10 @@ def _canon_index(key, raw=False):
     """Convert NDArray indices to jax-compatible; wrap scalars in tuple form."""
     def conv(k):
         if isinstance(k, NDArray):
+            # legacy nd accepts float index arrays for gather (take
+            # semantics); jnp requires integer indexers
+            if k._data.dtype.kind == "f":
+                return k._data.astype("int32")
             return k._data
         return k
     if isinstance(key, tuple):

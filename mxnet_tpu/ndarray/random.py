@@ -85,3 +85,51 @@ def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
 
 
 seed = _rng.seed
+
+
+# ---------------------------------------------------------------------------
+# array-parameter samplers (multisample_op.cc): mx.nd.random.* with NDArray
+# distribution parameters; output shape = param.shape + shape
+# ---------------------------------------------------------------------------
+def _as_nd(x, dtype="float32"):
+    return x if isinstance(x, NDArray) else NDArray(x, dtype=dtype)
+
+
+def sample_uniform(low, high, shape=(), dtype=None):
+    return _apply_op("_sample_uniform", _as_nd(low), _as_nd(high),
+                     _rng.take_key(), shape=_shape(shape),
+                     dtype=DTypes.canonical(dtype))
+
+
+def sample_normal(mu, sigma, shape=(), dtype=None):
+    return _apply_op("_sample_normal", _as_nd(mu), _as_nd(sigma),
+                     _rng.take_key(), shape=_shape(shape),
+                     dtype=DTypes.canonical(dtype))
+
+
+def sample_gamma(alpha, beta, shape=(), dtype=None):
+    return _apply_op("_sample_gamma", _as_nd(alpha), _as_nd(beta),
+                     _rng.take_key(), shape=_shape(shape),
+                     dtype=DTypes.canonical(dtype))
+
+
+def sample_exponential(lam, shape=(), dtype=None):
+    return _apply_op("_sample_exponential", _as_nd(lam), _rng.take_key(),
+                     shape=_shape(shape), dtype=DTypes.canonical(dtype))
+
+
+def sample_poisson(lam, shape=(), dtype=None):
+    return _apply_op("_sample_poisson", _as_nd(lam), _rng.take_key(),
+                     shape=_shape(shape), dtype=DTypes.canonical(dtype))
+
+
+def sample_negative_binomial(k, p, shape=(), dtype=None):
+    return _apply_op("_sample_negative_binomial", _as_nd(k), _as_nd(p),
+                     _rng.take_key(), shape=_shape(shape),
+                     dtype=DTypes.canonical(dtype))
+
+
+def sample_generalized_negative_binomial(mu, alpha, shape=(), dtype=None):
+    return _apply_op("_sample_generalized_negative_binomial", _as_nd(mu),
+                     _as_nd(alpha), _rng.take_key(), shape=_shape(shape),
+                     dtype=DTypes.canonical(dtype))

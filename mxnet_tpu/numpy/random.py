@@ -83,3 +83,117 @@ def multinomial(n, pvals, size=None):
         draws.reshape(-1, n)) if draws.ndim > 1 else jnp.bincount(
         draws, length=len(pdata))
     return NDArray(counts.reshape(shape + (len(pdata),)))
+
+
+# ---------------------------------------------------------------------------
+# distribution breadth (parity: python/mxnet/numpy/random.py — the _npi_
+# sampler family: bernoulli/gumbel/laplace/logistic/pareto/rayleigh/weibull/
+# beta/chisquare/f/power/lognormal; jax.random-backed on the threefry chain)
+# ---------------------------------------------------------------------------
+def _draw(sampler, size, dtype=None):
+    import jax.numpy as jnp
+    from ..base import DTypes
+    from ..ndarray.ndarray import NDArray
+    key = _rng.take_key()
+    shape = () if size is None else ((size,) if isinstance(size, int) else tuple(size))
+    out = sampler(key, shape)
+    dt = DTypes.jnp(dtype) if dtype else jnp.float32
+    return NDArray(out.astype(dt))
+
+
+def bernoulli(prob, size=None, dtype=None, ctx=None, device=None, out=None):
+    import jax
+    return _draw(lambda k, s: jax.random.bernoulli(k, prob, s), size, dtype)
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: loc + scale * jax.random.gumbel(k, s), size, dtype)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: loc + scale * jax.random.laplace(k, s), size, dtype)
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: loc + scale * jax.random.logistic(k, s), size, dtype)
+
+
+def pareto(a=1.0, size=None, dtype=None, ctx=None, out=None):
+    # numpy semantics: Lomax (Pareto II) — (1-U)^(-1/a) - 1
+    import jax
+    import jax.numpy as jnp
+    return _draw(lambda k, s: jnp.exp(jax.random.exponential(k, s) / a) - 1.0,
+                 size, dtype)
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    import jax.numpy as jnp
+    return _draw(lambda k, s: scale * jnp.sqrt(2.0 * jax.random.exponential(k, s)),
+                 size, dtype)
+
+
+def weibull(a, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    import jax.numpy as jnp
+    return _draw(lambda k, s: jax.random.exponential(k, s) ** (1.0 / a),
+                 size, dtype)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: jax.random.beta(k, a, b, s), size, dtype)
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: 2.0 * jax.random.gamma(k, df / 2.0, s), size, dtype)
+
+
+def f(dfnum, dfden, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    def sampler(k, s):
+        k1, k2 = jax.random.split(k)
+        num = jax.random.gamma(k1, dfnum / 2.0, s) / dfnum
+        den = jax.random.gamma(k2, dfden / 2.0, s) / dfden
+        return num / den
+    return _draw(sampler, size, dtype)
+
+
+def power(a, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    return _draw(lambda k, s: jax.random.uniform(k, s) ** (1.0 / a), size, dtype)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    import jax.numpy as jnp
+    return _draw(lambda k, s: jnp.exp(mean + sigma * jax.random.normal(k, s)),
+                 size, dtype)
+
+
+def triangular(left, mode, right, size=None, dtype=None, ctx=None, out=None):
+    import jax
+    import jax.numpy as jnp
+    def sampler(k, s):
+        u = jax.random.uniform(k, s)
+        c = (mode - left) / (right - left)
+        return jnp.where(
+            u < c,
+            left + jnp.sqrt(u * (right - left) * (mode - left)),
+            right - jnp.sqrt((1 - u) * (right - left) * (right - mode)))
+    return _draw(sampler, size, dtype)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    key = _rng.take_key()
+    m = mean.data if isinstance(mean, NDArray) else jnp.asarray(mean)
+    c = cov.data if isinstance(cov, NDArray) else jnp.asarray(cov)
+    shape = () if size is None else ((size,) if isinstance(size, int) else tuple(size))
+    return NDArray(jax.random.multivariate_normal(key, m, c, shape or None))

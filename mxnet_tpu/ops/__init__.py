@@ -8,5 +8,6 @@ from . import nn        # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib   # noqa: F401
+from . import image_ops  # noqa: F401
 from . import pallas    # noqa: F401
 from . import quantization  # noqa: F401

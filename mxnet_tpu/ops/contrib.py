@@ -1005,3 +1005,86 @@ def rroi_align(data, rois, *, pooled_size, spatial_scale, sampling_ratio=2):
         px.reshape(n_rois, -1))                        # (n, P, c)
     full = gathered.reshape(n_rois, ph, sr, pw, sr, c)
     return full.mean(axis=(2, 4)).transpose(0, 3, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# straight-through estimators (contrib/stes_op.cc): forward quantizes,
+# backward passes the cotangent through unchanged
+# ---------------------------------------------------------------------------
+def _ste(quantize_fn, x):
+    @jax.custom_vjp
+    def f(v):
+        return quantize_fn(v)
+
+    f.defvjp(lambda v: (quantize_fn(v), None), lambda _, g: (g,))
+    return f(x)
+
+
+@register("_contrib_round_ste", jit=True)
+def round_ste(data):
+    return _ste(jnp.round, data)
+
+
+@register("_contrib_sign_ste", jit=True)
+def sign_ste(data):
+    return _ste(jnp.sign, data)
+
+
+@register("_npx_constraint_check", differentiable=False)
+def constraint_check(data, *, msg="Constraint violated."):
+    """npx.constraint_check (src/operator/numpy/np_constraint_check.cc):
+    reduces to a scalar True if every element is true; the eager path raises
+    MXNetError(msg) otherwise (the in-graph value is the boolean itself)."""
+    ok = jnp.all(data != 0)
+    import jax.core as _core
+    if not isinstance(ok, _core.Tracer) and not bool(ok):
+        from ..base import MXNetError
+        raise MXNetError(str(msg))
+    return ok
+
+
+@register("_contrib_mrcnn_mask_target", jit=True, differentiable=False)
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, *, num_rois=0,
+                      num_classes=0, mask_size=(14, 14), sample_ratio=2,
+                      aligned=False):
+    """Mask R-CNN training targets (contrib/mrcnn_mask_target-inl.h):
+    ROIAlign-samples each matched ground-truth mask into mask_size and emits a
+    per-class one-hot weight volume. rois (B,N,4) corner format, gt_masks
+    (B,M,H,W), matches (B,N) gt index, cls_targets (B,N) class id. Returns
+    (mask_targets, mask_cls) both (B,N,C,MH,MW)."""
+    MH, MW = mask_size
+    if int(num_classes) <= 0:
+        raise ValueError("mrcnn_mask_target requires num_classes > 0 "
+                         "(static attribute; it sets the output shape)")
+    C = int(num_classes)
+    sr = max(int(sample_ratio), 1)
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(roi, match, masks):
+        x1, y1, x2, y2 = roi[0] - off, roi[1] - off, roi[2] - off, roi[3] - off
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:  # force malformed ROIs to 1x1 (backward compat path)
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bw, bh = rw / MW, rh / MH
+        iy = y1 + jnp.arange(MH)[:, None] * bh + (jnp.arange(sr)[None, :] + 0.5) * bh / sr
+        ix = x1 + jnp.arange(MW)[:, None] * bw + (jnp.arange(sr)[None, :] + 0.5) * bw / sr
+        ys = jnp.broadcast_to(iy[:, None, :, None], (MH, MW, sr, sr))
+        xs = jnp.broadcast_to(ix[None, :, None, :], (MH, MW, sr, sr))
+        feat = masks[match.astype(jnp.int32)][None]             # (1, H, W)
+        return jnp.mean(_bilinear_sample(feat, ys, xs), axis=(-1, -2))[0]  # (MH, MW)
+
+    per_batch = jax.vmap(lambda rs, ms, masks: jax.vmap(
+        lambda r, m: one_roi(r, m, masks))(rs, ms))
+    sampled = per_batch(rois, matches, gt_masks)                # (B, N, MH, MW)
+    mask_targets = jnp.broadcast_to(sampled[:, :, None],
+                                    sampled.shape[:2] + (C,) + sampled.shape[2:])
+    onehot = (cls_targets[..., None] == jnp.arange(C)).astype(gt_masks.dtype)
+    mask_cls = jnp.broadcast_to(onehot[..., None, None],
+                                onehot.shape + (MH, MW))
+    return mask_targets, mask_cls
+
+
+# reference registers the Hawkes log-likelihood as _contrib_hawkesll
+# (contrib/hawkes_ll.cc); keep both spellings resolvable
+register("_contrib_hawkesll", jit=True)(hawkes_ll)

@@ -1,0 +1,224 @@
+"""Image operators (src/operator/image/: image_random.cc, resize.cc, crop.cc).
+
+The reference implements these as C++ kernels over HWC/NHWC uint8 or float
+tensors; here each is a jnp function (XLA-fusable, differentiable where the
+reference is). Random variants take an explicit threefry key — the functional
+analog of the reference's per-device random resource — supplied by the
+``nd.image`` namespace from the global RNG chain.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ITU-R BT.601 luma coefficients (image_random-inl.h gray path)
+_GRAY = (0.299, 0.587, 0.114)
+# YIQ transform pair for hue rotation (matches python/mxnet/image.py HueJitterAug)
+_TYIQ = ((0.299, 0.587, 0.114),
+         (0.596, -0.274, -0.321),
+         (0.211, -0.523, 0.311))
+_ITYIQ = ((1.0, 0.956, 0.621),
+          (1.0, -0.272, -0.647),
+          (1.0, -1.107, 1.705))
+
+
+def _hwc_axes(x):
+    """Return (h_axis, w_axis, c_axis) for 3D HWC or 4D NHWC input."""
+    if x.ndim == 3:
+        return 0, 1, 2
+    if x.ndim == 4:
+        return 1, 2, 3
+    raise ValueError("image ops expect HWC or NHWC input, got ndim=%d" % x.ndim)
+
+
+@register("_image_to_tensor", jit=True)
+def to_tensor(data):
+    """HWC [0,255] -> CHW float32 [0,1] (image_random.cc:41); batched NHWC->NCHW."""
+    out = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(out, (2, 0, 1))
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register("_image_normalize", jit=True)
+def normalize(data, *, mean=(0.0,), std=(1.0,)):
+    """(x - mean) / std over CHW or NCHW float input (image_random.cc:105)."""
+    c = data.shape[0] if data.ndim == 3 else data.shape[1]
+    m = jnp.broadcast_to(jnp.asarray(mean, data.dtype), (c,))
+    s = jnp.broadcast_to(jnp.asarray(std, data.dtype), (c,))
+    shape = (c, 1, 1) if data.ndim == 3 else (1, c, 1, 1)
+    return (data - m.reshape(shape)) / s.reshape(shape)
+
+
+@register("_image_resize", jit=True)
+def resize(data, *, size=(0, 0), keep_ratio=False, interp=1):
+    """Resize HWC/NHWC to size=(w, h) (resize.cc). interp 0=nearest else
+    bilinear. keep_ratio applies only to a single-int size and pins the
+    SHORTER edge to it (resize-inl.h GetHeightAndWidth)."""
+    ha, wa, _ = _hwc_axes(data)
+    single = isinstance(size, int) or len(size) == 1
+    s0 = int(size) if isinstance(size, int) else int(size[0])
+    if single:
+        if keep_ratio:
+            H, W = data.shape[ha], data.shape[wa]
+            if H > W:
+                w, h = s0, H * s0 // W
+            else:
+                h, w = s0, W * s0 // H
+        else:
+            h = w = s0
+    else:
+        w, h = s0, int(size[1])
+    new_shape = list(data.shape)
+    new_shape[ha], new_shape[wa] = h, w
+    method = "nearest" if interp == 0 else "linear"
+    return jax.image.resize(data.astype(jnp.float32), new_shape,
+                            method).astype(data.dtype)
+
+
+@register("_image_crop", jit=True)
+def crop(data, *, x=0, y=0, width=1, height=1):
+    """Crop region (x, y, width, height) out of HWC/NHWC (crop.cc)."""
+    if data.ndim == 3:
+        return jax.lax.dynamic_slice(
+            data, (y, x, 0), (height, width, data.shape[2]))
+    return jax.lax.dynamic_slice(
+        data, (0, y, x, 0), (data.shape[0], height, width, data.shape[3]))
+
+
+@register("_image_flip_left_right", jit=True)
+def flip_left_right(data):
+    _, wa, _ = _hwc_axes(data)
+    return jnp.flip(data, axis=wa)
+
+
+@register("_image_flip_top_bottom", jit=True)
+def flip_top_bottom(data):
+    ha, _, _ = _hwc_axes(data)
+    return jnp.flip(data, axis=ha)
+
+
+def _maybe(key, fn, data, p=0.5):
+    return jnp.where(jax.random.uniform(key, ()) < p, fn(data), data)
+
+
+@register("_image_random_flip_left_right", jit=True, differentiable=False)
+def random_flip_left_right(data, key):
+    return _maybe(key, flip_left_right, data)
+
+
+@register("_image_random_flip_top_bottom", jit=True, differentiable=False)
+def random_flip_top_bottom(data, key):
+    return _maybe(key, flip_top_bottom, data)
+
+
+def _adjust_brightness(data, alpha):
+    return data.astype(jnp.float32) * alpha
+
+
+def _adjust_contrast(data, alpha):
+    # blend with the scalar gray mean (image_random-inl.h:681-711)
+    _, _, ca = _hwc_axes(data)
+    coef = jnp.asarray(_GRAY, jnp.float32)
+    x = data.astype(jnp.float32)
+    if data.shape[ca] >= 3:
+        gray_mean = jnp.mean(jnp.tensordot(x[..., :3], coef, axes=([ca], [0])))
+    else:
+        gray_mean = jnp.mean(x)
+    return x * alpha + (1.0 - alpha) * gray_mean
+
+
+def _adjust_saturation(data, alpha):
+    # blend with the per-pixel gray (image_random-inl.h:731-759)
+    _, _, ca = _hwc_axes(data)
+    coef = jnp.asarray(_GRAY, jnp.float32)
+    x = data.astype(jnp.float32)
+    gray = jnp.tensordot(x, coef, axes=([ca], [0]))
+    return x * alpha + (1.0 - alpha) * jnp.expand_dims(gray, ca)
+
+
+def _adjust_hue(data, alpha):
+    # rotate chroma in YIQ space (python/mxnet/image.py HueJitterAug analog)
+    u = jnp.cos(alpha * jnp.pi)
+    w = jnp.sin(alpha * jnp.pi)
+    bt = jnp.array([[1.0, 0.0, 0.0], [0.0, 0.0, 0.0], [0.0, 0.0, 0.0]],
+                   jnp.float32) + \
+        jnp.array([[0.0, 0.0, 0.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]],
+                  jnp.float32) * u + \
+        jnp.array([[0.0, 0.0, 0.0], [0.0, 0.0, -1.0], [0.0, 1.0, 0.0]],
+                  jnp.float32) * w
+    t = (jnp.asarray(_ITYIQ, jnp.float32) @ bt @
+         jnp.asarray(_TYIQ, jnp.float32)).T
+    return data.astype(jnp.float32) @ t
+
+
+@register("_image_random_brightness", jit=True, differentiable=False)
+def random_brightness(data, key, *, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_brightness(data, alpha)
+
+
+@register("_image_random_contrast", jit=True, differentiable=False)
+def random_contrast(data, key, *, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_contrast(data, alpha)
+
+
+@register("_image_random_saturation", jit=True, differentiable=False)
+def random_saturation(data, key, *, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_saturation(data, alpha)
+
+
+@register("_image_random_hue", jit=True, differentiable=False)
+def random_hue(data, key, *, min_factor=0.0, max_factor=0.0):
+    alpha = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return _adjust_hue(data, alpha)
+
+
+@register("_image_random_color_jitter", jit=True, differentiable=False)
+def random_color_jitter(data, key, *, brightness=0.0, contrast=0.0,
+                        saturation=0.0, hue=0.0):
+    """Apply brightness/contrast/saturation/hue jitter, each drawn
+    1 + U(-p, p) (image_random-inl.h:944-976). Reference applies them in
+    random order; fixed order here (jit-stable), same distribution family."""
+    ks = jax.random.split(key, 4)
+    x = data.astype(jnp.float32)
+    if brightness > 0:
+        x = _adjust_brightness(x, 1.0 + jax.random.uniform(
+            ks[0], (), minval=-brightness, maxval=brightness))
+    if contrast > 0:
+        x = _adjust_contrast(x, 1.0 + jax.random.uniform(
+            ks[1], (), minval=-contrast, maxval=contrast))
+    if saturation > 0:
+        x = _adjust_saturation(x, 1.0 + jax.random.uniform(
+            ks[2], (), minval=-saturation, maxval=saturation))
+    if hue > 0:
+        x = _adjust_hue(x, jax.random.uniform(
+            ks[3], (), minval=-hue, maxval=hue))
+    return x
+
+
+# AlexNet PCA lighting tables (image_random-inl.h:1029)
+_EIGVAL = (55.46, 4.794, 1.148)
+_EIGVEC = ((-0.5675, 0.7192, 0.4009),
+           (-0.5808, -0.0045, -0.8140),
+           (-0.5836, -0.6948, 0.4203))
+
+
+@register("_image_adjust_lighting", jit=True)
+def adjust_lighting(data, *, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting with fixed alpha (image_random-inl.h:1029)."""
+    rgb = (jnp.asarray(_EIGVEC, jnp.float32) *
+           jnp.asarray(alpha, jnp.float32)) @ jnp.asarray(_EIGVAL, jnp.float32)
+    return data.astype(jnp.float32) + rgb
+
+
+@register("_image_random_lighting", jit=True, differentiable=False)
+def random_lighting(data, key, *, alpha_std=0.05):
+    a = jax.random.normal(key, (3,)) * alpha_std
+    rgb = (jnp.asarray(_EIGVEC, jnp.float32) * a) @ jnp.asarray(
+        _EIGVAL, jnp.float32)
+    return data.astype(jnp.float32) + rgb

@@ -803,3 +803,48 @@ def sparse_embedding(indices, weight, *, input_dim=0, output_dim=0,
     the tape's sparse-cotangent path recognizes this op name directly."""
     return embedding(indices, weight, input_dim=input_dim,
                      output_dim=output_dim, dtype=dtype, sparse_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# legacy regression output heads (src/operator/regression_output.cc).
+# Forward is an activation of the data; the *gradient w.r.t. data* is the
+# regression residual scaled by grad_scale / num_output — the incoming
+# cotangent is ignored, exactly like SoftmaxOutput above
+# (regression_output-inl.h:196-208: num_output = label.Size()/batch).
+# ---------------------------------------------------------------------------
+def _regression_output(data, label, grad_scale, fwd_fn, residual_fn):
+    @jax.custom_vjp
+    def f(x, ll):
+        return fwd_fn(x)
+
+    def f_fwd(x, ll):
+        out = fwd_fn(x)
+        return out, (out, ll)
+
+    def f_bwd(res, g):
+        out, ll = res
+        llb = ll.reshape(out.shape).astype(out.dtype)
+        num_output = out.size // out.shape[0] if out.ndim > 0 else 1
+        dx = residual_fn(out, llb) * (grad_scale / num_output)
+        return dx.astype(out.dtype), None
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data, label)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, *, grad_scale=1.0):
+    return _regression_output(data, label, grad_scale,
+                              lambda x: x, lambda o, l: o - l)
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, *, grad_scale=1.0):
+    return _regression_output(data, label, grad_scale,
+                              jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, *, grad_scale=1.0):
+    return _regression_output(data, label, grad_scale,
+                              lambda x: x, lambda o, l: jnp.sign(o - l))

@@ -92,3 +92,71 @@ def sample_unique_zipfian(key, *, range_max=1, shape=()):
     u = jax.random.uniform(key, shape)
     out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int32)
     return jnp.minimum(out, range_max - 1)
+
+
+# ---------------------------------------------------------------------------
+# array-parameter samplers (src/operator/random/multisample_op.cc): each
+# element of the distribution-parameter arrays yields `shape` draws, so the
+# output shape is param.shape + shape. vmapped over the flattened params.
+# ---------------------------------------------------------------------------
+def _multisample(key, params, shape, draw):
+    flat = [p.reshape(-1) for p in params]
+    n = flat[0].shape[0]
+    keys = jax.random.split(key, n)
+    out = jax.vmap(lambda k, *ps: draw(k, ps, tuple(shape)))(keys, *flat)
+    return out.reshape(tuple(params[0].shape) + tuple(shape))
+
+
+@register("_sample_uniform", differentiable=False)
+def sample_uniform(low, high, key, *, shape=(), dtype=None):
+    return _multisample(key, (low, high), shape,
+                        lambda k, ps, s: jax.random.uniform(
+                            k, s, _dt(dtype), minval=ps[0], maxval=ps[1]))
+
+
+@register("_sample_normal", differentiable=False)
+def sample_normal(mu, sigma, key, *, shape=(), dtype=None):
+    return _multisample(key, (mu, sigma), shape,
+                        lambda k, ps, s: ps[0] + ps[1] *
+                        jax.random.normal(k, s, _dt(dtype)))
+
+
+@register("_sample_gamma", differentiable=False)
+def sample_gamma(alpha, beta, key, *, shape=(), dtype=None):
+    return _multisample(key, (alpha, beta), shape,
+                        lambda k, ps, s: jax.random.gamma(
+                            k, ps[0], s, _dt(dtype)) * ps[1])
+
+
+@register("_sample_exponential", differentiable=False)
+def sample_exponential(lam, key, *, shape=(), dtype=None):
+    return _multisample(key, (lam,), shape,
+                        lambda k, ps, s: jax.random.exponential(
+                            k, s, _dt(dtype)) / ps[0])
+
+
+@register("_sample_poisson", differentiable=False)
+def sample_poisson(lam, key, *, shape=(), dtype=None):
+    return _multisample(key, (lam,), shape,
+                        lambda k, ps, s: jax.random.poisson(
+                            k, ps[0], s).astype(_dt(dtype)))
+
+
+@register("_sample_negative_binomial", differentiable=False)
+def sample_negative_binomial(k_param, p, key, *, shape=(), dtype=None):
+    def draw(k, ps, s):
+        kg, kp = jax.random.split(k)
+        lam = jax.random.gamma(kg, ps[0], s) * (1 - ps[1]) / ps[1]
+        return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+    return _multisample(key, (k_param, p), shape, draw)
+
+
+@register("_sample_generalized_negative_binomial", differentiable=False)
+def sample_generalized_negative_binomial(mu, alpha, key, *, shape=(), dtype=None):
+    def draw(k, ps, s):
+        kg, kp = jax.random.split(k)
+        mu_i, alpha_i = ps
+        r = 1.0 / jnp.maximum(alpha_i, 1e-12)
+        lam = jax.random.gamma(kg, r, s) * (mu_i * alpha_i)
+        return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+    return _multisample(key, (mu, alpha), shape, draw)

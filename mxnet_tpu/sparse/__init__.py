@@ -665,3 +665,35 @@ class SparseCotangent:
     def astype(self, dtype):
         return SparseCotangent([(i, v.astype(dtype)) for i, v in self.parts],
                                self.dense_shape)
+
+
+def square_sum(data, axis=None, keepdims=False):
+    """_square_sum (operator/tensor/square_sum-inl.h): sum(data**2) along axis,
+    computed on the value rows only for RowSparse input (axis 0 or 1). Returns
+    a dense NDArray (axis=1 keepdims output is logically row_sparse in the
+    reference; here dense rows are zero-filled, same values)."""
+    jnp = _jnp()
+    if isinstance(data, RowSparseNDArray):
+        vals, idx = data.data.data, data.indices.data
+        valid = (idx < data.shape[0])
+        sq = jnp.square(vals) * valid.reshape((-1,) + (1,) * (vals.ndim - 1)).astype(vals.dtype)
+        if axis in (None, (0, 1)):
+            out = jnp.sum(sq)
+            if keepdims:
+                out = out.reshape((1,) * len(data.shape))
+        elif axis in (0, (0,)):
+            out = jnp.sum(sq, axis=0)
+            if keepdims:
+                out = out[None]
+        elif axis in (1, (1,)):
+            per_row = jnp.sum(sq.reshape(sq.shape[0], -1), axis=1)
+            out = jnp.zeros((data.shape[0],), vals.dtype).at[
+                jnp.where(valid, idx, data.shape[0])].add(per_row, mode="drop")
+            if keepdims:
+                out = out[:, None]
+        else:
+            raise ValueError("_square_sum(row_sparse) supports axis None/0/1")
+        return NDArray(out, ctx=data.context)
+    arr = data.data if isinstance(data, NDArray) else _jnp().asarray(data)
+    out = jnp.sum(jnp.square(arr), axis=axis, keepdims=keepdims)
+    return NDArray(out, ctx=getattr(data, "context", None) or current_context())

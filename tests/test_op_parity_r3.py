@@ -1,0 +1,362 @@
+"""Round-3 operator-parity batch: legacy regression heads, STE ops,
+mrcnn_mask_target, constraint_check, the nd.image namespace
+(src/operator/image/), sparse square_sum/cast_storage surface, boolean-mask
+indexing, np.random distribution breadth and array-parameter samplers
+(multisample_op.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.numpy as mnp
+from mxnet_tpu import sparse
+
+
+# ---------------------------------------------------------------------------
+# legacy regression output heads (regression_output.cc)
+# ---------------------------------------------------------------------------
+def test_linear_regression_output():
+    data = mx.nd.array(onp.array([[1., 2.], [3., 4.]], "float32"))
+    label = mx.nd.array(onp.array([[0., 1.], [1., 2.]], "float32"))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.LinearRegressionOutput(data, label, grad_scale=2.0)
+    out.backward()
+    assert onp.allclose(out.asnumpy(), data.asnumpy())
+    # dx = (data - label) * grad_scale / num_output, num_output = 2
+    assert onp.allclose(data.grad.asnumpy(),
+                        (data.asnumpy() - label.asnumpy()) * 2.0 / 2)
+
+
+def test_logistic_regression_output():
+    data = mx.nd.array(onp.array([[0.5, -0.5]], "float32"))
+    label = mx.nd.array(onp.array([[1., 0.]], "float32"))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.LogisticRegressionOutput(data, label)
+    out.backward()
+    sig = 1 / (1 + onp.exp(-data.asnumpy()))
+    assert onp.allclose(out.asnumpy(), sig, atol=1e-6)
+    assert onp.allclose(data.grad.asnumpy(), (sig - label.asnumpy()) / 2,
+                        atol=1e-6)
+
+
+def test_mae_regression_output():
+    data = mx.nd.array(onp.array([[3., -1.]], "float32"))
+    label = mx.nd.array(onp.array([[1., 1.]], "float32"))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.MAERegressionOutput(data, label)
+    out.backward()
+    assert onp.allclose(data.grad.asnumpy(), onp.array([[1., -1.]]) / 2)
+
+
+def test_regression_output_1d_label():
+    # (B, 1) data with (B,) label (RegressionOpShape special case)
+    data = mx.nd.array(onp.array([[1.], [2.]], "float32"))
+    label = mx.nd.array(onp.array([0., 1.], "float32"))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.LinearRegressionOutput(data, label)
+    out.backward()
+    assert onp.allclose(data.grad.asnumpy(), onp.array([[1.], [1.]]))
+
+
+# ---------------------------------------------------------------------------
+# straight-through estimators (contrib/stes_op.cc)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("op,fwd", [("round_ste", onp.round),
+                                    ("sign_ste", onp.sign)])
+def test_ste(op, fwd):
+    x = mx.nd.array(onp.array([-1.6, -0.4, 0.4, 1.6], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = getattr(mx.nd.contrib, op)(x)
+        loss = (y * mx.nd.array(onp.array([1., 2., 3., 4.], "float32"))).sum()
+    loss.backward()
+    assert onp.allclose(y.asnumpy(), fwd(x.asnumpy()))
+    # straight-through: gradient passes unchanged
+    assert onp.allclose(x.grad.asnumpy(), [1., 2., 3., 4.])
+
+
+# ---------------------------------------------------------------------------
+# constraint_check (numpy/np_constraint_check.cc)
+# ---------------------------------------------------------------------------
+def test_constraint_check():
+    from mxnet_tpu.ops.registry import apply_op
+    ok = apply_op("_npx_constraint_check", mx.nd.array(onp.ones(3, "float32")))
+    assert bool(ok.asnumpy())
+    with pytest.raises(mx.base.MXNetError, match="positive"):
+        apply_op("_npx_constraint_check",
+                 mx.nd.array(onp.array([1., 0.], "float32")),
+                 msg="must be positive")
+
+
+# ---------------------------------------------------------------------------
+# mrcnn_mask_target (contrib/mrcnn_mask_target-inl.h)
+# ---------------------------------------------------------------------------
+def test_mrcnn_mask_target():
+    rng = onp.random.RandomState(0)
+    B, N, M, C, MS = 2, 3, 4, 5, 7
+    rois = onp.zeros((B, N, 4), "float32")
+    rois[..., 2:] = 16.0  # all ROIs cover [0,16)^2
+    gt_masks = rng.rand(B, M, 32, 32).astype("float32")
+    matches = rng.randint(0, M, (B, N)).astype("float32")
+    cls = rng.randint(0, C, (B, N)).astype("float32")
+    mt, mc = mx.nd.contrib.mrcnn_mask_target(
+        mx.nd.array(rois), mx.nd.array(gt_masks), mx.nd.array(matches),
+        mx.nd.array(cls), num_rois=N, num_classes=C, mask_size=(MS, MS))
+    assert mt.shape == (B, N, C, MS, MS)
+    assert mc.shape == (B, N, C, MS, MS)
+    mcn = mc.asnumpy()
+    for b in range(B):
+        for n in range(N):
+            for c in range(C):
+                expect = 1.0 if c == int(cls[b, n]) else 0.0
+                assert (mcn[b, n, c] == expect).all()
+    # sampled masks are identical across the class axis and within [0, 1]
+    mtn = mt.asnumpy()
+    assert onp.allclose(mtn, mtn[:, :, :1])
+    assert mtn.min() >= 0.0 and mtn.max() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# nd.image namespace (image_random.cc, resize.cc, crop.cc)
+# ---------------------------------------------------------------------------
+class TestImageOps:
+    img = (onp.random.RandomState(0).rand(8, 6, 3) * 255).astype("float32")
+
+    def test_to_tensor(self):
+        t = mx.nd.image.to_tensor(mx.nd.array(self.img))
+        assert t.shape == (3, 8, 6)
+        assert onp.allclose(t.asnumpy(),
+                            self.img.transpose(2, 0, 1) / 255.0, atol=1e-6)
+
+    def test_to_tensor_batched(self):
+        b = onp.stack([self.img, self.img])
+        t = mx.nd.image.to_tensor(mx.nd.array(b))
+        assert t.shape == (2, 3, 8, 6)
+
+    def test_normalize(self):
+        t = mx.nd.image.to_tensor(mx.nd.array(self.img))
+        n = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.1, 0.2, 0.3))
+        exp = (self.img.transpose(2, 0, 1) / 255.0 - 0.5) / \
+            onp.array([0.1, 0.2, 0.3]).reshape(3, 1, 1)
+        assert onp.allclose(n.asnumpy(), exp, atol=1e-5)
+
+    def test_resize_and_crop(self):
+        r = mx.nd.image.resize(mx.nd.array(self.img), (4, 5))
+        assert r.shape == (5, 4, 3)  # size=(w,h) -> (h,w,c)
+        c = mx.nd.image.crop(mx.nd.array(self.img), x=1, y=2, width=3, height=4)
+        assert onp.allclose(c.asnumpy(), self.img[2:6, 1:4])
+
+    def test_flips(self):
+        a = mx.nd.array(self.img)
+        assert onp.allclose(mx.nd.image.flip_left_right(a).asnumpy(),
+                            self.img[:, ::-1])
+        assert onp.allclose(mx.nd.image.flip_top_bottom(a).asnumpy(),
+                            self.img[::-1])
+        rf = mx.nd.image.random_flip_left_right(a).asnumpy()
+        assert onp.allclose(rf, self.img) or onp.allclose(rf, self.img[:, ::-1])
+
+    def test_random_brightness_range(self):
+        mx.random.seed(7)
+        out = mx.nd.image.random_brightness(mx.nd.array(self.img), 0.5, 0.5)
+        assert onp.allclose(out.asnumpy(), self.img * 0.5, atol=1e-4)
+
+    def test_random_contrast_preserves_mean_gray(self):
+        out = mx.nd.image.random_contrast(mx.nd.array(self.img), 1.0, 1.0)
+        assert onp.allclose(out.asnumpy(), self.img, atol=1e-4)
+
+    def test_saturation_gray_identity(self):
+        # alpha=0 collapses to per-pixel gray replicated across channels
+        out = mx.nd.image.random_saturation(mx.nd.array(self.img), 0.0, 0.0)
+        o = out.asnumpy()
+        assert onp.allclose(o[..., 0], o[..., 1], atol=1e-4)
+        assert onp.allclose(o[..., 1], o[..., 2], atol=1e-4)
+
+    def test_hue_zero_is_identity(self):
+        # the published YIQ matrices round-trip to identity only to ~3 decimal
+        # places (≤0.72 absolute on a 0-255 scale), same as the reference's
+        out = mx.nd.image.random_hue(mx.nd.array(self.img), 0.0, 0.0)
+        assert onp.abs(out.asnumpy() - self.img).max() < 0.75
+
+    def test_color_jitter_and_lighting(self):
+        out = mx.nd.image.random_color_jitter(mx.nd.array(self.img),
+                                              0.4, 0.4, 0.4, 0.1)
+        assert out.shape == self.img.shape
+        al = mx.nd.image.adjust_lighting(mx.nd.array(self.img), (0., 0., 0.))
+        assert onp.allclose(al.asnumpy(), self.img)
+        rl = mx.nd.image.random_lighting(mx.nd.array(self.img), 0.05)
+        assert rl.shape == self.img.shape
+
+
+# ---------------------------------------------------------------------------
+# sparse surface: square_sum, nd-level cast_storage
+# ---------------------------------------------------------------------------
+def test_square_sum_row_sparse():
+    d = onp.zeros((6, 3), "float32")
+    d[1] = [1, 2, 3]
+    d[4] = [2, 0, 1]
+    rsp = mx.nd.cast_storage(mx.nd.array(d), "row_sparse")
+    assert float(sparse.square_sum(rsp).asnumpy()) == (d ** 2).sum()
+    assert onp.allclose(sparse.square_sum(rsp, axis=1).asnumpy(),
+                        (d ** 2).sum(1))
+    assert onp.allclose(sparse.square_sum(rsp, axis=0).asnumpy(),
+                        (d ** 2).sum(0))
+    assert sparse.square_sum(rsp, axis=1, keepdims=True).shape == (6, 1)
+    # dense input path
+    assert onp.allclose(sparse.square_sum(mx.nd.array(d)).asnumpy(),
+                        (d ** 2).sum())
+
+
+# ---------------------------------------------------------------------------
+# boolean-mask indexing on the np frontend (_npi_boolean_mask_assign_*)
+# ---------------------------------------------------------------------------
+def test_boolean_mask_getitem():
+    b = mnp.array([1., 2., 3., 4.])
+    assert onp.allclose(b[b > 2].asnumpy(), [3., 4.])
+
+
+def test_boolean_mask_setitem_scalar():
+    a = mnp.array([[1., 2.], [3., 4.]])
+    a[a > 2] = 0.0
+    assert onp.allclose(a.asnumpy(), [[1., 2.], [0., 0.]])
+
+
+def test_boolean_mask_setitem_vector():
+    b = mnp.array([1., 2., 3., 4.])
+    b[b > 2] = mnp.array([9., 10.])
+    assert onp.allclose(b.asnumpy(), [1., 2., 9., 10.])
+
+
+def test_integer_fancy_index_unaffected():
+    c = mnp.array([1., 2., 3.])
+    idx = mnp.array([0, 2]).astype("int32")
+    assert onp.allclose(c[idx].asnumpy(), [1., 3.])
+
+
+# ---------------------------------------------------------------------------
+# np.random distribution breadth
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("name,kwargs,mean,tol", [
+    ("bernoulli", dict(prob=0.3), 0.3, 0.02),
+    ("gumbel", {}, 0.5772, 0.05),
+    ("laplace", {}, 0.0, 0.05),
+    ("logistic", {}, 0.0, 0.08),
+    ("pareto", dict(a=3.0), 0.5, 0.05),
+    ("rayleigh", {}, onp.sqrt(onp.pi / 2), 0.05),
+    ("weibull", dict(a=2.0), 0.8862, 0.03),
+    ("beta", dict(a=2.0, b=3.0), 0.4, 0.02),
+    ("chisquare", dict(df=4.0), 4.0, 0.12),
+    ("f", dict(dfnum=5.0, dfden=10.0), 1.25, 0.12),
+    ("power", dict(a=3.0), 0.75, 0.02),
+    ("lognormal", {}, onp.exp(0.5), 0.1),
+    ("triangular", dict(left=0., mode=1., right=2.), 1.0, 0.05),
+])
+def test_np_random_distribution(name, kwargs, mean, tol):
+    mnp.random.seed(42)
+    out = getattr(mnp.random, name)(size=(20000,), **kwargs)
+    assert out.shape == (20000,)
+    assert abs(float(out.asnumpy().mean()) - mean) < 3 * tol + tol
+
+
+def test_np_random_multivariate_normal():
+    mnp.random.seed(0)
+    mv = mnp.random.multivariate_normal(
+        mnp.array([0., 5.]), mnp.array([[1., 0.], [0., 1.]]), size=(2000,))
+    assert mv.shape == (2000, 2)
+    assert onp.allclose(mv.asnumpy().mean(0), [0., 5.], atol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# array-parameter samplers (multisample_op.cc)
+# ---------------------------------------------------------------------------
+def test_sample_uniform_array_params():
+    mx.random.seed(3)
+    low = mx.nd.array(onp.array([0., 10.], "float32"))
+    high = mx.nd.array(onp.array([1., 20.], "float32"))
+    s = mx.nd.sample_uniform(low, high, shape=(4000,))
+    assert s.shape == (2, 4000)
+    m = s.asnumpy()
+    assert abs(m[0].mean() - 0.5) < 0.05 and abs(m[1].mean() - 15.0) < 0.5
+    assert m[0].min() >= 0.0 and m[0].max() <= 1.0
+    assert m[1].min() >= 10.0 and m[1].max() <= 20.0
+
+
+def test_sample_normal_keeps_param_shape():
+    mu = mx.nd.array(onp.array([[0.], [5.]], "float32"))
+    sg = mx.nd.array(onp.array([[1.], [2.]], "float32"))
+    s = mx.nd.sample_normal(mu, sg, shape=(3000,))
+    assert s.shape == (2, 1, 3000)
+    m = s.asnumpy()
+    assert abs(m[0].mean()) < 0.15 and abs(m[1].mean() - 5.0) < 0.25
+
+
+def test_sample_poisson_gamma_exponential():
+    mx.random.seed(11)
+    lam = mx.nd.array(onp.array([1., 8.], "float32"))
+    sp = mx.nd.sample_poisson(lam, shape=(3000,)).asnumpy()
+    assert abs(sp[0].mean() - 1.0) < 0.15 and abs(sp[1].mean() - 8.0) < 0.4
+    a = mx.nd.array(onp.array([2.0], "float32"))
+    b = mx.nd.array(onp.array([3.0], "float32"))
+    sg = mx.nd.sample_gamma(a, b, shape=(3000,)).asnumpy()
+    assert abs(sg.mean() - 6.0) < 0.5
+    se = mx.nd.sample_exponential(mx.nd.array(onp.array([4.0], "float32")),
+                                  shape=(3000,)).asnumpy()
+    assert abs(se.mean() - 0.25) < 0.05
+
+
+def test_sample_negative_binomials():
+    mx.random.seed(5)
+    k = mx.nd.array(onp.array([3.0], "float32"))
+    p = mx.nd.array(onp.array([0.4], "float32"))
+    s = mx.nd.sample_negative_binomial(k, p, shape=(5000,)).asnumpy()
+    assert abs(s.mean() - 3.0 * 0.6 / 0.4) < 0.5
+    mu = mx.nd.array(onp.array([2.0], "float32"))
+    alpha = mx.nd.array(onp.array([0.5], "float32"))
+    g = mx.nd.sample_generalized_negative_binomial(
+        mu, alpha, shape=(8000,)).asnumpy()
+    assert abs(g.mean() - 2.0) < 0.25
+    # variance of GNB: mu + alpha * mu^2 = 2 + 0.5*4 = 4
+    assert abs(g.var() - 4.0) < 0.8
+
+
+# hawkesll spelling alias
+def test_hawkesll_alias():
+    from mxnet_tpu.ops.registry import get_op
+    assert get_op("_contrib_hawkesll") is not None
+    assert get_op("_contrib_hawkes_ll") is not None
+
+
+def test_boolean_mask_setitem_rowmajor_not_broadcast():
+    # (2,2) with mask hitting (0,1) and (1,0): value vector must fill in
+    # row-major order, NOT via a where-broadcast across rows
+    a = mnp.array([[1., 4.], [5., 2.]])
+    a[a > 2] = mnp.array([9., 10.])
+    assert onp.allclose(a.asnumpy(), [[1., 9.], [10., 2.]])
+
+
+def test_float_gather_index_not_hijacked_as_mask():
+    # same-shaped float index with values outside {0,1} is a gather
+    x = mx.nd.array(onp.array([10., 20., 30.], "float32"))
+    idx = mx.nd.array(onp.array([0., 2., 1.], "float32"))
+    assert onp.allclose(x[idx].asnumpy(), [10., 30., 20.])
+
+
+def test_resize_keep_ratio_short_edge():
+    img = onp.zeros((100, 200, 3), "float32")
+    out = mx.nd.image.resize(mx.nd.array(img), size=50, keep_ratio=True)
+    assert out.shape == (50, 100, 3)
+    out = mx.nd.image.resize(mx.nd.array(onp.zeros((200, 100, 3), "float32")),
+                             size=50, keep_ratio=True)
+    assert out.shape == (100, 50, 3)
+
+
+def test_mrcnn_requires_num_classes():
+    with pytest.raises((ValueError, mx.base.MXNetError)):
+        mx.nd.contrib.mrcnn_mask_target(
+            mx.nd.array(onp.zeros((1, 1, 4), "float32")),
+            mx.nd.array(onp.zeros((1, 1, 8, 8), "float32")),
+            mx.nd.array(onp.zeros((1, 1), "float32")),
+            mx.nd.array(onp.zeros((1, 1), "float32")),
+            num_rois=1, mask_size=(7, 7))
