@@ -1,0 +1,58 @@
+// Minimal C++ inference example (parity: reference cpp-package inference
+// examples / example/image-classification/predict-cpp): load an exported
+// model, run a batch, print the argmax per row.
+//
+// Build:
+//   g++ -std=c++17 -I cpp-package/include predict.cpp \
+//       -L mxnet_tpu/native -lmxtpu_predict -o predict
+// Run:
+//   ./predict <model-prefix> <batch> <flat-input-dim>
+#include <cstdlib>
+#include <iostream>
+
+#include "mxnet_tpu_cpp/mxnet_tpu_cpp.hpp"
+
+namespace mcpp = mxnet_tpu_cpp;
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::cerr << "usage: predict <model-prefix> <batch> <input-dim>\n";
+    return 2;
+  }
+  const std::string prefix = argv[1];
+  const int batch_arg = std::atoi(argv[2]);
+  const int dim_arg = std::atoi(argv[3]);
+  if (batch_arg <= 0 || dim_arg <= 0) {
+    std::cerr << "batch and input-dim must be positive integers\n";
+    return 2;
+  }
+  const unsigned batch = static_cast<unsigned>(batch_arg);
+  const unsigned dim = static_cast<unsigned>(dim_arg);
+
+  try {
+    mcpp::Predictor pred = mcpp::Predictor::FromExport(
+        prefix, {{"data", {batch, dim}}});
+
+    mcpp::NDArray input({batch, dim});
+    for (size_t i = 0; i < input.Size(); ++i) {
+      input.Data()[i] = 0.01f * static_cast<float>(i % 97);
+    }
+    pred.SetInput("data", input);
+    pred.Forward();
+
+    mcpp::NDArray out = pred.GetOutput(0);
+    const auto& shape = out.Shape();
+    std::cout << "output shape:";
+    for (unsigned d : shape) std::cout << " " << d;
+    std::cout << "\n";
+    const size_t classes = out.Size() / batch;
+    for (unsigned b = 0; b < batch; ++b) {
+      std::cout << "row " << b << " argmax "
+                << out.ArgMax(b * classes, (b + 1) * classes) << "\n";
+    }
+  } catch (const mcpp::Error& e) {
+    std::cerr << "error: " << e.what() << "\n";
+    return 1;
+  }
+  return 0;
+}

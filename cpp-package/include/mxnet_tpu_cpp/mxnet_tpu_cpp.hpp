@@ -1,0 +1,198 @@
+// C++ language binding (parity: cpp-package/include/mxnet-cpp/ — the
+// inference surface: NDArray, Context, Predictor; reference predict flow
+// cpp-package example/inference/ + include/mxnet/c_predict_api.h).
+//
+// Header-only RAII wrapper over the libmxtpu_predict.so C ABI
+// (mxnet_tpu/native/predict.cc). A C++ application exports a model from
+// Python once (HybridBlock.export -> symbol.json + params), then loads and
+// runs it here with no Python source in sight.
+#ifndef MXNET_TPU_CPP_HPP_
+#define MXNET_TPU_CPP_HPP_
+
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// the shared ABI header (mxnet_tpu/native/c_predict_api.h) is the single
+// source of truth for these signatures; both the implementation and this
+// binding include it, so drift is a compile error
+#include "../../../mxnet_tpu/native/c_predict_api.h"
+
+namespace mxnet_tpu_cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc, const char* op) {
+  if (rc != 0) {
+    throw Error(std::string(op) + " failed: " + MXGetLastError());
+  }
+}
+
+// Device descriptor (mxnet-cpp Context analog). dev_type 1 = cpu, 2 = gpu in
+// the reference ABI; placement is PJRT's on this stack, the value is
+// informational.
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context tpu(int id = 0) { return {2, id}; }
+};
+
+// Host-side dense float tensor (the inference-boundary slice of the
+// mxnet-cpp NDArray surface).
+class NDArray {
+ public:
+  NDArray() = default;  // empty: Size() == 0, no buffer
+  NDArray(std::vector<unsigned> shape, std::vector<float> data)
+      : shape_(std::move(shape)), data_(std::move(data)) {
+    if (data_.size() != SizeOf(shape_)) {
+      throw Error("NDArray: data size does not match shape");
+    }
+  }
+  explicit NDArray(const std::vector<unsigned>& shape)
+      : shape_(shape), data_(SizeOf(shape), 0.0f) {}
+
+  const std::vector<unsigned>& Shape() const { return shape_; }
+  // data_.size() (not the shape product) so a default-constructed empty
+  // array reports 0 instead of the empty-product 1
+  size_t Size() const { return data_.size(); }
+  const float* Data() const { return data_.data(); }
+  float* Data() { return data_.data(); }
+  const std::vector<float>& Vector() const { return data_; }
+
+  float At(size_t i) const { return data_.at(i); }
+
+  // index of the maximum element in [begin, end) of the flat buffer —
+  // the classic argmax-over-logits helper from the predict examples
+  size_t ArgMax(size_t begin = 0, size_t end = 0) const {
+    if (end == 0) end = data_.size();
+    size_t best = begin;
+    for (size_t i = begin; i < end; ++i) {
+      if (data_[i] > data_[best]) best = i;
+    }
+    return best - begin;
+  }
+
+ private:
+  static size_t SizeOf(const std::vector<unsigned>& s) {
+    return std::accumulate(s.begin(), s.end(), size_t{1},
+                           [](size_t a, unsigned b) { return a * b; });
+  }
+  std::vector<unsigned> shape_;
+  std::vector<float> data_;
+};
+
+// Read a whole file into a string (BufferFile analog from the reference
+// predict-cpp example).
+inline std::string LoadFile(const std::string& path) {
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) throw Error("cannot open " + path);
+  long size = -1;
+  if (std::fseek(f, 0, SEEK_END) == 0) size = std::ftell(f);
+  if (size < 0 || std::fseek(f, 0, SEEK_SET) != 0) {
+    std::fclose(f);
+    throw Error("cannot determine size of " + path +
+                " (directory or non-seekable file?)");
+  }
+  std::string buf(static_cast<size_t>(size), '\0');
+  size_t got = std::fread(buf.data(), 1, static_cast<size_t>(size), f);
+  std::fclose(f);
+  if (got != static_cast<size_t>(size)) throw Error("short read on " + path);
+  return buf;
+}
+
+// RAII predictor over the C ABI (mxnet-cpp Executor / c_predict_api
+// PredictorHandle analog).
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const std::map<std::string, std::vector<unsigned>>& input_shapes,
+            Context ctx = Context::cpu())
+      : handle_(nullptr) {
+    std::vector<const char*> keys;
+    std::vector<unsigned> indptr{0};
+    std::vector<unsigned> dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<unsigned>(dims.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()), ctx.dev_type,
+                       ctx.dev_id, static_cast<unsigned>(keys.size()),
+                       keys.data(), indptr.data(), dims.data(), &handle_),
+          "MXPredCreate");
+  }
+
+  // load directly from exported files: prefix-symbol.json + prefix-0000.params
+  static Predictor FromExport(
+      const std::string& prefix,
+      const std::map<std::string, std::vector<unsigned>>& input_shapes,
+      Context ctx = Context::cpu()) {
+    return Predictor(LoadFile(prefix + "-symbol.json"),
+                     LoadFile(prefix + "-0000.params"), input_shapes, ctx);
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Predictor& operator=(Predictor&& o) noexcept {
+    if (this != &o) {
+      Release();
+      handle_ = o.handle_;
+      o.handle_ = nullptr;
+    }
+    return *this;
+  }
+  ~Predictor() { Release(); }
+
+  void SetInput(const std::string& key, const NDArray& arr) {
+    Check(MXPredSetInput(handle_, key.c_str(), arr.Data(),
+                         static_cast<unsigned>(arr.Size())),
+          "MXPredSetInput");
+  }
+
+  void SetInput(const std::string& key, const float* data, unsigned size) {
+    Check(MXPredSetInput(handle_, key.c_str(), data, size), "MXPredSetInput");
+  }
+
+  void Forward() { Check(MXPredForward(handle_), "MXPredForward"); }
+
+  std::vector<unsigned> GetOutputShape(unsigned index) const {
+    unsigned* shape_data = nullptr;
+    unsigned ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &shape_data, &ndim),
+          "MXPredGetOutputShape");
+    return std::vector<unsigned>(shape_data, shape_data + ndim);
+  }
+
+  NDArray GetOutput(unsigned index) const {
+    NDArray out(GetOutputShape(index));
+    Check(MXPredGetOutput(handle_, index, out.Data(),
+                          static_cast<unsigned>(out.Size())),
+          "MXPredGetOutput");
+    return out;
+  }
+
+ private:
+  void Release() {
+    if (handle_) {
+      MXPredFree(handle_);
+      handle_ = nullptr;
+    }
+  }
+  void* handle_;
+};
+
+}  // namespace mxnet_tpu_cpp
+
+#endif  // MXNET_TPU_CPP_HPP_

@@ -35,7 +35,8 @@ FP32_OPS = [
     "cumprod", "logsumexp", "linalg_potrf", "linalg_potri",
     "linalg_sumlogdiag", "linalg_trsm", "linalg_svd", "linalg_inverse",
     "linalg_det", "linalg_slogdet", "linalg_syevd", "linalg_gelqf",
-    "moments", "mish", "smooth_l1", "_contrib_hawkes_ll",
+    "moments", "mish", "smooth_l1", "_contrib_hawkes_ll", "_contrib_hawkesll",
+    "LinearRegressionOutput", "LogisticRegressionOutput", "MAERegressionOutput",
     "RMSNorm", "SoftmaxActivation", "softrelu", "gelu_tanh", "erf_inv",
     "sum_axis", "_contrib_div_sqrt_dim",
     "rsqrt", "rcbrt", "reciprocal", "cosh", "sinh", "tanh",
@@ -101,6 +102,23 @@ DTYPE_NEUTRAL_OPS = [
     "_contrib_quantized_fully_connected", "_contrib_quantized_pooling",
     "_contrib_quantized_act", "_contrib_quantized_flatten",
     "_contrib_quantized_concat", "_contrib_quantized_elemwise_add",
+    # int8-code ops (round 3 family completion): quantized codes are not
+    # float activations, AMP must not touch them
+    "_contrib_quantize", "_contrib_quantized_batch_norm",
+    "_contrib_quantized_elemwise_mul", "_contrib_quantized_embedding",
+    # boolean / target-generation outputs
+    "_npx_constraint_check", "_contrib_mrcnn_mask_target",
+    # straight-through estimators: pass-through codes, dtype-preserving
+    "_contrib_round_ste", "_contrib_sign_ste",
+    # host-boundary image augmentation pipeline ops (uint8/float pixel
+    # space, never inside an autocast training graph)
+    "_image_to_tensor", "_image_normalize", "_image_resize", "_image_crop",
+    "_image_flip_left_right", "_image_flip_top_bottom",
+    "_image_random_flip_left_right", "_image_random_flip_top_bottom",
+    "_image_random_brightness", "_image_random_contrast",
+    "_image_random_saturation", "_image_random_hue",
+    "_image_random_color_jitter", "_image_adjust_lighting",
+    "_image_random_lighting",
 ]
 
 FP16_FUNCS = TARGET_DTYPE_OPS          # compat aliases (reference naming)

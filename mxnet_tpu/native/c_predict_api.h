@@ -1,0 +1,36 @@
+// C predict ABI (parity: include/mxnet/c_predict_api.h). The single source
+// of truth for the libmxtpu_predict.so signatures — included by both the
+// implementation (predict.cc) and every language binding (cpp-package), so
+// signature drift is a compile error instead of silent argument corruption.
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* MXGetLastError(void);
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, void** out);
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   unsigned size);
+
+int MXPredForward(void* handle);
+
+int MXPredGetOutputShape(void* handle, unsigned index, unsigned** shape_data,
+                         unsigned* shape_ndim);
+
+int MXPredGetOutput(void* handle, unsigned index, float* data, unsigned size);
+
+int MXPredFree(void* handle);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // MXTPU_C_PREDICT_API_H_

@@ -15,6 +15,8 @@
 #include <string>
 #include <vector>
 
+#include "c_predict_api.h"  // shared ABI declarations — drift = compile error
+
 namespace {
 
 std::string g_last_error;

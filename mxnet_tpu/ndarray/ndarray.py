@@ -31,7 +31,7 @@ class NDArray:
     """Multi-dimensional array backed by a PJRT buffer; asynchronous by construction."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node", "_tape_index",
-                 "__weakref__")
+                 "_is_predicate", "__weakref__")
 
     # Let NDArray win binary ops against numpy arrays
     __array_priority__ = 1000.0
@@ -211,22 +211,18 @@ class NDArray:
     # indexing
     # ------------------------------------------------------------------
     def _mask_index(self, key):
-        """A same-shaped boolean (or 0/1-valued float — the comparison dunders
-        return 0/1 floats for nd parity) NDArray index is a boolean mask:
-        np-style ``x[x > 2]`` / ``x[x > 2] = v``
-        (_npi_boolean_mask_assign_* semantics). A float index containing any
-        value outside {0, 1} is a gather index, not a mask."""
+        """A same-shaped boolean NDArray index — or a comparison result,
+        which carries 0/1 floats for nd parity but is tagged _is_predicate —
+        is a boolean mask: np-style ``x[x > 2]`` / ``x[x > 2] = v``
+        (_npi_boolean_mask_assign_* semantics). Untagged float index arrays
+        are always gather indices (take semantics), even if 0/1-valued."""
         if not (isinstance(key, NDArray) and key.shape == self.shape):
             return None
         kd = key._data
         if kd.dtype == bool:
             return kd
-        if kd.dtype.kind == "f":
-            # host check is fine: mask indexing has a data-dependent output
-            # shape, so it can only ever run eagerly anyway
-            vals = onp.asarray(kd)
-            if ((vals == 0) | (vals == 1)).all():
-                return kd.astype(bool)
+        if getattr(key, "_is_predicate", False):
+            return kd.astype(bool)
         return None
 
     def __getitem__(self, key) -> "NDArray":
@@ -308,6 +304,19 @@ class NDArray:
     def __rmod__(self, o):
         return self._binary(o, "broadcast_mod", "_mod_scalar", reverse=True)
 
+    def __and__(self, o):
+        return self._compare(o, "broadcast_logical_and")
+
+    def __or__(self, o):
+        return self._compare(o, "broadcast_logical_or")
+
+    def __xor__(self, o):
+        return self._compare(o, "broadcast_logical_xor")
+
+    def __invert__(self):
+        from ..ops.registry import apply_op
+        return apply_op("logical_not", self)
+
     def __pow__(self, o):
         return self._binary(o, "broadcast_power", "_power_scalar")
 
@@ -350,6 +359,8 @@ class NDArray:
         from ..ops.registry import apply_op
         if not isinstance(other, NDArray):
             other = NDArray(onp.asarray(other), ctx=self._ctx, dtype=self.dtype)
+        # the registry tags the result _is_predicate (see _PREDICATE_OPS) so
+        # np-style boolean indexing recognizes comparison results as masks
         return apply_op(op, self, other)
 
     def __eq__(self, o):

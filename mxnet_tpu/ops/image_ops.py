@@ -80,7 +80,15 @@ def resize(data, *, size=(0, 0), keep_ratio=False, interp=1):
 
 @register("_image_crop", jit=True)
 def crop(data, *, x=0, y=0, width=1, height=1):
-    """Crop region (x, y, width, height) out of HWC/NHWC (crop.cc)."""
+    """Crop region (x, y, width, height) out of HWC/NHWC (crop.cc). Bounds
+    are static attrs, checked at trace time like the reference's CHECKs —
+    lax.dynamic_slice would otherwise silently clamp a bad origin."""
+    ha, wa, _ = _hwc_axes(data)
+    H, W = data.shape[ha], data.shape[wa]
+    if x < 0 or y < 0 or x + width > W or y + height > H:
+        raise ValueError(
+            f"crop region (x={x}, y={y}, w={width}, h={height}) out of "
+            f"bounds for {H}x{W} image")
     if data.ndim == 3:
         return jax.lax.dynamic_slice(
             data, (y, x, 0), (height, width, data.shape[2]))
@@ -119,14 +127,21 @@ def _adjust_brightness(data, alpha):
 
 
 def _adjust_contrast(data, alpha):
-    # blend with the scalar gray mean (image_random-inl.h:681-711)
-    _, _, ca = _hwc_axes(data)
+    # blend with the per-IMAGE scalar gray mean (image_random-inl.h:681-711);
+    # for batched NHWC input each image uses its own mean, so results do not
+    # depend on batch composition
+    ha, wa, ca = _hwc_axes(data)
     coef = jnp.asarray(_GRAY, jnp.float32)
     x = data.astype(jnp.float32)
     if data.shape[ca] >= 3:
-        gray_mean = jnp.mean(jnp.tensordot(x[..., :3], coef, axes=([ca], [0])))
+        gray = jnp.tensordot(x[..., :3], coef, axes=([ca], [0]))
+        gray_mean = jnp.mean(gray, axis=(ha, wa) if data.ndim == 4
+                             else None, keepdims=data.ndim == 4)
     else:
-        gray_mean = jnp.mean(x)
+        gray_mean = jnp.mean(x, axis=(ha, wa, ca) if data.ndim == 4
+                             else None, keepdims=data.ndim == 4)
+    if data.ndim == 4 and data.shape[ca] >= 3:
+        gray_mean = gray_mean[..., None]  # re-add channel axis for broadcast
     return x * alpha + (1.0 - alpha) * gray_mean
 
 

@@ -286,3 +286,101 @@ def quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b):
     acc = (ca + cb).astype(jnp.int32)
     out_amax = real_amax * INT32_SPAN_RATIO
     return acc, -out_amax, out_amax
+
+
+# ---------------------------------------------------------------------------
+# quantize v1 (quantize.cc): explicit-range quantization with array ranges
+# ---------------------------------------------------------------------------
+@register("_contrib_quantize", jit=True, differentiable=False)
+def quantize(data, min_range, max_range, *, out_type="uint8"):
+    """fp32 -> int8/uint8 with the range supplied as inputs (quantize-inl.h).
+    uint8 is affine over [min, max]; int8 zero-centered like quantize_v2."""
+    x = data.astype(jnp.float32)
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx = jnp.asarray(max_range, jnp.float32).reshape(())
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx - mn, 1e-12)
+        q = jnp.clip(jnp.round((x - mn) * scale), 0, 255).astype(jnp.uint8)
+        return q, mn, mx
+    if out_type == "int8":
+        amax = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12)
+        q = jnp.clip(jnp.round(x * (INT8_QMAX / amax)),
+                     -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+        return q, -amax, amax
+    raise ValueError(f"unsupported out_type {out_type}")
+
+
+# ---------------------------------------------------------------------------
+# quantized batch norm (quantized_batch_norm.cc): BN folded into a per-channel
+# int8->int8 affine, exactly the mkldnn_quantized_batch_norm.cc:98-112 fold
+# ---------------------------------------------------------------------------
+@register("_contrib_quantized_batch_norm", jit=True, differentiable=False)
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, *, eps=1e-3,
+                         min_calib_range=None, max_calib_range=None, axis=1):
+    if min_calib_range is None or max_calib_range is None:
+        raise ValueError("quantized_batch_norm requires calibrated output "
+                         "ranges (min_calib_range/max_calib_range) — the "
+                         "output scale is static (quantized_batch_norm.cc)")
+    amax_in = jnp.maximum(jnp.abs(jnp.asarray(min_data, jnp.float32)),
+                          jnp.abs(jnp.asarray(max_data, jnp.float32)))
+    amax_out = max(abs(float(min_calib_range)), abs(float(max_calib_range)),
+                   1e-12)
+    invstd = 1.0 / jnp.sqrt(moving_var.astype(jnp.float32) + eps)
+    # out_real = gamma*invstd*(in_real - mean) + beta; in int8 code space:
+    # out_q = q * [gamma*invstd*amax_in/amax_out] + [(beta-mean*gamma*invstd)*127/amax_out]
+    w = gamma.astype(jnp.float32) * invstd * (amax_in / amax_out)
+    b = (beta.astype(jnp.float32) -
+         moving_mean.astype(jnp.float32) * gamma.astype(jnp.float32) * invstd) \
+        * (INT8_QMAX / amax_out)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = data.astype(jnp.float32) * w.reshape(shape) + b.reshape(shape)
+    q = jnp.clip(jnp.round(out), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, jnp.float32(-amax_out), jnp.float32(amax_out)
+
+
+# ---------------------------------------------------------------------------
+# quantized elementwise mul (quantized_elemwise_mul.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_quantized_elemwise_mul", jit=True, differentiable=False)
+def quantized_elemwise_mul(lhs, rhs, min_lhs, max_lhs, min_rhs, max_rhs, *,
+                           min_calib_range=None, max_calib_range=None,
+                           enable_float_output=False):
+    """int8 * int8 elementwise. Default: int32 codes with the int32-span range
+    convention; with calib ranges: requantized int8; with
+    enable_float_output: dequantized fp32."""
+    amax_l = jnp.maximum(jnp.abs(jnp.asarray(min_lhs, jnp.float32)),
+                         jnp.abs(jnp.asarray(max_lhs, jnp.float32)))
+    amax_r = jnp.maximum(jnp.abs(jnp.asarray(min_rhs, jnp.float32)),
+                         jnp.abs(jnp.asarray(max_rhs, jnp.float32)))
+    acc = lhs.astype(jnp.int32) * rhs.astype(jnp.int32)
+    if enable_float_output:
+        real = acc.astype(jnp.float32) * \
+            ((amax_l / INT8_QMAX) * (amax_r / INT8_QMAX))
+        return real, -amax_l * amax_r, amax_l * amax_r
+    out_amax = amax_l * amax_r * INT32_SPAN_RATIO
+    if min_calib_range is not None and max_calib_range is not None:
+        return requantize(acc, -out_amax, out_amax,
+                          min_calib_range=min_calib_range,
+                          max_calib_range=max_calib_range)
+    return acc, -out_amax, out_amax
+
+
+# ---------------------------------------------------------------------------
+# quantized embedding (quantized_indexing_op.cc): gather int8 codes; the
+# weight's range IS the output range
+# ---------------------------------------------------------------------------
+@register("_contrib_quantized_embedding", jit=True, differentiable=False)
+def quantized_embedding(data, weight, min_weight, max_weight, *, input_dim=0,
+                        output_dim=0, dtype="int8"):
+    if input_dim and int(input_dim) != weight.shape[0]:
+        raise ValueError(
+            f"quantized_embedding: input_dim={input_dim} does not match "
+            f"weight rows {weight.shape[0]}")
+    # same index handling as the dense Embedding op (ops/nn.py embedding):
+    # jnp.take's jit-mode clamp — out-of-range behavior is undefined in the
+    # reference; matching the dense op keeps quantize_net output-compatible
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    return out, jnp.asarray(min_weight, jnp.float32).reshape(()), \
+        jnp.asarray(max_weight, jnp.float32).reshape(())

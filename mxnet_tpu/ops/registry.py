@@ -191,7 +191,23 @@ def invoke(op: Op, inputs: Sequence, attrs: Dict[str, Any]):
 
     if op.differentiable and autograd.is_recording():
         autograd._record_op(op, attrs, list(inputs), outputs)
+    if op.name in _PREDICATE_OPS and isinstance(outputs, NDArray):
+        # comparison/logical results carry 0/1 floats for nd parity; the tag
+        # lets boolean indexing (x[x > 2]) recognize them as masks no matter
+        # whether they came from a dunder or the functional frontend
+        outputs._is_predicate = True
     return outputs
+
+
+# ops whose output is a logical predicate (0/1-valued), taggable as a mask
+_PREDICATE_OPS = frozenset([
+    "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+    "broadcast_greater_equal", "broadcast_lesser", "broadcast_lesser_equal",
+    "broadcast_logical_and", "broadcast_logical_or", "broadcast_logical_xor",
+    "logical_not", "_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+    "_greater_equal_scalar", "_lesser_scalar", "_lesser_equal_scalar",
+    "isnan", "isinf", "isfinite",
+])
 
 
 def apply_op(name: str, *inputs, **attrs):

@@ -1,0 +1,59 @@
+"""C++ language binding end-to-end (parity: cpp-package/ — the mxnet-cpp
+header API). Exports a model from Python, compiles the header-only C++
+example with g++, runs it against libmxtpu_predict.so, and checks the
+predictions against the Python forward."""
+import os
+import subprocess
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+
+NATIVE = os.path.join(os.path.dirname(mx.__file__), "native")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP_INCLUDE = os.path.join(REPO, "cpp-package", "include")
+CPP_EXAMPLE = os.path.join(REPO, "cpp-package", "example", "predict.cpp")
+
+
+@pytest.mark.skipif(not os.path.exists(os.path.join(NATIVE, "Makefile")),
+                    reason="native sources absent")
+def test_cpp_package_predict_example(tmp_path):
+    # export a classifier whose argmax the C++ side will reproduce
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    batch, dim = 3, 7
+    # the exact input pattern the C++ example generates — float32 arithmetic,
+    # matching 0.01f * (float)(i % 97) bit for bit
+    x = ((onp.arange(batch * dim) % 97).astype("float32") *
+         onp.float32(0.01)).reshape(batch, dim)
+    want = net(nd.array(x)).asnumpy()
+    prefix = str(tmp_path / "model")
+    net.export(prefix)
+
+    r = subprocess.run(["make", "-C", NATIVE, "libmxtpu_predict.so"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+
+    exe = tmp_path / "predict"
+    r = subprocess.run(
+        ["g++", "-std=c++17", "-O2", f"-I{CPP_INCLUDE}", CPP_EXAMPLE,
+         "-o", str(exe), f"-L{NATIVE}", "-lmxtpu_predict",
+         f"-Wl,-rpath,{NATIVE}"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=f"{REPO}:{os.environ.get('PYTHONPATH', '')}")
+    r = subprocess.run([str(exe), prefix, str(batch), str(dim)],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].split(":")[1].split() == [str(batch), "5"]
+    got_argmax = [int(line.split()[-1]) for line in lines[1:]]
+    assert got_argmax == list(want.argmax(axis=1))

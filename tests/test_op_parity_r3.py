@@ -360,3 +360,45 @@ def test_mrcnn_requires_num_classes():
             mx.nd.array(onp.zeros((1, 1), "float32")),
             mx.nd.array(onp.zeros((1, 1), "float32")),
             num_rois=1, mask_size=(7, 7))
+
+
+def test_binary_float_index_is_take_not_mask():
+    # untagged 0/1-valued float index array must still gather
+    x = mx.nd.array(onp.array([10., 20., 30.], "float32"))
+    idx = mx.nd.array(onp.array([0., 1., 1.], "float32"))
+    assert onp.allclose(x[idx].asnumpy(), [10., 20., 20.])
+
+
+def test_combined_predicate_mask():
+    # & | ~ keep the predicate tag so compound masks index correctly
+    a = mnp.array([1., 2., 3., 4.])
+    sel = (a > 1) & (a < 4)
+    assert onp.allclose(a[sel].asnumpy(), [2., 3.])
+    sel2 = (a < 2) | (a > 3)
+    assert onp.allclose(a[sel2].asnumpy(), [1., 4.])
+    assert onp.allclose(a[~sel].asnumpy(), [1., 4.])
+
+
+def test_random_contrast_per_image_mean():
+    # batched contrast must use each image's own gray mean
+    lo = onp.full((4, 4, 3), 10.0, "float32")
+    hi = onp.full((4, 4, 3), 200.0, "float32")
+    solo = mx.nd.image.random_contrast(mx.nd.array(lo), 0.5, 0.5).asnumpy()
+    batched = mx.nd.image.random_contrast(
+        mx.nd.array(onp.stack([lo, hi])), 0.5, 0.5).asnumpy()
+    assert onp.allclose(batched[0], solo, atol=1e-4)
+    assert onp.allclose(batched[1], hi, atol=1e-3)  # 0.5*200 + 0.5*200
+
+
+def test_crop_out_of_bounds_raises():
+    img = mx.nd.array(onp.zeros((8, 6, 3), "float32"))
+    with pytest.raises((ValueError, mx.base.MXNetError)):
+        mx.nd.image.crop(img, x=5, y=0, width=4, height=4)
+
+
+def test_functional_comparison_is_tagged_mask():
+    # functional frontend comparisons must index as masks like dunders do
+    x = mx.nd.array(onp.array([10., 20., 30.], "float32"))
+    m = mx.nd.broadcast_greater(x, mx.nd.array(onp.array([15., 15., 15.],
+                                                         "float32")))
+    assert onp.allclose(x[m].asnumpy(), [20., 30.])

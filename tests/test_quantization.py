@@ -266,3 +266,96 @@ def test_quantized_pipeline_composes():
         kernel=(2, 2), stride=(2, 2),
         pool_type="max").asnumpy().reshape(1, -1)
     assert onp.abs(real - ref).max() < 0.1
+
+
+# ---------------------------------------------------------------------------
+# round-3 family completion: quantize (v1), quantized_batch_norm,
+# quantized_elemwise_mul, quantized_embedding
+# ---------------------------------------------------------------------------
+from mxnet_tpu.ops.registry import apply_op  # noqa: E402
+def test_quantize_v1_uint8_roundtrip():
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.uniform(-2, 3, (4, 5)).astype("float32"))
+    q, mn, mxr = apply_op("_contrib_quantize", x,
+                          mx.nd.array(onp.array([-2.], "float32")),
+                          mx.nd.array(onp.array([3.], "float32")))
+    assert q.asnumpy().dtype == onp.uint8
+    deq = apply_op("_contrib_dequantize", q, mn, mxr)
+    assert abs(deq.asnumpy() - x.asnumpy()).max() < 5.0 / 255
+
+
+def test_quantize_v1_int8():
+    x = mx.nd.array(onp.array([-1.0, 0.0, 0.5, 1.0], "float32"))
+    q, mn, mxr = apply_op("_contrib_quantize", x,
+                          mx.nd.array(onp.array([-1.], "float32")),
+                          mx.nd.array(onp.array([1.], "float32")),
+                          out_type="int8")
+    assert q.asnumpy().dtype == onp.int8
+    assert onp.allclose(q.asnumpy(), [-127, 0, 64, 127], atol=1)
+
+
+def test_quantized_batch_norm_matches_float():
+    rng = onp.random.RandomState(1)
+    d = rng.uniform(-1, 1, (2, 3, 4, 4)).astype("float32")
+    gamma = rng.rand(3).astype("float32") + 0.5
+    beta = rng.randn(3).astype("float32") * 0.1
+    mean = rng.randn(3).astype("float32") * 0.1
+    var = rng.rand(3).astype("float32") + 0.5
+    ref = gamma.reshape(1, 3, 1, 1) * (d - mean.reshape(1, 3, 1, 1)) / \
+        onp.sqrt(var.reshape(1, 3, 1, 1) + 1e-3) + beta.reshape(1, 3, 1, 1)
+    qd, dmn, dmx = apply_op("_contrib_quantize_v2", mx.nd.array(d),
+                            out_type="int8")
+    qo, omn, omx = apply_op(
+        "_contrib_quantized_batch_norm", qd, mx.nd.array(gamma),
+        mx.nd.array(beta), mx.nd.array(mean), mx.nd.array(var), dmn, dmx,
+        min_calib_range=float(ref.min()), max_calib_range=float(ref.max()))
+    assert qo.asnumpy().dtype == onp.int8
+    deq = apply_op("_contrib_dequantize", qo, omn, omx).asnumpy()
+    # two quantization steps -> ~2/127 of the range
+    assert abs(deq - ref).max() < 2.5 * abs(ref).max() / 127
+
+
+def test_quantized_batch_norm_requires_calib():
+    import pytest
+    qd = mx.nd.array(onp.zeros((1, 2, 2, 2), "int8"))
+    with pytest.raises((ValueError, mx.base.MXNetError)):
+        apply_op("_contrib_quantized_batch_norm", qd,
+                 mx.nd.ones((2,)), mx.nd.zeros((2,)), mx.nd.zeros((2,)),
+                 mx.nd.ones((2,)), mx.nd.array([-1.0]), mx.nd.array([1.0]))
+
+
+def test_quantized_elemwise_mul():
+    rng = onp.random.RandomState(2)
+    a = rng.uniform(-1, 1, (16,)).astype("float32")
+    b = rng.uniform(-2, 2, (16,)).astype("float32")
+    qa, amn, amx = apply_op("_contrib_quantize_v2", mx.nd.array(a), out_type="int8")
+    qb, bmn, bmx = apply_op("_contrib_quantize_v2", mx.nd.array(b), out_type="int8")
+    qm, mmn, mmx = apply_op("_contrib_quantized_elemwise_mul",
+                            qa, qb, amn, amx, bmn, bmx)
+    assert qm.asnumpy().dtype == onp.int32
+    deq = apply_op("_contrib_dequantize", qm, mmn, mmx).asnumpy()
+    assert abs(deq - a * b).max() < 0.05
+    # float-output mode
+    fm, _, _ = apply_op("_contrib_quantized_elemwise_mul", qa, qb,
+                        amn, amx, bmn, bmx, enable_float_output=True)
+    assert fm.asnumpy().dtype == onp.float32
+    assert abs(fm.asnumpy() - a * b).max() < 0.05
+    # calibrated int8 output
+    im, imn, imx = apply_op("_contrib_quantized_elemwise_mul", qa, qb,
+                            amn, amx, bmn, bmx,
+                            min_calib_range=float((a * b).min()),
+                            max_calib_range=float((a * b).max()))
+    assert im.asnumpy().dtype == onp.int8
+    deq8 = apply_op("_contrib_dequantize", im, imn, imx).asnumpy()
+    assert abs(deq8 - a * b).max() < 0.08
+
+
+def test_quantized_embedding():
+    rng = onp.random.RandomState(3)
+    w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+    qw, wmn, wmx = apply_op("_contrib_quantize_v2", mx.nd.array(w), out_type="int8")
+    idx = mx.nd.array(onp.array([1, 3, 7], "float32"))
+    qe, emn, emx = apply_op("_contrib_quantized_embedding", idx, qw, wmn, wmx)
+    assert qe.shape == (3, 4) and qe.asnumpy().dtype == onp.int8
+    deq = apply_op("_contrib_dequantize", qe, emn, emx).asnumpy()
+    assert abs(deq - w[[1, 3, 7]]).max() < 1.5 / 127
