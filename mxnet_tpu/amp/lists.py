@@ -54,7 +54,7 @@ CONDITIONAL_FP32_OPS = [
 WIDEST_TYPE_CASTS = [
     "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
     "broadcast_mod", "broadcast_power", "broadcast_maximum",
-    "broadcast_minimum", "broadcast_hypot", "elemwise_add", "elemwise_sub",
+    "broadcast_minimum", "broadcast_hypot", "hypot", "elemwise_add", "elemwise_sub",
     "elemwise_mul", "elemwise_div", "add_n", "concat", "stack", "where",
     "maximum", "minimum", "clip", "abs", "sign", "negative", "square",
     "sqrt", "cbrt", "floor", "ceil", "round", "rint", "trunc", "fix",

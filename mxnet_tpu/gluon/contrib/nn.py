@@ -1,0 +1,133 @@
+"""gluon.contrib.nn (parity: python/mxnet/gluon/contrib/nn/basic_layers.py):
+Concurrent/HybridConcurrent/Identity, the PixelShuffle family,
+SparseEmbedding, BatchNormReLU."""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from ..nn.basic_layers import Sequential, HybridSequential, Embedding, BatchNorm
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+           "BatchNormReLU"]
+
+
+class Concurrent(Sequential):
+    """Feeds the input to every child and concatenates their outputs on
+    `axis` (contrib/nn/basic_layers.py:31)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (contrib/nn/basic_layers.py:64). The container
+    runs children via forward directly, like HybridSequential."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        from ... import ndarray as nd
+        return nd.concat(*[block(x) for block in self._children.values()],
+                         dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block, e.g. the residual branch of a Concurrent
+    (contrib/nn/basic_layers.py:97)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Embedding with row-sparse gradients (contrib/nn/basic_layers.py
+    SparseEmbedding): same lookup, grad w.r.t. weight is a RowSparse
+    cotangent consumed by the lazy sparse optimizer rules."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm fused with ReLU (contrib BatchNormWithReLU op); on this
+    stack XLA fuses the activation into the normalize epilogue anyway, so
+    this is API parity over the same machinery."""
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        out = super().hybrid_forward(F, x, gamma, beta, running_mean,
+                                     running_var)
+        return F.relu(out)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim):
+        super().__init__()
+        try:
+            self._factors = (int(factor),) * ndim
+        except TypeError:
+            self._factors = tuple(int(f) for f in factor)
+            assert len(self._factors) == ndim, \
+                f"expected {ndim} factors, got {len(self._factors)}"
+
+    def __repr__(self):
+        f = self._factors
+        return f"{type(self).__name__}({f[0] if len(set(f)) == 1 else f})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, f*W) sub-pixel upsample
+    (contrib/nn/basic_layers.py PixelShuffle1D)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+    def hybrid_forward(self, F, x):
+        (f,) = self._factors
+        n, fc, w = x.shape
+        c = fc // f
+        x = F.reshape(x, shape=(n, c, f, w))
+        x = F.transpose(x, axes=(0, 1, 3, 2))
+        return F.reshape(x, shape=(n, c, w * f))
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, f1*H, f2*W)
+    (contrib/nn/basic_layers.py PixelShuffle2D)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, fc, h, w = x.shape
+        c = fc // (f1 * f2)
+        x = F.reshape(x, shape=(n, c, f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(n, c, h * f1, w * f2))
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, f1*D, f2*H, f3*W)
+    (contrib/nn/basic_layers.py PixelShuffle3D)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._factors
+        n, fc, d, h, w = x.shape
+        c = fc // (f1 * f2 * f3)
+        x = F.reshape(x, shape=(n, c, f1, f2, f3, d, h, w))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(n, c, d * f1, h * f2, w * f3))

@@ -339,3 +339,9 @@ class BidirectionalCell(RecurrentCell):
         if merge_outputs:
             outputs = nd_mod.stack(*outputs, axis=axis)
         return outputs, l_states + r_states
+
+
+class HybridSequentialRNNCell(SequentialRNNCell):
+    """Hybridizable sequential stack of cells (rnn_cell.py:772); on this
+    stack every cell composes into the traced computation, so the class is
+    the same machinery under the reference's hybrid name."""

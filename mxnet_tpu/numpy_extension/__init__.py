@@ -142,3 +142,110 @@ def load(fname):
 def save(fname, data):
     from ..ndarray.utils import save as _save
     return _save(fname, data)
+
+
+def constraint_check(data, msg="Constraint violated."):
+    """npx.constraint_check (numpy/np_constraint_check.cc)."""
+    return _apply_op("_npx_constraint_check", data, msg=msg)
+
+
+def gather_nd(data, indices):
+    return _apply_op("gather_nd", data, indices)
+
+
+def scatter_nd(data, indices, shape):
+    return _apply_op("scatter_nd", data, indices, shape=tuple(shape))
+
+
+def nonzero(a):
+    """npx.nonzero (np_nonzero_op.cc): (num_nonzero, ndim) index array (int32
+    here — x64 is disabled on this stack). Data-dependent shape — host
+    boundary, like boolean indexing."""
+    import numpy as _onp
+    from ..ndarray.ndarray import NDArray
+    arr = a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+    return NDArray(_onp.argwhere(arr != 0), dtype="int32")
+
+
+def _xreshape_infer(src, target):
+    """NumpyXReshapeInferShape (np_matrix_op.cc:199): resolve the -1..-6
+    special codes against the static source shape."""
+    out = []
+    unknown_axis = -1
+    known_prod = 1
+    si = 0
+    i = 0
+    while i < len(target):
+        d = target[i]
+        if d >= 0:
+            out.append(d)
+            known_prod *= d
+            si += 1
+        elif d == -1:
+            if unknown_axis >= 0:
+                raise ValueError("one and only one dim can be inferred")
+            unknown_axis = len(out)
+            out.append(-1)
+            si += 1
+        elif d == -2:  # copy this dimension from src
+            if si >= len(src):
+                raise ValueError("unmatching dimension of proposed new shape")
+            out.append(src[si]); known_prod *= src[si]; si += 1
+        elif d == -3:  # skip a size-1 source dimension
+            if src[si] != 1:
+                raise ValueError("-3 index should only skip dimension size 1")
+            si += 1
+        elif d == -4:  # copy all remaining dims
+            while si < len(src):
+                out.append(src[si]); known_prod *= src[si]; si += 1
+        elif d == -5:  # merge two source dims
+            if si >= len(src) - 1:
+                raise ValueError("not enough dimensions left for the product")
+            out.append(src[si] * src[si + 1])
+            known_prod *= src[si] * src[si + 1]
+            si += 2
+        elif d == -6:  # split one source dim into two (either may be -1)
+            if i + 2 >= len(target) or si >= len(src):
+                raise ValueError("-6 requires two following dims")
+            d0 = src[si]; si += 1
+            d1, d2 = target[i + 1], target[i + 2]
+            if d1 == -1 and d2 == -1:
+                raise ValueError("split dims cannot both be -1")
+            if d1 == -1:
+                d1 = d0 // d2
+            if d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise ValueError(f"cannot split dim {d0} into ({d1}, {d2})")
+            out += [d1, d2]; known_prod *= d0
+            i += 2
+        else:
+            raise ValueError(f"dimension size must be >= -6, got {d}")
+        i += 1
+    total = 1
+    for s in src:
+        total *= s
+    if unknown_axis >= 0:
+        out[unknown_axis] = total // known_prod
+    return tuple(out)
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """npx.reshape with the full -1..-6 special-code semantics
+    (np_matrix_op.cc NumpyXReshape). reverse=True matches codes against the
+    shape right-to-left."""
+    if order != "C":
+        raise ValueError("npx.reshape supports order='C' only")
+    target = (newshape,) if isinstance(newshape, int) else tuple(newshape)
+    src = tuple(a.shape)
+    if reverse:
+        resolved = _xreshape_infer(src[::-1], target[::-1])[::-1]
+    else:
+        resolved = _xreshape_infer(src, target)
+    return _apply_op("reshape", a, shape=resolved)
+
+
+# npx.random / npx.image namespaces (reference numpy_extension/random.py,
+# numpy_extension/image.py)
+from . import random  # noqa: E402,F401
+from . import image  # noqa: E402,F401

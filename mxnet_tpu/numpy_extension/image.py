@@ -1,0 +1,8 @@
+"""npx.image (parity: python/mxnet/numpy_extension/image.py): the same
+``_image_*`` op family as nd.image, re-exported for the numpy frontend."""
+from ..ndarray.image import (  # noqa: F401
+    to_tensor, normalize, imresize, resize, crop, fixed_crop,
+    flip_left_right, flip_top_bottom, random_flip_left_right,
+    random_flip_top_bottom, random_brightness, random_contrast,
+    random_saturation, random_hue, random_color_jitter, adjust_lighting,
+    random_lighting)

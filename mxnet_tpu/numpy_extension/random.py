@@ -1,0 +1,76 @@
+"""npx.random (parity: python/mxnet/numpy_extension/random.py): seed,
+bernoulli (prob or logit), and the *_n batch-shape samplers
+(_npi_uniform_n/_npi_normal_n: batch_shape APPENDS to the parameter
+shape)."""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .. import random as _rng
+from ..numpy import random as _np_random
+from ..ndarray.ndarray import NDArray
+
+seed = _rng.seed
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              out=None):
+    """Bernoulli draws from probabilities OR logits (exactly one given,
+    reference numpy_extension/random.py:77)."""
+    if (prob is None) == (logit is None):
+        raise ValueError("bernoulli: pass exactly one of prob / logit")
+    if logit is not None:
+        if isinstance(logit, NDArray):
+            from ..ops.registry import apply_op
+            prob = apply_op("sigmoid", logit)  # on-device, trace-safe
+        else:
+            prob = 1.0 / (1.0 + _onp.exp(-float(logit)))
+    if isinstance(prob, NDArray):
+        res = _tensor_bernoulli(prob, size, dtype)
+    else:
+        res = _np_random.bernoulli(float(prob), size=size, dtype=dtype,
+                                   ctx=ctx)
+    if out is not None:
+        out._set_data(res.data)
+        return out
+    return res
+
+
+def _tensor_bernoulli(prob, size, dtype):
+    """Per-element probabilities: U(0,1) of shape prob.shape+size < prob."""
+    import jax
+    import jax.numpy as jnp
+    from ..base import DTypes
+    shape = () if size is None else \
+        ((size,) if isinstance(size, int) else tuple(size))
+    p = prob.data
+    u = jax.random.uniform(_rng.take_key(), tuple(p.shape) + shape)
+    draw = (u < p.reshape(tuple(p.shape) + (1,) * len(shape))).astype(
+        DTypes.jnp(dtype) if dtype else jnp.float32)
+    return NDArray(draw)
+
+
+def _fill_like(value, like):
+    return NDArray(_onp.full(like.shape, float(value), "float32"))
+
+
+def uniform_n(low=0.0, high=1.0, batch_shape=None, dtype=None, ctx=None):
+    """batch_shape APPENDS to the parameter shape (_npi_uniform_n); tensor
+    params route through the multisample op (multisample_op.cc)."""
+    size = batch_shape if batch_shape is not None else ()
+    if isinstance(low, NDArray) or isinstance(high, NDArray):
+        from ..ndarray import random as _nd_random
+        lo = low if isinstance(low, NDArray) else _fill_like(low, high)
+        hi = high if isinstance(high, NDArray) else _fill_like(high, low)
+        return _nd_random.sample_uniform(lo, hi, shape=size, dtype=dtype)
+    return _np_random.uniform(low, high, size=size, dtype=dtype, ctx=ctx)
+
+
+def normal_n(loc=0.0, scale=1.0, batch_shape=None, dtype=None, ctx=None):
+    size = batch_shape if batch_shape is not None else ()
+    if isinstance(loc, NDArray) or isinstance(scale, NDArray):
+        from ..ndarray import random as _nd_random
+        mu = loc if isinstance(loc, NDArray) else _fill_like(loc, scale)
+        sg = scale if isinstance(scale, NDArray) else _fill_like(scale, loc)
+        return _nd_random.sample_normal(mu, sg, shape=size, dtype=dtype)
+    return _np_random.normal(loc, scale, size=size, dtype=dtype, ctx=ctx)

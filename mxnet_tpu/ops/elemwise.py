@@ -223,6 +223,7 @@ _binary("elemwise_mul", jnp.multiply)
 _binary("elemwise_div", jnp.divide)
 _binary("maximum", jnp.maximum)
 _binary("minimum", jnp.minimum)
+_binary("hypot", jnp.hypot)
 _binary("arctan2", jnp.arctan2)
 _binary("ldexp", lambda a, b: a * (2.0 ** b))
 

@@ -456,3 +456,43 @@ def lars_update(weight, grad, mom, *, lr, eta=0.001, momentum=0.9, wd=0.0,
     g = g + wd * weight
     mom = momentum * mom + (lr * local_lr).astype(weight.dtype) * g
     return weight - mom, mom
+
+
+@register("multi_mp_adamw_update", differentiable=False)
+def multi_mp_adamw_update(*arrays, lrs, etas, wds, num_weights, beta1=0.9,
+                          beta2=0.999, epsilon=1e-8, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    """Fused multi-tensor multi-precision AdamW (contrib/adamw.cc
+    multi_mp_adamw_update): groups of (w16, g, m, v, w32)."""
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_chunks(arrays, num_weights, 5)):
+        nw, nm, nv, nw32 = mp_adamw_update(
+            w, g, m, v, w32, lr=lrs[i], eta=etas[i], beta1=beta1, beta2=beta2,
+            epsilon=epsilon, wd=wds[i], rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        outs.extend([nw, nm, nv, nw32])
+    return tuple(outs)
+
+
+@register("multi_mp_lamb_update", differentiable=False)
+def multi_mp_lamb_update(*arrays, lrs, wds, num_weights, step_count,
+                         beta1=0.9, beta2=0.999, epsilon=1e-6,
+                         bias_correction=True, lower_bound=-1.0,
+                         upper_bound=-1.0, rescale_grad=1.0,
+                         clip_gradient=-1.0):
+    """Fused multi-tensor multi-precision LAMB (contrib/multi_lamb.cu mp
+    path): groups of (w16, g, m, v, w32); the trust-ratio norms use the
+    fp32 master weight."""
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_chunks(arrays, num_weights, 5)):
+        gp, nm, nv = lamb_update_phase1(
+            w32, g.astype(jnp.float32), m, v, beta1=beta1, beta2=beta2,
+            epsilon=epsilon, t=step_count[i], bias_correction=bias_correction,
+            wd=wds[i], rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        r1 = jnp.linalg.norm(w32)
+        r2 = jnp.linalg.norm(gp)
+        nw32 = lamb_update_phase2(w32, gp, r1, r2, lr=lrs[i],
+                                  lower_bound=lower_bound,
+                                  upper_bound=upper_bound)
+        outs.extend([nw32.astype(w.dtype), nm, nv, nw32])
+    return tuple(outs)

@@ -416,3 +416,61 @@ def test_sdml_loss():
         l = loss_fn(x1, x2_aligned).sum()
     l.backward()
     assert float(onp.abs(x1.grad.asnumpy()).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.nn (contrib/nn/basic_layers.py parity, round 3)
+# ---------------------------------------------------------------------------
+def test_contrib_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    for cls in (cnn.Concurrent, cnn.HybridConcurrent):
+        net = cls(axis=-1)
+        net.add(nn.Dense(4), nn.Dense(6), cnn.Identity())
+        net.initialize()
+        out = net(mx.nd.array(onp.ones((2, 3), "float32")))
+        assert out.shape == (2, 13)
+
+
+def test_contrib_pixelshuffle():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    assert cnn.PixelShuffle1D(2)(
+        mx.nd.array(onp.zeros((1, 8, 3), "float32"))).shape == (1, 4, 6)
+    x = onp.arange(1 * 4 * 2 * 2).reshape(1, 4, 2, 2).astype("float32")
+    got = cnn.PixelShuffle2D(2)(mx.nd.array(x)).asnumpy()
+    exp = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 4, 2, 5, 3).reshape(
+        1, 1, 4, 4)
+    assert onp.allclose(got, exp)
+    assert cnn.PixelShuffle2D((2, 3))(
+        mx.nd.array(onp.zeros((1, 12, 3, 5), "float32"))).shape == (1, 2, 6, 15)
+    assert cnn.PixelShuffle3D(2)(
+        mx.nd.array(onp.zeros((1, 16, 2, 3, 4), "float32"))).shape == \
+        (1, 2, 4, 6, 8)
+
+
+def test_contrib_sparse_embedding_block():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    se = cnn.SparseEmbedding(10, 4)
+    se.initialize()
+    out = se(mx.nd.array(onp.array([1, 2], "float32")))
+    assert out.shape == (2, 4)
+    assert se.weight._grad_stype == "row_sparse"
+
+
+def test_contrib_batchnorm_relu():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    bnr = cnn.BatchNormReLU()
+    bnr.initialize()
+    with mx.autograd.record():
+        out = bnr(mx.nd.array(onp.random.RandomState(0).randn(
+            2, 3, 4, 4).astype("float32")))
+    assert float(out.asnumpy().min()) >= 0.0
+
+
+def test_hybrid_sequential_rnn_cell():
+    from mxnet_tpu.gluon.rnn import HybridSequentialRNNCell, LSTMCell
+    cell = HybridSequentialRNNCell()
+    cell.add(LSTMCell(8, input_size=4))
+    cell.initialize()
+    out, states = cell(mx.nd.array(onp.zeros((2, 4), "float32")),
+                       cell.begin_state(2))
+    assert out.shape == (2, 8)

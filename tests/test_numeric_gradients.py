@@ -1,0 +1,193 @@
+"""Systematic numeric-gradient sweep over the differentiable op library —
+the reference's core operator-correctness oracle
+(python/mxnet/test_utils.py:987 check_numeric_gradient, applied throughout
+tests/python/unittest/test_operator.py). Every case compares the autograd
+VJP against central finite differences on small float64-friendly shapes."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RNG = onp.random.RandomState(0)
+
+
+def _arr(*shape, scale=1.0, offset=0.0):
+    return mx.nd.array((RNG.rand(*shape).astype("float32") - 0.5) * 2 * scale
+                       + offset)
+
+
+# (name, fn(*inputs)->scalar, input builders, tolerance overrides)
+UNARY_CASES = [
+    ("exp", lambda x: nd.exp(x).sum(), dict()),
+    ("log", lambda x: nd.log(x).sum(), dict(offset=2.0)),
+    ("sqrt", lambda x: nd.sqrt(x).sum(), dict(offset=2.0)),
+    ("square", lambda x: nd.square(x).sum(), dict()),
+    ("tanh", lambda x: nd.tanh(x).sum(), dict()),
+    ("sigmoid", lambda x: nd.sigmoid(x).sum(), dict()),
+    ("relu", lambda x: nd.relu(x).sum(), dict(offset=1.5)),  # away from kink
+    ("softrelu", lambda x: nd.Activation(x, act_type="softrelu").sum(), dict()),
+    ("erf", lambda x: nd.erf(x).sum(), dict()),
+    ("rsqrt", lambda x: nd.rsqrt(x).sum(), dict(offset=2.0)),
+    ("cbrt", lambda x: nd.cbrt(x).sum(), dict(offset=2.0)),
+    ("expm1", lambda x: nd.expm1(x).sum(), dict()),
+    ("log1p", lambda x: nd.log1p(x).sum(), dict(offset=1.0)),
+    ("sin", lambda x: nd.sin(x).sum(), dict()),
+    ("arctan", lambda x: nd.arctan(x).sum(), dict()),
+    ("softsign", lambda x: nd.softsign(x).sum(), dict(offset=2.0)),
+    ("gamma_ln", lambda x: nd.gammaln(x).sum(), dict(offset=3.0)),
+]
+
+
+@pytest.mark.parametrize("name,fn,opts",
+                         UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_gradient(name, fn, opts):
+    check_numeric_gradient(fn, [_arr(3, 4, **opts)], eps=1e-3, rtol=2e-2,
+                           atol=2e-3)
+
+
+BINARY_CASES = [
+    ("add", lambda a, b: (a + b).sum()),
+    ("sub", lambda a, b: (a - b).sum()),
+    ("mul", lambda a, b: (a * b).sum()),
+    ("div", lambda a, b: (a / (b + 3.0)).sum()),
+    ("pow", lambda a, b: ((a + 3.0) ** (b + 2.0)).sum()),
+    ("maximum", lambda a, b: nd.maximum(a * 2, b).sum()),
+    ("hypot", lambda a, b: nd.hypot(a + 2, b + 2).sum()),
+    ("broadcast_mul_bcast", lambda a, b: nd.broadcast_mul(a, b.reshape((1, 4))).sum()),
+]
+
+
+@pytest.mark.parametrize("name,fn",
+                         BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_gradient(name, fn):
+    b_shape = (4,) if name.endswith("bcast") else (3, 4)
+    check_numeric_gradient(lambda a, b: fn(a, b),
+                           [_arr(3, 4), _arr(*b_shape)],
+                           eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+REDUCE_CASES = [
+    ("sum_axis", lambda x: nd.sum(x, axis=1).sum()),
+    ("mean", lambda x: nd.mean(x)),
+    ("prod", lambda x: nd.prod(x + 2.0)),
+    ("norm", lambda x: nd.norm(x + 1.0)),
+    ("max_reduce", lambda x: nd.max(x, axis=0).sum()),
+    ("logsumexp", lambda x: nd.logsumexp(x, axis=1).sum()
+     if hasattr(nd, "logsumexp") else nd.log(nd.sum(nd.exp(x), axis=1)).sum()),
+]
+
+
+@pytest.mark.parametrize("name,fn",
+                         REDUCE_CASES, ids=[c[0] for c in REDUCE_CASES])
+def test_reduce_gradient(name, fn):
+    check_numeric_gradient(fn, [_arr(3, 4)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_dot_gradient():
+    check_numeric_gradient(lambda a, b: nd.dot(a, b).sum(),
+                           [_arr(3, 4), _arr(4, 2)], eps=1e-3, rtol=2e-2,
+                           atol=2e-3)
+
+
+def test_batch_dot_gradient():
+    check_numeric_gradient(lambda a, b: nd.batch_dot(a, b).sum(),
+                           [_arr(2, 3, 4), _arr(2, 4, 2)], eps=1e-3,
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_fully_connected_gradient():
+    check_numeric_gradient(
+        lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3).sum(),
+        [_arr(2, 5), _arr(3, 5), _arr(3)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_convolution_gradient():
+    check_numeric_gradient(
+        lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3), num_filter=2,
+                                       pad=(1, 1)).sum(),
+        [_arr(1, 2, 5, 5), _arr(2, 2, 3, 3), _arr(2)],
+        eps=1e-3, rtol=3e-2, atol=3e-3)
+
+
+def test_pooling_gradient():
+    check_numeric_gradient(
+        lambda x: nd.Pooling(x, kernel=(2, 2), pool_type="avg",
+                             stride=(2, 2)).sum(),
+        [_arr(1, 2, 4, 4)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_layernorm_gradient():
+    check_numeric_gradient(
+        lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1).square().sum(),
+        [_arr(3, 6), _arr(6, offset=1.0), _arr(6)],
+        eps=1e-3, rtol=3e-2, atol=3e-3)
+
+
+def test_softmax_gradient():
+    w = mx.nd.array(RNG.rand(3, 5).astype("float32"))  # fixed across FD evals
+    check_numeric_gradient(
+        lambda x: (nd.softmax(x, axis=-1) * w).sum(),
+        [_arr(3, 5, scale=2.0)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_log_softmax_gradient():
+    w = mx.nd.array(RNG.rand(2, 4).astype("float32"))
+    check_numeric_gradient(
+        lambda x: (nd.log_softmax(x, axis=-1) * w).sum(),
+        [_arr(2, 4, scale=2.0)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_take_gradient():
+    idx = mx.nd.array(onp.array([0, 2, 1], "float32"))
+    check_numeric_gradient(
+        lambda w: nd.take(w, idx).sum(), [_arr(4, 3)],
+        eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_embedding_gradient():
+    idx = mx.nd.array(onp.array([1, 0, 3], "float32"))
+    check_numeric_gradient(
+        lambda w: nd.Embedding(idx, w, input_dim=4, output_dim=3).sum(),
+        [_arr(4, 3)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_transpose_reshape_slice_gradient():
+    check_numeric_gradient(
+        lambda x: nd.transpose(x, axes=(1, 0)).reshape((2, 6))[0].sum(),
+        [_arr(3, 4)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_concat_gradient():
+    check_numeric_gradient(
+        lambda a, b: nd.concat(a, b, dim=1).square().sum(),
+        [_arr(2, 3), _arr(2, 2)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_where_gradient():
+    cond = mx.nd.array(onp.array([[1., 0.], [0., 1.]], "float32"))
+    check_numeric_gradient(
+        lambda a, b: nd.where(cond, a, b).square().sum(),
+        [_arr(2, 2), _arr(2, 2)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_leaky_relu_gradient():
+    check_numeric_gradient(
+        lambda x: nd.LeakyReLU(x + 2.0, act_type="leaky", slope=0.1).sum(),
+        [_arr(3, 4)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_gelu_gradient():
+    check_numeric_gradient(
+        lambda x: nd.LeakyReLU(x, act_type="gelu").sum(),
+        [_arr(3, 4)], eps=1e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_ctc_loss_gradient():
+    # small CTC: (T, B, C) activations vs short label
+    act = _arr(4, 1, 3, scale=0.5)
+    label = mx.nd.array(onp.array([[1, 2]], "float32"))
+    check_numeric_gradient(
+        lambda a: nd.CTCLoss(a, label).sum(), [act],
+        eps=1e-2, rtol=5e-2, atol=5e-3)

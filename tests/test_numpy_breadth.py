@@ -139,3 +139,113 @@ def test_numpy_dispatch_protocol():
     # positional axis on a sequence-first function
     c = onp.concatenate([x.reshape(1, 3), x.reshape(1, 3)], 1)
     assert c.shape == (1, 6)
+
+
+# ---------------------------------------------------------------------------
+# npx namespace round-3 additions
+# ---------------------------------------------------------------------------
+def test_npx_random_namespace():
+    import mxnet_tpu.numpy_extension as npx
+    npx.random.seed(0)
+    u = npx.random.uniform_n(0.0, 1.0, batch_shape=(4, 3))
+    assert u.shape == (4, 3)
+    n = npx.random.normal_n(5.0, 0.1, batch_shape=(1000,))
+    assert abs(float(n.asnumpy().mean()) - 5.0) < 0.05
+    b = npx.random.bernoulli(prob=0.5, size=(100,))
+    assert set(onp.unique(b.asnumpy())) <= {0.0, 1.0}
+
+
+def test_npx_image_namespace():
+    import mxnet_tpu.numpy_extension as npx
+    img = np.array((onp.random.rand(6, 5, 3) * 255).astype("float32"))
+    t = npx.image.to_tensor(img)
+    assert t.shape == (3, 6, 5)
+    r = npx.image.resize(img, (4, 4))
+    assert r.shape == (4, 4, 3)
+
+
+def test_npx_nonzero_and_constraint():
+    import mxnet_tpu.numpy_extension as npx
+    nz = npx.nonzero(np.array([[0., 1.], [2., 0.]]))
+    assert nz.asnumpy().tolist() == [[0, 1], [1, 0]]
+    assert float(npx.constraint_check(np.array([1., 1.])).asnumpy()) == 1.0
+
+
+def test_npx_gather_scatter_nd():
+    import mxnet_tpu.numpy_extension as npx
+    data = np.array([[1., 2.], [3., 4.]])
+    idx = np.array([[0, 1], [1, 0]]).astype("int32")
+    assert npx.gather_nd(data, idx).asnumpy().tolist() == [2., 3.]
+    scattered = npx.scatter_nd(np.array([2., 3.]), idx, (2, 2))
+    assert scattered.asnumpy().tolist() == [[0., 2.], [3., 0.]]
+
+
+def test_npx_bernoulli_logit_hybridize_safe():
+    # the logit path must stay on-device (trace-safe sigmoid, no asnumpy)
+    import mxnet_tpu.numpy_extension as npx
+    out = np.zeros((2, 10))
+    res = npx.random.bernoulli(logit=np.array([-10.0, 10.0]), size=(10,),
+                               out=out)
+    assert res is out
+    assert out.asnumpy()[0].max() == 0.0 and out.asnumpy()[1].min() == 1.0
+
+
+def test_nd_hypot():
+    import mxnet_tpu as mx
+    a = mx.nd.array(onp.array([3.0])); b = mx.nd.array(onp.array([4.0]))
+    assert float(mx.nd.hypot(a, b).asnumpy()) == 5.0
+
+
+def test_npx_reshape_special_codes():
+    import mxnet_tpu.numpy_extension as npx
+    x = np.zeros((3, 4, 5))
+    assert npx.reshape(x, (-2, -1)).shape == (3, 20)
+    assert npx.reshape(x, (-4,)).shape == (3, 4, 5)
+    assert npx.reshape(x, (-5, -2)).shape == (12, 5)
+    assert npx.reshape(x, (-6, 1, 3, -2, -2)).shape == (1, 3, 4, 5)
+    y = np.zeros((1, 4, 5))
+    assert npx.reshape(y, (-3, -2, -2)).shape == (4, 5)
+    assert npx.reshape(x, (60,)).shape == (60,)
+    import pytest as _pytest
+    with _pytest.raises((ValueError, Exception)):
+        npx.reshape(x, (-2, -2, -2, -2))  # too many dims consumed
+
+
+def test_npx_random_tensor_params():
+    import mxnet_tpu.numpy_extension as npx
+    npx.random.seed(0)
+    low = np.array([0.0, 10.0]); high = np.array([1.0, 20.0])
+    u = npx.random.uniform_n(low, high, batch_shape=(2000,))
+    assert u.shape == (2, 2000)
+    m = u.asnumpy()
+    assert abs(m[0].mean() - 0.5) < 0.05 and abs(m[1].mean() - 15.0) < 0.5
+    n = npx.random.normal_n(np.array([0.0, 5.0]), 1.0, batch_shape=(2000,))
+    assert n.shape == (2, 2000)
+    assert abs(n.asnumpy()[1].mean() - 5.0) < 0.2
+
+
+def test_npx_bernoulli_logit():
+    import mxnet_tpu.numpy_extension as npx
+    npx.random.seed(1)
+    b = npx.random.bernoulli(logit=0.0, size=(4000,))
+    assert abs(float(b.asnumpy().mean()) - 0.5) < 0.04
+    bl = npx.random.bernoulli(logit=np.array([-10.0, 10.0]), size=(50,))
+    assert bl.shape == (2, 50)
+    assert bl.asnumpy()[0].max() == 0.0 and bl.asnumpy()[1].min() == 1.0
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        npx.random.bernoulli(prob=0.5, logit=0.0)
+
+
+def test_prng_impl_validation():
+    import mxnet_tpu.config as config
+    from mxnet_tpu.random import _prng_impl
+    config.set("MXNET_PRNG_IMPL", "threefry")
+    try:
+        assert _prng_impl() == "threefry2x32"
+        config.set("MXNET_PRNG_IMPL", "bogus")
+        import pytest as _pytest
+        with _pytest.raises(Exception):
+            _prng_impl()
+    finally:
+        config.set("MXNET_PRNG_IMPL", "auto")

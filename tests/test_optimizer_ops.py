@@ -216,3 +216,49 @@ def test_signum_and_nag():
                                  lr=0.1, momentum=0.9)
     onp.testing.assert_allclose(nw2.asnumpy(), w - 0.1 * (g + 0.9 * g),
                                 rtol=1e-6)
+
+
+from mxnet_tpu.ops.registry import apply_op  # noqa: E402
+
+
+def test_multi_mp_adamw_matches_single():
+    rng = onp.random.RandomState(0)
+    arrays, singles = [], []
+    for _ in range(3):
+        w32 = rng.randn(5, 4).astype("float32")
+        g = rng.randn(5, 4).astype("float32") * 0.1
+        m = onp.zeros_like(w32); v = onp.zeros_like(w32)
+        arrays += [mx.nd.array(w32.astype("float16")), mx.nd.array(g.astype("float16")),
+                   mx.nd.array(m), mx.nd.array(v), mx.nd.array(w32)]
+        singles.append((w32, g, m, v))
+    outs = apply_op("multi_mp_adamw_update", *arrays,
+                    lrs=(0.1, 0.2, 0.3), etas=(1.0, 1.0, 1.0),
+                    wds=(0.0, 0.01, 0.0), num_weights=3)
+    assert len(outs) == 12
+    for i, (w32, g, m, v) in enumerate(singles):
+        ew, em, ev, ew32 = apply_op(
+            "mp_adamw_update", mx.nd.array(w32.astype("float16")),
+            mx.nd.array(g.astype("float16")), mx.nd.array(m), mx.nd.array(v),
+            mx.nd.array(w32), lr=(0.1, 0.2, 0.3)[i], eta=1.0,
+            wd=(0.0, 0.01, 0.0)[i])
+        for j, single in enumerate([ew, em, ev, ew32]):
+            onp.testing.assert_allclose(outs[4 * i + j].asnumpy(),
+                                        single.asnumpy(), rtol=1e-6)
+
+
+def test_multi_mp_lamb_update_runs_and_descends():
+    rng = onp.random.RandomState(1)
+    w32 = rng.randn(6, 3).astype("float32")
+    g = onp.ones_like(w32) * 0.5
+    m = onp.zeros_like(w32); v = onp.zeros_like(w32)
+    outs = apply_op("multi_mp_lamb_update",
+                    mx.nd.array(w32.astype("float16")), mx.nd.array(g),
+                    mx.nd.array(m), mx.nd.array(v), mx.nd.array(w32),
+                    lrs=(0.01,), wds=(0.0,), num_weights=1, step_count=(1,))
+    assert len(outs) == 4
+    nw32 = outs[3].asnumpy()
+    assert not onp.allclose(nw32, w32)
+    assert onp.isfinite(nw32).all()
+    # fp16 view mirrors the fp32 master
+    onp.testing.assert_allclose(outs[0].asnumpy(), nw32.astype("float16"),
+                                rtol=1e-3)
