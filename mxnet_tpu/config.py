@@ -94,10 +94,10 @@ register("MXNET_USE_SIGNAL_HANDLER", True, bool,
 register("MXNET_SAFE_ACCUMULATION", True, bool,
          "Accumulate reductions over bf16/fp16 inputs in fp32.")
 register("MXNET_PRNG_IMPL", "auto", str,
-         "PRNG generator: threefry2x32 | rbg | auto. auto = rbg on "
-         "accelerators (hardware-friendly; +13% measured BERT pretraining "
-         "throughput vs threefry dropout-bit generation), threefry on CPU "
-         "(bit-reproducible test runs).")
+         "PRNG generator: threefry2x32 (alias: threefry) | rbg | unsafe_rbg "
+         "| auto. auto = rbg on accelerators (hardware-friendly; +13% "
+         "measured BERT pretraining throughput vs threefry dropout-bit "
+         "generation), threefry on CPU (bit-reproducible test runs).")
 register("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", True, bool,
          "Log when a sparse op densifies an operand (executor fallback log).")
 register("MXNET_HOME", os.path.join("~", ".mxnet"), str,

@@ -192,7 +192,7 @@ def _xreshape_infer(src, target):
                 raise ValueError("unmatching dimension of proposed new shape")
             out.append(src[si]); known_prod *= src[si]; si += 1
         elif d == -3:  # skip a size-1 source dimension
-            if src[si] != 1:
+            if si >= len(src) or src[si] != 1:
                 raise ValueError("-3 index should only skip dimension size 1")
             si += 1
         elif d == -4:  # copy all remaining dims

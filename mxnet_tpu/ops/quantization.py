@@ -230,17 +230,28 @@ def quantized_pooling(x, min_x, max_x, **attrs):
 
 @register("_contrib_quantized_act", jit=True, differentiable=False)
 def quantized_act(x, min_x, max_x, *, act_type="relu"):
-    """ReLU on zero-centered int8 codes is a plain max(x, 0)
-    (quantized_activation.cc); ranges pass through (the negative half simply
-    never decodes)."""
+    """ReLU in code space (quantized_activation.cc). Zero-centered int8:
+    max(x, 0), ranges pass through. Affine uint8: real zero sits at code
+    z = -min*255/(max-min); clamp codes below z to z and tighten the carried
+    min to 0 (the decoded value of z)."""
     if act_type != "relu":
         raise ValueError("quantized_act supports act_type='relu' only "
                          f"(got {act_type!r})")
-    if x.dtype != jnp.int8:
-        raise ValueError("quantized_act expects zero-centered int8 codes "
-                         f"(got {x.dtype}); uint8 affine codes need the "
-                         "zero-point form")
-    return jnp.maximum(x, 0).astype(x.dtype), min_x, max_x
+    if x.dtype == jnp.int8:
+        return jnp.maximum(x, 0).astype(x.dtype), min_x, max_x
+    if x.dtype == jnp.uint8:
+        # decode → relu in real space → re-encode under [0, max(max, 0)];
+        # working on real values (not a zero-point shift) keeps the result
+        # exact for any sign of the calibration min
+        mn = jnp.asarray(min_x, jnp.float32).reshape(())
+        mx_ = jnp.asarray(max_x, jnp.float32).reshape(())
+        scale_old = jnp.maximum(mx_ - mn, 1e-12) / 255.0
+        real = jnp.maximum(x.astype(jnp.float32) * scale_old + mn, 0.0)
+        new_max = jnp.maximum(mx_, 0.0)
+        scale_new = jnp.maximum(new_max, 1e-12) / 255.0
+        rq = jnp.clip(jnp.round(real / scale_new), 0, 255)
+        return rq.astype(jnp.uint8), jnp.float32(0.0), new_max
+    raise ValueError(f"quantized_act: unsupported code dtype {x.dtype}")
 
 
 @register("_contrib_quantized_flatten", jit=True, differentiable=False)

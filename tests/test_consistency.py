@@ -1,0 +1,138 @@
+"""Cross-dtype consistency sweep — the reference's check_consistency oracle
+(python/mxnet/test_utils.py:1428, used by tests/python/gpu/test_operator_gpu.py
+to compare the same op across contexts/dtypes). Here the portability axis is
+dtype (fp32 vs bf16 vs fp16 on the same mesh): every op must produce the same
+result within reduced-precision tolerance."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import cpu
+from mxnet_tpu.test_utils import check_consistency
+
+RNG = onp.random.RandomState(7)
+
+# bf16 has ~3 decimal digits; tolerances sized to that
+BF16_RTOL, BF16_ATOL = 3e-2, 3e-2
+
+
+def _consistent(fn, *shapes, positive=False):
+    inputs = [RNG.rand(*s).astype("float32") + (0.5 if positive else -0.5)
+              for s in shapes]
+    check_consistency(fn, inputs, [cpu()],
+                      dtypes=("float32", "bfloat16", "float16"),
+                      rtol=BF16_RTOL, atol=BF16_ATOL)
+
+
+ELEMWISE = [
+    ("relu", lambda x: nd.relu(x)),
+    ("sigmoid", lambda x: nd.sigmoid(x)),
+    ("tanh", lambda x: nd.tanh(x)),
+    ("exp", lambda x: nd.exp(x)),
+    ("sqrt_abs", lambda x: nd.sqrt(nd.abs(x))),
+    ("square", lambda x: nd.square(x)),
+    ("softmax", lambda x: nd.softmax(x, axis=-1)),
+    ("log_softmax_exp", lambda x: nd.exp(nd.log_softmax(x, axis=-1))),
+    ("erf", lambda x: nd.erf(x)),
+    ("gelu", lambda x: nd.LeakyReLU(x, act_type="gelu")),
+]
+
+
+@pytest.mark.parametrize("name,fn", ELEMWISE, ids=[e[0] for e in ELEMWISE])
+def test_elemwise_dtype_consistency(name, fn):
+    _consistent(fn, (4, 6))
+
+
+BINARY = [
+    ("add", lambda a, b: a + b),
+    ("mul", lambda a, b: a * b),
+    ("div", lambda a, b: a / (b + 2.0)),
+    ("maximum", lambda a, b: nd.maximum(a, b)),
+    ("dot", lambda a, b: nd.dot(a, b)),
+]
+
+
+@pytest.mark.parametrize("name,fn", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_dtype_consistency(name, fn):
+    if name == "dot":
+        _consistent(fn, (4, 5), (5, 3))
+    else:
+        _consistent(fn, (4, 6), (4, 6))
+
+
+def test_conv_dtype_consistency():
+    def fn(x, w):
+        return nd.Convolution(x, w, no_bias=True, kernel=(3, 3),
+                              num_filter=4, pad=(1, 1))
+    _consistent(fn, (2, 3, 8, 8), (4, 3, 3, 3))
+
+
+def test_fc_dtype_consistency():
+    def fn(x, w, b):
+        return nd.FullyConnected(x, w, b, num_hidden=4)
+    _consistent(fn, (3, 6), (4, 6), (4,))
+
+
+def test_pooling_dtype_consistency():
+    def fn(x):
+        return nd.Pooling(x, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    _consistent(fn, (2, 3, 8, 8))
+
+
+def test_batchnorm_inference_dtype_consistency():
+    # inference-mode BN (global stats) across dtypes
+    gamma = RNG.rand(3).astype("float32") + 0.5
+    beta = RNG.rand(3).astype("float32")
+    mean = RNG.rand(3).astype("float32")
+    var = RNG.rand(3).astype("float32") + 0.5
+
+    def fn(x):
+        return nd.BatchNorm(x, mx.nd.array(gamma), mx.nd.array(beta),
+                            mx.nd.array(mean), mx.nd.array(var),
+                            use_global_stats=True, fix_gamma=False)
+    _consistent(fn, (2, 3, 5, 5))
+
+
+def test_reduce_dtype_consistency():
+    # reductions accumulate in fp32 (MXNET_SAFE_ACCUMULATION), so even bf16
+    # inputs keep tight sums
+    def fn(x):
+        return nd.sum(x, axis=1)
+    _consistent(fn, (8, 32))
+
+
+def test_layernorm_dtype_consistency():
+    g = RNG.rand(6).astype("float32") + 0.5
+    b = RNG.rand(6).astype("float32")
+
+    def fn(x):
+        return nd.LayerNorm(x, mx.nd.array(g), mx.nd.array(b), axis=-1)
+    _consistent(fn, (4, 6))
+
+
+def test_training_step_dtype_consistency():
+    """A whole fused train step in fp32 vs bf16 compute must land within
+    bf16 tolerance after one update (the check_consistency pattern applied
+    at training-step granularity)."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn as gnn
+
+    results = {}
+    x = RNG.rand(8, 10).astype("float32")
+    y = (onp.arange(8) % 3).astype("float32")
+    for dtype in ("float32", "bfloat16"):
+        mx.random.seed(0)
+        net = gnn.HybridSequential()
+        net.add(gnn.Dense(16, activation="relu"), gnn.Dense(3))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2),
+                       force_reinit=True)
+        net(mx.nd.array(onp.zeros((1, 10), "float32")))
+        mesh = parallel.make_mesh({"dp": -1})
+        step = parallel.ParallelTrainStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            mx.optimizer.SGD(learning_rate=0.1), mesh, compute_dtype=dtype)
+        placed = step.place_batch(x, y)
+        loss = step.step(*placed)
+        results[dtype] = float(loss.asnumpy().mean())
+    assert abs(results["float32"] - results["bfloat16"]) < 0.05, results

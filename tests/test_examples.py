@@ -41,3 +41,24 @@ def test_bert_pretrain_example():
 def test_ssd_example():
     out = _run("examples/ssd/train_ssd.py", "--steps", "2", "--detect")
     assert out.count("loss=") == 2 and "detections kept" in out
+
+
+def test_model_parallel_example():
+    out = _run("examples/model_parallel/train_tp.py", "--steps", "3")
+    assert "params synced back" in out
+
+
+def test_distributed_training_example():
+    # same env hygiene as test_dist_kvstore: plain CPU, no forced device
+    # count, repo-only PYTHONPATH (accelerator plugin paths break the
+    # 2-process gloo bootstrap)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"), "-n", "2",
+         "--launcher", "local", sys.executable,
+         os.path.join(REPO, "examples", "distributed_training",
+                      "train_dist.py"), "--steps", "2"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "[worker 0] done" in r.stdout and "[worker 1] done" in r.stdout

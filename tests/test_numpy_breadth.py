@@ -249,3 +249,9 @@ def test_prng_impl_validation():
             _prng_impl()
     finally:
         config.set("MXNET_PRNG_IMPL", "auto")
+
+
+def test_npx_reshape_minus3_out_of_dims():
+    import mxnet_tpu.numpy_extension as npx
+    with pytest.raises(ValueError):
+        npx.reshape(np.zeros((2,)), (-2, -3))

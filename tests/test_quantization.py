@@ -359,3 +359,27 @@ def test_quantized_embedding():
     assert qe.shape == (3, 4) and qe.asnumpy().dtype == onp.int8
     deq = apply_op("_contrib_dequantize", qe, emn, emx).asnumpy()
     assert abs(deq - w[[1, 3, 7]]).max() < 1.5 / 127
+
+
+def test_quantized_act_uint8_affine():
+    rng = onp.random.RandomState(4)
+    x = rng.uniform(-2, 3, (32,)).astype("float32")
+    q, mn, mxr = apply_op("_contrib_quantize", mx.nd.array(x),
+                          mx.nd.array(onp.array([-2.], "float32")),
+                          mx.nd.array(onp.array([3.], "float32")))
+    qo, omn, omx = apply_op("_contrib_quantized_act", q, mn, mxr)
+    assert qo.asnumpy().dtype == onp.uint8
+    assert float(omn.asnumpy()) == 0.0
+    deq = apply_op("_contrib_dequantize", qo, omn, omx).asnumpy()
+    assert abs(deq - onp.maximum(x, 0)).max() < 2 * 5.0 / 255
+
+
+def test_quantized_act_uint8_positive_min():
+    # post-ReLU activation ranges have min > 0: relu must stay identity
+    x = onp.linspace(1.0, 3.0, 16).astype("float32")
+    q, mn, mxr = apply_op("_contrib_quantize", mx.nd.array(x),
+                          mx.nd.array(onp.array([1.], "float32")),
+                          mx.nd.array(onp.array([3.], "float32")))
+    qo, omn, omx = apply_op("_contrib_quantized_act", q, mn, mxr)
+    deq = apply_op("_contrib_dequantize", qo, omn, omx).asnumpy()
+    assert abs(deq - x).max() < 2 * 3.0 / 255
