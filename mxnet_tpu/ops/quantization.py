@@ -231,9 +231,10 @@ def quantized_pooling(x, min_x, max_x, **attrs):
 @register("_contrib_quantized_act", jit=True, differentiable=False)
 def quantized_act(x, min_x, max_x, *, act_type="relu"):
     """ReLU in code space (quantized_activation.cc). Zero-centered int8:
-    max(x, 0), ranges pass through. Affine uint8: real zero sits at code
-    z = -min*255/(max-min); clamp codes below z to z and tighten the carried
-    min to 0 (the decoded value of z)."""
+    max(x, 0), ranges pass through. Affine uint8: decode to real values,
+    relu, and REQUANTIZE onto the tightened [0, max(max, 0)] grid — a full
+    re-encode (one extra rounding step of the new grid), not a zero-point
+    clamp, so it is correct for any sign of the calibration min."""
     if act_type != "relu":
         raise ValueError("quantized_act supports act_type='relu' only "
                          f"(got {act_type!r})")

@@ -313,3 +313,60 @@ def test_engine_stats_counters():
     assert s["pushed"] == 6 and s["completed"] == 6 and s["pending"] == 0
     assert s["pools"] >= 2  # copy lane spun up its own pool
     eng.close()
+
+
+def test_cpp_unit_suite_from_clean_build(tmp_path):
+    """The native C++ unit-test binary (parity: tests/cpp/ gtest tier —
+    engine ordering/race/exception invariants + recordio round-trip) builds
+    against the shared library and passes."""
+    import subprocess
+    native_dir = os.path.join(os.path.dirname(native.__file__))
+    src = os.path.join(native_dir, "tests", "native_unit_test.cc")
+    exe = str(tmp_path / "native_unit_test")
+    r = subprocess.run(
+        ["g++", "-O2", "-std=c++17", src, "-o", exe, f"-L{native_dir}",
+         "-lmxtpu_native", f"-Wl,-rpath,{native_dir}"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run([exe, str(tmp_path)], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "ALL NATIVE UNIT TESTS PASSED" in r.stdout
+
+
+def test_hybridized_forward_thread_safety():
+    """Concurrent forwards through ONE hybridized block from many threads
+    (parity: tests/cpp/thread_safety/thread_safety_test.cc over
+    cached_op_threadsafe.cc): results must match the single-threaded
+    output bit-for-bit and no error may escape."""
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    xs = [mx.nd.array(onp.random.RandomState(i).rand(4, 16).astype("float32"))
+          for i in range(8)]
+    want = [net(x).asnumpy() for x in xs]  # also triggers the trace once
+
+    results = [[None] * len(xs) for _ in range(4)]
+    errors = []
+
+    def worker(tid):
+        try:
+            for j, x in enumerate(xs):
+                results[tid][j] = net(x).asnumpy()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    for tid in range(4):
+        for j in range(len(xs)):
+            onp.testing.assert_array_equal(results[tid][j], want[j])
