@@ -137,31 +137,41 @@ int test_read_concurrency(void* e) {
   return 0;
 }
 
+struct StampCtx {
+  std::atomic<int>* clock;
+  std::atomic<int>* stamp;  // this op's completion order
+};
+
+void stamp_op(void* arg) {
+  StampCtx* c = static_cast<StampCtx*>(arg);
+  c->stamp->store(++*c->clock);
+}
+
 int test_diamond_dependencies(void* e) {
   //    a
-  //   / \       b,c read a's var; d reads b's and c's vars.
-  //  b   c      Order must be a < b, a < c, b < d, c < d.
-  //   \ /
-  //    d
-  std::vector<int> log;
-  std::atomic<int> running{0}, max_running{0};
+  //   / \       b,c read a's var (may run CONCURRENTLY); d reads b's and
+  //  b   c      c's vars. Order must be a < b, a < c, b < d, c < d —
+  //   \ /       each op gets its own atomic stamp slot, since b and c are
+  //    d        legitimately unordered relative to each other.
+  std::atomic<int> clock{0};
+  std::atomic<int> sa{0}, sb{0}, sc{0}, sd{0};
   int64_t va = mxtpu_engine_new_var(e);
   int64_t vb = mxtpu_engine_new_var(e);
   int64_t vc = mxtpu_engine_new_var(e);
   int64_t vd = mxtpu_engine_new_var(e);
-  AppendCtx a{&log, &running, &max_running, 0, 30};
-  AppendCtx b{&log, &running, &max_running, 1, 10};
-  AppendCtx c{&log, &running, &max_running, 2, 10};
-  AppendCtx d{&log, &running, &max_running, 3, 1};
-  mxtpu_engine_push(e, append_op, &a, nullptr, 0, &va, 1);
-  mxtpu_engine_push(e, append_op, &b, &va, 1, &vb, 1);
-  mxtpu_engine_push(e, append_op, &c, &va, 1, &vc, 1);
+  StampCtx a{&clock, &sa}, b{&clock, &sb}, c{&clock, &sc}, d{&clock, &sd};
+  mxtpu_engine_push(e, stamp_op, &a, nullptr, 0, &va, 1);
+  mxtpu_engine_push(e, stamp_op, &b, &va, 1, &vb, 1);
+  mxtpu_engine_push(e, stamp_op, &c, &va, 1, &vc, 1);
   int64_t bc[2] = {vb, vc};
-  mxtpu_engine_push(e, append_op, &d, bc, 2, &vd, 1);
+  mxtpu_engine_push(e, stamp_op, &d, bc, 2, &vd, 1);
   mxtpu_engine_wait_for_var(e, vd);
-  CHECK_MSG(log.size() == 4, "diamond: all four ops ran");
-  CHECK_MSG(log[0] == 0, "diamond: a first");
-  CHECK_MSG(log[3] == 3, "diamond: d last");
+  CHECK_MSG(sa.load() && sb.load() && sc.load() && sd.load(),
+            "diamond: all four ops ran");
+  CHECK_MSG(sa.load() < sb.load() && sa.load() < sc.load(),
+            "diamond: a before b and c");
+  CHECK_MSG(sd.load() > sb.load() && sd.load() > sc.load(),
+            "diamond: d after b and c");
   return 0;
 }
 

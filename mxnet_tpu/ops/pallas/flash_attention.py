@@ -24,73 +24,138 @@ import jax.numpy as jnp
 
 from ..registry import register
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# defaults from an on-chip v5e sweep (S=4096, D=64, causal): 512/1024 runs
+# ~30% faster than 128/128 (fewer grid steps, larger MXU ops) and ~10-25%
+# faster than jax.experimental.pallas.ops.tpu.flash_attention at the same
+# shapes; both clamp to S for short sequences
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 _LANES = 128  # TPU lane width; lse is broadcast across it for layout legality
 
 
-def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
-                          causal, block_k, seq_len):
+def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                          m_scr, l_scr, acc_scr, *, sm_scale, causal,
+                          block_k, seq_len, num_k):
+    """One (q-block, k-block) grid step. The k axis is the innermost grid
+    dimension: K/V blocks stream through VMEM with pallas's automatic
+    double-buffered pipelining while the online-softmax state (m, l, acc)
+    persists in VMEM scratch across the k sweep. This keeps VMEM usage
+    O(block) — independent of S — and overlaps the K/V HBM loads with the
+    MXU work (the jax.experimental.pallas.ops.tpu.flash_attention design)."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # (Bq, D)
-    block_q = q.shape[0]
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    block_q = q_ref.shape[1]
     q_offset = qi * block_q
+    k_offset = ki * block_k
 
-    num_k = pl.cdiv(seq_len, block_k)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip k blocks lying fully in the pad region (S padded to a block
+    # multiple of max(bq, bk) can add WHOLE k-blocks when bq > bk), and —
+    # causal — blocks strictly above the diagonal
+    work = k_offset < seq_len
     if causal:
-        # only blocks at or before the diagonal contribute
-        num_k = jnp.minimum(num_k, (q_offset + block_q + block_k - 1) // block_k)
+        work &= k_offset <= q_offset + block_q - 1
 
-    def body(ki, carry):
-        m_acc, l_acc, o_acc = carry
-        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    def _do_block(mask_causal, mask_tail):
+        # dots stay in the input dtype (bf16 MXU-native) with fp32
+        # accumulation — casting operands to fp32 first would run the MXU at
+        # its 8x-slower fp32 rate
+        q = q_ref[0]                                      # (Bq, D)
+        k_blk = k_ref[0]                                  # (Bk, D)
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 1)
-        valid = cols < seq_len          # mask the padded K tail
-        if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 0)
-            valid &= rows >= cols
-        s = jnp.where(valid, s, _NEG_INF)
+                                preferred_element_type=jnp.float32) * sm_scale
+        if mask_causal or mask_tail:
+            cols = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = cols < seq_len if mask_tail else None
+            if mask_causal:
+                rows = q_offset + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                causal_ok = rows >= cols
+                valid = causal_ok if valid is None else (valid & causal_ok)
+            s = jnp.where(valid, s, _NEG_INF)
+        m_acc = m_scr[:, 0]
+        l_acc = l_scr[:, 0]
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_acc, m_blk)
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_acc - m_new)
         l_new = l_acc * alpha + jnp.sum(p, axis=1)
-        o_new = o_acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, o_new
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
-    D = q_ref.shape[-1]
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    o0 = jnp.zeros((block_q, D), jnp.float32)
-    m_f, l_f, o_f = jax.lax.fori_loop(0, num_k, body, (m0, l0, o0))
-    l_safe = jnp.maximum(l_f, 1e-30)
-    o_ref[0] = (o_f / l_safe[:, None]).astype(o_ref.dtype)
-    # per-row scalar broadcast across the 128-lane axis: TPU tiling requires
-    # the last two block dims be (8k, 128)-aligned, so a (bq,)-shaped output
-    # is not representable (same layout as pallas.ops.tpu.flash_attention's
-    # l/m residuals)
-    lse = m_f + jnp.log(l_safe)
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
+    # interior blocks skip the iota/where VPU cost: only the causal diagonal
+    # band and (statically, when S was padded) the last K block pay for masks
+    has_tail = seq_len % block_k != 0
+    if causal:
+        # a k block is fully below the diagonal iff its last col <= first row
+        on_diag = k_offset + block_k - 1 > q_offset
+
+        @pl.when(work & on_diag)
+        def _diag():
+            _do_block(True, has_tail)
+
+        if has_tail:
+            is_tail_blk = k_offset + block_k > seq_len
+
+            @pl.when(work & jnp.logical_not(on_diag) & is_tail_blk)
+            def _tail_only():
+                _do_block(False, True)
+
+            @pl.when(work & jnp.logical_not(on_diag) &
+                     jnp.logical_not(is_tail_blk))
+            def _interior():
+                _do_block(False, False)
+        else:
+            @pl.when(work & jnp.logical_not(on_diag))
+            def _interior():
+                _do_block(False, False)
+    elif has_tail:
+        is_tail_blk = k_offset + block_k > seq_len
+
+        @pl.when(work & is_tail_blk)
+        def _tail():
+            _do_block(False, True)
+
+        @pl.when(work & jnp.logical_not(is_tail_blk))
+        def _interior():
+            _do_block(False, False)
+    else:
+        @pl.when(work)
+        def _all():
+            _do_block(False, False)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # per-row scalar broadcast across the 128-lane axis: TPU tiling
+        # requires the last two block dims be (8k, 128)-aligned, so a
+        # (bq,)-shaped output is not representable (same layout as
+        # pallas.ops.tpu.flash_attention's l/m residuals)
+        lse = m_scr[:, 0] + jnp.log(l_safe)
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, _LANES))
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, D = q.shape
     bq = min(block_q, S)
     bk = min(block_k, S)
-    # pad S to a block multiple: pl.ds clamps out-of-range starts (silently
-    # re-reading earlier rows), so the kernel must never index past the buffer
     Sp = -(-S // max(bq, bk)) * max(bq, bk)
     if Sp != S:
         pad = [(0, 0), (0, 0), (0, Sp - S), (0, 0)]
@@ -100,24 +165,32 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     qr = q.reshape(B * H, Sp, D)
     kr = k.reshape(B * H, Sp, D)
     vr = v.reshape(B * H, Sp, D)
-    grid = (B * H, pl.cdiv(Sp, bq))
+    num_k = pl.cdiv(Sp, bk)
+    grid = (B * H, pl.cdiv(Sp, bq), num_k)
     kernel = functools.partial(_attention_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=bk, seq_len=S)
+                               causal=causal, block_k=bk, seq_len=S,
+                               num_k=num_k)
+    scratch = pltpu.VMEM
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sp, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sp, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Sp, D), q.dtype),
             jax.ShapeDtypeStruct((B * H, Sp, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            scratch((bq, _LANES), jnp.float32),   # running max (lane-bcast)
+            scratch((bq, _LANES), jnp.float32),   # running sum
+            scratch((bq, D), jnp.float32),        # output accumulator
         ],
         interpret=interpret,
     )(qr, kr, vr)

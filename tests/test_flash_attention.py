@@ -61,3 +61,19 @@ def test_flash_uneven_seq():
     ref = _dense(q, k, v)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_whole_padded_k_blocks(causal):
+    """block_q > block_k pads S to a block_q multiple, creating ENTIRE
+    k-blocks of padding; they must not leak into the softmax (regression:
+    the has_tail check once only caught partial tail blocks)."""
+    rng = onp.random.RandomState(0)
+    B, H, S, D = 1, 2, 640, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    out = flash_attention(q, k, v, causal=causal, block_q=512, block_k=128)
+    ref = _dense(q, k, v, causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-3, atol=2e-3)
