@@ -200,7 +200,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _dense_bwd(q, k, v, out, lse, g, sm_scale, causal):
-    """Recompute-style backward with XLA einsums (fp32 accumulation)."""
+    """Recompute-style backward with XLA einsums (fp32 accumulation).
+    Materializes the (S, S) score matrix — fine for short sequences."""
     q32 = q.astype(jnp.float32)
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
@@ -220,6 +221,71 @@ def _dense_bwd(q, k, v, out, lse, g, sm_scale, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+# past this sequence length the backward switches to the blockwise scan:
+# the dense recompute's (B, H, S, S) fp32 score tensor at S=4096, B·H=48
+# would already be 3.2 GB of HBM
+_BWD_BLOCKWISE_MIN_S = 1024
+
+
+def _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal, block):
+    """O(S·D)-memory flash backward: lax.scan over q-blocks recomputing
+    (block, S) score strips — never the full (S, S) matrix. Each strip's
+    work is two bf16 MXU matmuls + the ds strip, so XLA keeps the MXU busy
+    while HBM holds only O(S·D) tensors (the flash-attention backward
+    recipe, scan-structured instead of a hand-written Pallas kernel)."""
+    B, H, S, D = q.shape
+    blk = min(block, S)
+    nb = -(-S // blk)
+    Sp = nb * blk
+    if Sp != S:
+        pad = [(0, 0), (0, 0), (0, Sp - S), (0, 0)]
+        # zero-padding g is what neutralizes the pad rows: every pad-row
+        # contribution (dv via p·g, ds via p·(dp-delta)) carries a factor of
+        # g = 0, and the pad rows of dq are sliced away below. The lse pad
+        # value is arbitrary — any finite constant works.
+        q, out, g = (jnp.pad(x, pad) for x in (q, out, g))
+        lse = jnp.pad(lse, [(0, 0), (0, 0), (0, Sp - S)],
+                      constant_values=1.0)
+    cols = jnp.arange(S)
+    # matmul operands stay in the input dtype (bf16 MXU rate) with fp32
+    # accumulation via preferred_element_type; only the softmax/ds
+    # elementwise math runs fp32
+    ein = functools.partial(jnp.einsum, preferred_element_type=jnp.float32)
+
+    def one_block(i):
+        qi = jax.lax.dynamic_slice_in_dim(q, i * blk, blk, axis=2)
+        gi = jax.lax.dynamic_slice_in_dim(g, i * blk, blk, axis=2)
+        oi = jax.lax.dynamic_slice_in_dim(out, i * blk, blk, axis=2)
+        li = jax.lax.dynamic_slice_in_dim(lse, i * blk, blk, axis=2)
+        s = ein("bhqd,bhkd->bhqk", qi, k) * sm_scale       # (B,H,blk,S) f32
+        rows = i * blk + jnp.arange(blk)
+        if causal:
+            valid = rows[:, None] >= cols[None, :]
+            s = jnp.where(valid[None, None], s, _NEG_INF)
+        p = jnp.exp(s - li[..., None])
+        p_lo = p.astype(q.dtype)
+        dv_i = ein("bhqk,bhqd->bhkd", p_lo, gi)
+        dp = ein("bhqd,bhkd->bhqk", gi, v)
+        delta = jnp.sum(gi.astype(jnp.float32) * oi.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_i = ein("bhqk,bhkd->bhqd", ds, k)
+        dk_i = ein("bhqk,bhqd->bhkd", ds, qi)
+        return dq_i, dk_i, dv_i
+
+    def body(carry, i):
+        dk_acc, dv_acc = carry
+        dq_i, dk_i, dv_i = one_block(i)
+        return (dk_acc + dk_i, dv_acc + dv_i), dq_i
+
+    f32 = jnp.float32
+    (dk, dv), dq_blocks = jax.lax.scan(
+        body, (jnp.zeros(k.shape, f32), jnp.zeros(v.shape, f32)),
+        jnp.arange(nb))
+    dq = jnp.moveaxis(dq_blocks, 0, 2).reshape(B, H, Sp, D)[:, :, :S]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
@@ -233,6 +299,9 @@ def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
+    if q.shape[2] > _BWD_BLOCKWISE_MIN_S:
+        return _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal,
+                              block_q)
     return _dense_bwd(q, k, v, out, lse, g, sm_scale, causal)
 
 

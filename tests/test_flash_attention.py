@@ -5,6 +5,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+import mxnet_tpu as mx
 from mxnet_tpu.ops.pallas.flash_attention import flash_attention
 
 
@@ -77,3 +78,39 @@ def test_flash_attention_whole_padded_k_blocks(causal):
     ref = _dense(q, k, v, causal)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_backward_matches_dense(causal):
+    """The O(S·D) blockwise backward (used past _BWD_BLOCKWISE_MIN_S) must
+    produce the same gradients as the dense recompute, incl. a non-multiple
+    S that exercises the q padding."""
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    rng = onp.random.RandomState(2)
+    B, H, S, D = 1, 2, 1300, 32  # S > 1024 threshold, not a block multiple
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    g = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    out, lse = fa._flash_fwd(q, k, v, 1.0 / 8, causal, 256, 256, True)
+    want = fa._dense_bwd(q, k, v, out, lse, g, 1.0 / 8, causal)
+    got = fa._blockwise_bwd(q, k, v, out, lse, g, 1.0 / 8, causal, 512)
+    for w, gt, name in zip(want, got, "q k v".split()):
+        onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
+                                    rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_long_seq_gradient_through_op():
+    """End-to-end autograd through the op at S past the blockwise threshold."""
+    rng = onp.random.RandomState(3)
+    B, H, S, D = 1, 1, 1100, 32
+    x = mx.nd.array(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    k = mx.nd.array(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    v = mx.nd.array(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.flash_attention(x, k, v, causal=True)
+        loss = (out * out).sum()
+    loss.backward()
+    gradn = x.grad.asnumpy()
+    assert onp.isfinite(gradn).all() and onp.abs(gradn).max() > 0
