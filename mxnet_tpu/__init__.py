@@ -55,6 +55,8 @@ from . import symbol as sym
 from . import subgraph
 from . import module
 from . import module as mod
+from . import model
+from . import name
 from . import contrib
 from .util import np_shape, np_array, is_np_array, set_np, reset_np
 from . import numpy as np

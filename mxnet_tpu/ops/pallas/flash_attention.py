@@ -34,6 +34,74 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU lane width; lse is broadcast across it for layout legality
 
 
+def _masked_scores(q, k_blk, sm_scale, mask_causal, mask_tail, q_offset,
+                   k_offset, block_q, block_k, seq_len):
+    """q @ k^T * scale with the causal/padded-tail masks this block class
+    needs. Dots stay in the input dtype (bf16 MXU-native) with fp32
+    accumulation — casting operands to fp32 first would run the MXU at its
+    8x-slower fp32 rate. Shared by the forward and both backward kernels so
+    the masking logic exists exactly once."""
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    if mask_causal or mask_tail:
+        cols = k_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < seq_len if mask_tail else None
+        if mask_causal:
+            rows = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            causal_ok = rows >= cols
+            valid = causal_ok if valid is None else (valid & causal_ok)
+        s = jnp.where(valid, s, _NEG_INF)
+    return s
+
+
+def _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
+                   seq_len, do):
+    """Run ``do(mask_causal, mask_tail)`` under the cheapest masks for this
+    block class: interior blocks skip the iota/where VPU cost entirely; only
+    the causal diagonal band and (statically, when S was padded) the last
+    partial K block pay for masks."""
+    has_tail = seq_len % block_k != 0
+    if causal:
+        # a k block is fully below the diagonal iff its last col <= first row
+        on_diag = k_offset + block_k - 1 > q_offset
+
+        @pl.when(work & on_diag)
+        def _diag():
+            do(True, has_tail)
+
+        if has_tail:
+            is_tail_blk = k_offset + block_k > seq_len
+
+            @pl.when(work & jnp.logical_not(on_diag) & is_tail_blk)
+            def _tail_only():
+                do(False, True)
+
+            @pl.when(work & jnp.logical_not(on_diag) &
+                     jnp.logical_not(is_tail_blk))
+            def _interior():
+                do(False, False)
+        else:
+            @pl.when(work & jnp.logical_not(on_diag))
+            def _interior():
+                do(False, False)
+    elif has_tail:
+        is_tail_blk = k_offset + block_k > seq_len
+
+        @pl.when(work & is_tail_blk)
+        def _tail():
+            do(False, True)
+
+        @pl.when(work & jnp.logical_not(is_tail_blk))
+        def _interior():
+            do(False, False)
+    else:
+        @pl.when(work)
+        def _all():
+            do(False, False)
+
+
 def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                           m_scr, l_scr, acc_scr, *, sm_scale, causal,
                           block_k, seq_len, num_k):
@@ -65,24 +133,11 @@ def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         work &= k_offset <= q_offset + block_q - 1
 
     def _do_block(mask_causal, mask_tail):
-        # dots stay in the input dtype (bf16 MXU-native) with fp32
-        # accumulation — casting operands to fp32 first would run the MXU at
-        # its 8x-slower fp32 rate
         q = q_ref[0]                                      # (Bq, D)
         k_blk = k_ref[0]                                  # (Bk, D)
         v_blk = v_ref[0]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * sm_scale
-        if mask_causal or mask_tail:
-            cols = k_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = cols < seq_len if mask_tail else None
-            if mask_causal:
-                rows = q_offset + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0)
-                causal_ok = rows >= cols
-                valid = causal_ok if valid is None else (valid & causal_ok)
-            s = jnp.where(valid, s, _NEG_INF)
+        s = _masked_scores(q, k_blk, sm_scale, mask_causal, mask_tail,
+                           q_offset, k_offset, block_q, block_k, seq_len)
         m_acc = m_scr[:, 0]
         l_acc = l_scr[:, 0]
         m_blk = jnp.max(s, axis=1)
@@ -96,46 +151,8 @@ def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
-    # interior blocks skip the iota/where VPU cost: only the causal diagonal
-    # band and (statically, when S was padded) the last K block pay for masks
-    has_tail = seq_len % block_k != 0
-    if causal:
-        # a k block is fully below the diagonal iff its last col <= first row
-        on_diag = k_offset + block_k - 1 > q_offset
-
-        @pl.when(work & on_diag)
-        def _diag():
-            _do_block(True, has_tail)
-
-        if has_tail:
-            is_tail_blk = k_offset + block_k > seq_len
-
-            @pl.when(work & jnp.logical_not(on_diag) & is_tail_blk)
-            def _tail_only():
-                _do_block(False, True)
-
-            @pl.when(work & jnp.logical_not(on_diag) &
-                     jnp.logical_not(is_tail_blk))
-            def _interior():
-                _do_block(False, False)
-        else:
-            @pl.when(work & jnp.logical_not(on_diag))
-            def _interior():
-                _do_block(False, False)
-    elif has_tail:
-        is_tail_blk = k_offset + block_k > seq_len
-
-        @pl.when(work & is_tail_blk)
-        def _tail():
-            _do_block(False, True)
-
-        @pl.when(work & jnp.logical_not(is_tail_blk))
-        def _interior():
-            _do_block(False, False)
-    else:
-        @pl.when(work)
-        def _all():
-            _do_block(False, False)
+    _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
+                   seq_len, _do_block)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -221,10 +238,182 @@ def _dense_bwd(q, k, v, out, lse, g, sm_scale, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-# past this sequence length the backward switches to the blockwise scan:
-# the dense recompute's (B, H, S, S) fp32 score tensor at S=4096, B·H=48
-# would already be 3.2 GB of HBM
+# past this sequence length the backward switches away from the dense
+# recompute: its (B, H, S, S) fp32 score tensor at S=4096, B·H=48 would
+# already be 3.2 GB of HBM
 _BWD_BLOCKWISE_MIN_S = 1024
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, sm_scale, causal, block_k, seq_len, num_k):
+    """dq = sum_j ds_ij @ K_j, streamed over k blocks (innermost grid dim)
+    with the accumulator in VMEM scratch — same structure as the forward."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    q_offset = qi * block_q
+    k_offset = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip wholly-pad k blocks, wholly-pad q blocks (their dq is sliced
+    # away), and — causal — k blocks strictly above the diagonal
+    work = (k_offset < seq_len) & (q_offset < seq_len)
+    if causal:
+        work &= k_offset <= q_offset + block_q - 1
+
+    def _do(mask_causal, mask_tail):
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
+        g = g_ref[0]
+        s = _masked_scores(q_ref[0], k_blk, sm_scale, mask_causal, mask_tail,
+                           q_offset, k_offset, block_q, block_k, seq_len)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, :, 0][:, None]) * sm_scale).astype(
+            k_blk.dtype)
+        acc_scr[...] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
+                   seq_len, _do)
+
+    @pl.when(ki == num_k - 1)
+    def _fin():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, seq_len, num_q):
+    """dk/dv for one k block, streamed over q blocks (innermost grid dim):
+    dv = sum_i P_ij^T @ G_i, dk = sum_i dS_ij^T @ Q_i."""
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    block_k = k_ref.shape[1]
+    k_offset = ki * block_k
+    q_offset = qi * block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # skip wholly-pad q steps, wholly-pad k blocks (their dk/dv rows are
+    # sliced away), and — causal — q blocks strictly above the diagonal
+    work = (q_offset < seq_len) & (k_offset < seq_len)
+    if causal:
+        work &= q_offset + block_q - 1 >= k_offset
+
+    def _do(mask_causal, mask_tail):
+        q = q_ref[0]
+        v_blk = v_ref[0]
+        g = g_ref[0]
+        s = _masked_scores(q, k_ref[0], sm_scale, mask_causal, mask_tail,
+                           q_offset, k_offset, block_q, block_k, seq_len)
+        p = jnp.exp(s - lse_ref[0, :, 0][:, None])
+        p_lo = p.astype(g.dtype)
+        dv_scr[...] += jax.lax.dot_general(
+            p_lo, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, :, 0][:, None]) * sm_scale).astype(
+            q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
+                   seq_len, _do)
+
+    @pl.when(qi == num_q - 1)
+    def _fin():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
+                interpret):
+    """Pallas flash backward: dq via a (bh, q, k) grid, dk/dv via a
+    (bh, k, q) grid — score strips never leave VMEM (the HBM-bound step of
+    the scan-based blockwise backward)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    Sp = -(-S // max(bq, bk)) * max(bq, bk)
+    if Sp != S:
+        pad = [(0, 0), (0, 0), (0, Sp - S), (0, 0)]
+        q, k, v, out, g = (jnp.pad(x, pad) for x in (q, k, v, out, g))
+        lse = jnp.pad(lse, [(0, 0), (0, 0), (0, Sp - S)])
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    BH = B * H
+    qr, kr, vr, gr = (x.reshape(BH, Sp, D) for x in (q, k, v, g))
+    # lane-broadcast the per-row scalars (same layout rule as the fwd lse)
+    lse_b = jnp.broadcast_to(lse.reshape(BH, Sp)[..., None], (BH, Sp, _LANES))
+    delta_b = jnp.broadcast_to(delta.reshape(BH, Sp)[..., None],
+                               (BH, Sp, _LANES))
+    nq = pl.cdiv(Sp, bq)
+    nk = pl.cdiv(Sp, bk)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=bk, seq_len=S, num_k=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_b, delta_b)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, seq_len=S, num_q=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sp, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sp, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse_b, delta_b)
+
+    dq = dq.reshape(B, H, Sp, D)[:, :, :S]
+    dk = dk.reshape(B, H, Sp, D)[:, :, :S]
+    dv = dv.reshape(B, H, Sp, D)[:, :, :S]
+    return dq, dk, dv
 
 
 def _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal, block):
@@ -300,8 +489,13 @@ def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     if q.shape[2] > _BWD_BLOCKWISE_MIN_S:
-        return _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal,
-                              block_q)
+        if interpret:
+            # non-TPU backends: the XLA scan backward — same O(S·D) memory,
+            # but orders of magnitude faster than the Pallas interpreter
+            return _blockwise_bwd(q, k, v, out, lse, g, sm_scale, causal,
+                                  block_q)
+        return _pallas_bwd(q, k, v, out, lse, g, sm_scale, causal,
+                           block_q, block_k, interpret)
     return _dense_bwd(q, k, v, out, lse, g, sm_scale, causal)
 
 

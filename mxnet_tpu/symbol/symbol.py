@@ -36,6 +36,10 @@ _name_counts: Dict[str, int] = {}
 
 
 def _auto_name(hint: str) -> str:
+    from ..name import NameManager
+    mgr = NameManager.current()
+    if mgr is not None:
+        return mgr.get(None, hint)
     with _name_lock:
         n = _name_counts.get(hint, 0)
         _name_counts[hint] = n + 1

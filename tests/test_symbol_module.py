@@ -201,3 +201,34 @@ def test_print_summary_symbol(capsys):
     assert total == (8 * 16 + 16) + (16 * 4 + 4)
     out = capsys.readouterr().out
     assert "fc1 (FullyConnected)" in out and "2x16" in out
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    """mx.model.save_checkpoint / load_checkpoint (model.py:403/:452)."""
+    d = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(d, num_hidden=3, name="fc")
+    arg = {"fc_weight": mx.nd.array(onp.ones((3, 4), "float32")),
+           "fc_bias": mx.nd.array(onp.zeros(3, "float32"))}
+    aux = {}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 7, net, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert sorted(arg2) == ["fc_bias", "fc_weight"] and aux2 == {}
+    onp.testing.assert_allclose(arg2["fc_weight"].asnumpy(),
+                                onp.ones((3, 4)))
+    assert sym2 is not None
+
+
+def test_name_manager_and_prefix():
+    """mx.name.NameManager / Prefix control symbol auto-naming (name.py)."""
+    with mx.name.Prefix("mynet_"):
+        s = mx.sym.exp(mx.sym.Variable("x"))
+    assert s.name.startswith("mynet_"), s.name
+    mgr = mx.name.NameManager()
+    with mgr:
+        a = mx.sym.exp(mx.sym.Variable("y"))
+        b = mx.sym.exp(mx.sym.Variable("z"))
+    # fresh manager restarts hint counters: two distinct generated names
+    assert a.name != b.name
+    assert mx.name.NameManager.current() is None or \
+        mx.name.NameManager.current() is not mgr
