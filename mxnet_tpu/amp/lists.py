@@ -64,7 +64,7 @@ WIDEST_TYPE_CASTS = [
     "broadcast_to", "broadcast_axis", "broadcast_like", "reshape_like",
     "split", "split_v2", "slice", "slice_axis", "slice_like", "pad", "tile",
     "repeat", "reverse", "depth_to_space", "space_to_depth",
-    "diag", "take", "batch_take", "pick", "gather_nd", "scatter_nd",
+    "diag", "take", "batch_take", "take_along_axis", "pick", "gather_nd", "scatter_nd",
     "index_add", "index_copy", "slice_assign", "slice_assign_scalar",
     "sequence_mask", "sequence_last", "sequence_reverse",
     "boolean_mask_dense", "sort", "max", "min", "identity",

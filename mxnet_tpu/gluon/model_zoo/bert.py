@@ -151,9 +151,23 @@ class BERTForPretraining(HybridBlock):
             self.mlm_ln = LayerNorm(in_channels=backbone._units)
             self.nsp = Dense(2, flatten=False, in_units=backbone._units)
 
-    def forward(self, tokens, token_types=None, valid_mask=None):
+    def forward(self, tokens, token_types=None, valid_mask=None,
+                masked_positions=None):
+        """With ``masked_positions`` (B, P) the MLM transform + vocab decoder
+        run ONLY at those positions — (B, P, V) logits instead of
+        (B, S, V). At the standard ~15% masking rate (P=19 of 128) this
+        cuts the vocab-matmul (the largest single matmul in the step)
+        ~6.7×; the dense path stays for full-sequence scoring."""
         F = _F()
         seq, pooled = self.backbone(tokens, token_types, valid_mask)
+        if masked_positions is not None:
+            # gather as a one-hot batched matmul: XLA lowers a plain gather
+            # (and its scatter-add backward) to slow non-MXU custom fusions
+            # (~27% of the step measured); (B,P,S)@(B,S,U) rides the MXU and
+            # its backward is just the transposed matmul
+            S = seq.shape[1]
+            onehot = F.one_hot(masked_positions, depth=S).astype(seq.dtype)
+            seq = F.batch_dot(onehot, seq)                 # (B, P, U)
         h = self.mlm_ln(F.gelu(self.mlm_transform(seq)))
         embed_w = self.backbone.word_embed.weight.data(
             h.context if hasattr(h, "context") else None)

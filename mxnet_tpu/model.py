@@ -16,7 +16,11 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` (model.py:403)."""
+    """Write ``prefix-symbol.json`` + ``prefix-%04d.params`` (model.py:403).
+
+    ``remove_amp_cast`` is accepted for signature parity but has no effect:
+    on this stack AMP casts are inserted at dispatch time, never recorded as
+    graph nodes, so there is nothing to strip from the saved symbol."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v.as_in_context(cpu())

@@ -701,3 +701,11 @@ def BlockGrad(x):
     """Identity forward, zero gradient (tensor/elemwise_unary_op_basic.cc
     BlockGrad / stop_gradient)."""
     return lax.stop_gradient(x)
+
+
+@register("take_along_axis")
+def take_along_axis(x, indices, *, axis=0):
+    """np.take_along_axis as a registered op so both frontends (and the
+    symbolic tracer) can batched-gather — e.g. the BERT masked-position
+    gather (arr[b, idx[b, p], ...])."""
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=axis)

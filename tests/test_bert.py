@@ -72,3 +72,27 @@ def test_graft_entry_dryrun():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as g
     g.dryrun_multichip(8)
+
+
+def test_masked_position_mlm_matches_dense_gather():
+    """forward(masked_positions=...) must equal gathering the dense-path
+    logits at those positions (the 6x-cheaper decoder path)."""
+    from mxnet_tpu.gluon.model_zoo import bert
+    backbone = bert.BERTModel(units=32, num_layers=1, num_heads=2,
+                              max_length=16, vocab_size=50)
+    model = bert.BERTForPretraining(backbone, vocab_size=50)
+    model.initialize(mx.init.Normal(0.02))
+    rng = onp.random.RandomState(0)
+    toks = mx.nd.array(rng.randint(0, 50, (2, 16)).astype("int32"))
+    tt = mx.nd.array(onp.zeros((2, 16), "int32"))
+    pos = mx.nd.array(onp.array([[1, 5, 9], [0, 3, 15]], "int32"))
+    dense_mlm, dense_nsp = model(toks, tt)
+    masked_mlm, masked_nsp = model(toks, tt, None, pos)
+    assert masked_mlm.shape == (2, 3, 50)
+    dn = dense_mlm.asnumpy()
+    for b in range(2):
+        for j, p in enumerate(pos.asnumpy().astype(int)[b]):
+            onp.testing.assert_allclose(masked_mlm.asnumpy()[b, j],
+                                        dn[b, p], rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(masked_nsp.asnumpy(), dense_nsp.asnumpy(),
+                                rtol=1e-5)
