@@ -192,3 +192,172 @@ class RandomSaturation(Block):
         arr = _asnumpy(x).astype(onp.float32)
         gray = arr.mean(axis=2, keepdims=True)
         return NDArray(arr * alpha + gray * (1 - alpha))
+
+
+class RandomHue(Block):
+    """Jitter hue by rotating chroma in YIQ space
+    (transforms RandomHue; image.py HueJitterAug math)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        from ....image import HueJitterAug
+        arr = x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
+        return HueJitterAug(self._hue)(arr)
+
+
+class RandomColorJitter(Block):
+    """Random-order brightness/contrast/saturation/hue jitter
+    (transforms RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        ts = []
+        if brightness > 0:
+            ts.append(RandomBrightness(brightness))
+        if contrast > 0:
+            ts.append(RandomContrast(contrast))
+        if saturation > 0:
+            ts.append(RandomSaturation(saturation))
+        if hue > 0:
+            ts.append(RandomHue(hue))
+        self._ts = ts
+
+    def forward(self, x):
+        order = list(self._ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (transforms RandomLighting)."""
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        from ....image import LightingAug
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        arr = x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
+        return LightingAug(self._alpha, eigval, eigvec)(arr)
+
+
+class Rotate(Block):
+    """Rotate an HWC image by a fixed angle (degrees, counterclockwise;
+    transforms Rotate). zoom_in/zoom_out control whether the frame scales
+    to hide black corners."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._deg = rotation_degrees
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+
+    def forward(self, x):
+        return _rotate_hwc(x, self._deg, self._zoom_in, self._zoom_out)
+
+
+class RandomRotation(Block):
+    """Rotate by U(angle_limits) with probability rotate_with_proba
+    (transforms RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        self._limits = angle_limits
+        self._zoom_in = zoom_in
+        self._zoom_out = zoom_out
+        self._proba = rotate_with_proba
+
+    def forward(self, x):
+        if pyrandom.random() >= self._proba:
+            return x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
+        deg = pyrandom.uniform(*self._limits)
+        return _rotate_hwc(x, deg, self._zoom_in, self._zoom_out)
+
+
+def _rotate_hwc(x, deg, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the image center (host-side, augmentation
+    boundary like the other random transforms)."""
+    arr = _asnumpy(x).astype(onp.float32)
+    H, W = arr.shape[:2]
+    theta = onp.deg2rad(deg)
+    c, s = onp.cos(theta), onp.sin(theta)
+    scale = 1.0
+    if zoom_out:
+        # scale so the rotated frame contains the whole original image
+        scale = max(abs(c) + abs(s) * W / H, abs(c) + abs(s) * H / W)
+    elif zoom_in:
+        # scale so no black corners appear: the binding constraint is the
+        # worse aspect direction, measured between pixel CENTERS (extents
+        # (W-1)/2, (H-1)/2 — using W/H leaves a thin black edge)
+        ratio = max((W - 1) / max(H - 1, 1), (H - 1) / max(W - 1, 1))
+        scale = 1.0 / (abs(c) + abs(s) * ratio)
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+    ys, xs = onp.meshgrid(onp.arange(H), onp.arange(W), indexing="ij")
+    yr = (ys - cy) * scale
+    xr = (xs - cx) * scale
+    src_y = c * yr + s * xr + cy
+    src_x = -s * yr + c * xr + cx
+    y0 = onp.floor(src_y).astype(int)
+    x0 = onp.floor(src_x).astype(int)
+    wy = src_y - y0
+    wx = src_x - x0
+    valid = (src_y >= 0) & (src_y <= H - 1) & (src_x >= 0) & (src_x <= W - 1)
+    y0c = onp.clip(y0, 0, H - 1)
+    x0c = onp.clip(x0, 0, W - 1)
+    y1c = onp.clip(y0 + 1, 0, H - 1)
+    x1c = onp.clip(x0 + 1, 0, W - 1)
+    out = (arr[y0c, x0c] * ((1 - wy) * (1 - wx))[..., None]
+           + arr[y0c, x1c] * ((1 - wy) * wx)[..., None]
+           + arr[y1c, x0c] * (wy * (1 - wx))[..., None]
+           + arr[y1c, x1c] * (wy * wx)[..., None])
+    out = out * valid[..., None]
+    return NDArray(out)
+
+
+class CropResize(Block):
+    """Crop (x, y, w, h) then optionally resize (transforms CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (x, y, width, height)
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, data):
+        from ....image import fixed_crop, imresize
+        arr = data if isinstance(data, NDArray) else NDArray(onp.asarray(data))
+        x, y, w, h = self._box
+        out = fixed_crop(arr, x, y, w, h)
+        if self._size is not None:
+            sw, sh = (self._size, self._size) if isinstance(self._size, int) \
+                else self._size
+            out = imresize(out, sw, sh, self._interp)
+        return out
+
+
+class RandomApply(Block):
+    """Apply a transform with probability p (transforms RandomApply)."""
+
+    def __init__(self, transform, p=0.5):
+        super().__init__()
+        self._t = transform
+        self._p = p
+
+    def forward(self, x):
+        if pyrandom.random() < self._p:
+            return self._t(x)
+        return x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
+
+
+__all__ += ["RandomHue", "RandomColorJitter", "RandomLighting", "Rotate",
+            "RandomRotation", "CropResize", "RandomApply"]

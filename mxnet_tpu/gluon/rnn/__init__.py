@@ -1,5 +1,5 @@
 """gluon.rnn namespace (parity: python/mxnet/gluon/rnn/)."""
 from .rnn_layer import RNN, LSTM, GRU
-from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, ModifierCell, RNNCell, LSTMCell,
                        GRUCell, SequentialRNNCell, HybridSequentialRNNCell, DropoutCell, ZoneoutCell,
                        ResidualCell, BidirectionalCell)

@@ -10,7 +10,7 @@ import numpy as onp
 
 from .base import Registry, MXNetError
 
-__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+__all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "FusedRNN",
            "Constant", "Zero", "One", "Bilinear", "LSTMBias", "Load", "Mixed",
            "register", "InitDesc"]
 
@@ -276,3 +276,67 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"parameter {name} did not match any pattern")
+
+
+@register("fusedrnn")  # class-name key: what Initializer.dumps() emits
+@register("fused_rnn")
+class FusedRNN(Initializer):
+    """Initialize a fused flat RNN parameter vector sub-matrix by sub-matrix
+    (initializer.py FusedRNN): the inner initializer sees each W_i2h / W_h2h
+    with its true 2-D shape (so Xavier fan-in/out is right), biases get
+    zeros. Layout: ops/nn.py rnn_unpack_params (rnn-inl.h flat order)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            init = _REG.get(init)()
+        # serialize the inner init by registry name so dumps() round-trips
+        super().__init__(init=type(init).__name__.lower(),
+                         num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional, forget_bias=forget_bias)
+        self._init = init
+        self._h = num_hidden
+        self._layers = num_layers
+        self._mode = mode
+        self._bi = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        import numpy as onp
+        from .ops.nn import _num_gates
+        g = _num_gates(self._mode)
+        h = self._h
+        d = 2 if self._bi else 1
+        total = arr.size
+        # infer input_size from the flat length (closed form inversion of
+        # rnn_param_size)
+        rest = d * (self._layers - 1) * (g * h * h * d + g * h * h) if \
+            self._layers > 1 else 0
+        bias_sz = self._layers * d * 2 * g * h
+        first = total - rest - bias_sz
+        in_sz = first // (d * g * h) - h
+        out = onp.empty(total, "float32")
+        off = 0
+        for layer in range(self._layers):
+            cur_in = in_sz if layer == 0 else h * d
+            for _ in range(d):
+                for shape in ((g * h, cur_in), (g * h, h)):
+                    n = shape[0] * shape[1]
+                    sub = onp.zeros(shape, "float32")
+                    from .ndarray.ndarray import NDArray as _ND
+                    tmp = _ND(sub)
+                    self._init(InitDesc(str(desc) + "_weight"), tmp)
+                    out[off:off + n] = tmp.asnumpy().ravel()
+                    off += n
+        for layer in range(self._layers):
+            for _ in range(d):
+                for _bias in range(2):
+                    b = onp.zeros(g * h, "float32")
+                    if self._mode == "lstm":
+                        # forget-gate bias (gate order i, f, g, o)
+                        b[h:2 * h] = self._forget_bias / 2.0
+                    out[off:off + g * h] = b
+                    off += g * h
+        arr._set_data(__import__("jax").numpy.asarray(
+            out.reshape(arr.shape), arr.data.dtype))

@@ -282,3 +282,110 @@ Loss = type("Loss", (EvalMetric,), {
          setattr(self, "num_inst", self.num_inst + _as_numpy(p).size))
         for p in _listify(preds)] and None})
 register("loss")(Loss)
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(EvalMetric):
+    """-mean(log p[label]) over predicted class probabilities
+    (metric.py:1343)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        import numpy as onp
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            l = _as_numpy(label).astype(int).ravel()
+            p = _as_numpy(pred).reshape(l.size, -1)
+            picked = p[onp.arange(l.size), l]
+            self.sum_metric += float(-onp.log(picked + self.eps).sum())
+            self.num_inst += l.size
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    """Matthews correlation coefficient for binary classification
+    (metric.py:838): (TP·TN − FP·FN) / sqrt((TP+FP)(TP+FN)(TN+FP)(TN+FN)),
+    accumulated over the confusion counts."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._tp = self._tn = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._tn = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        import numpy as onp
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            l = _as_numpy(label).astype(int).ravel()
+            p = _as_numpy(pred)
+            yhat = p.reshape(l.size, -1).argmax(-1) if p.ndim > 1 and \
+                p.shape[-1] > 1 else (p.ravel() > 0.5).astype(int)
+            self._tp += float(((yhat == 1) & (l == 1)).sum())
+            self._tn += float(((yhat == 0) & (l == 0)).sum())
+            self._fp += float(((yhat == 1) & (l == 0)).sum())
+            self._fn += float(((yhat == 0) & (l == 1)).sum())
+            self.num_inst = 1
+        denom = onp.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
+                         (self._tn + self._fp) * (self._tn + self._fn))
+        self.sum_metric = 0.0 if denom == 0 else \
+            (self._tp * self._tn - self._fp * self._fn) / denom
+
+
+@register("pcc")
+class PCC(EvalMetric):
+    """Multiclass MCC generalization — the Pearson correlation of the
+    k×k confusion matrix (metric.py:1527)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._cm = None
+
+    def reset(self):
+        super().reset()
+        self._cm = None
+
+    def update(self, labels, preds):
+        import numpy as onp
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            l = _as_numpy(label).astype(int).ravel()
+            p = _as_numpy(pred)
+            yhat = p.reshape(l.size, -1).argmax(-1) if p.ndim > 1 and \
+                p.shape[-1] > 1 else (p.ravel() > 0.5).astype(int)
+            k = int(max(l.max(), yhat.max())) + 1
+            if self._cm is None or self._cm.shape[0] < k:
+                new = onp.zeros((k, k), "float64")
+                if self._cm is not None:
+                    new[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+                self._cm = new
+            onp.add.at(self._cm, (yhat, l), 1)
+            self.num_inst = 1
+        cm = self._cm
+        n = cm.sum()
+        x = cm.sum(1)  # predicted counts
+        y = cm.sum(0)  # true counts
+        cov_xy = (cm.trace() * n - (x * y).sum())
+        cov_xx = (n * n - (x * x).sum())
+        cov_yy = (n * n - (y * y).sum())
+        import math
+        denom = math.sqrt(cov_xx * cov_yy)
+        self.sum_metric = 0.0 if denom == 0 else cov_xy / denom
+
+
+@register("torch")
+class Torch(Loss):
+    """Legacy alias: mean of criterion outputs (metric.py:1694)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name, **kwargs)
+
+
+@register("caffe")
+class Caffe(Loss):
+    """Legacy alias: mean of criterion outputs (metric.py:1703)."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name, **kwargs)

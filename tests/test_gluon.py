@@ -474,3 +474,80 @@ def test_hybrid_sequential_rnn_cell():
     out, states = cell(mx.nd.array(onp.zeros((2, 4), "float32")),
                        cell.begin_state(2))
     assert out.shape == (2, 8)
+
+
+# ---------------------------------------------------------------------------
+# round-3 additions: metrics MCC/PCC/NLL, FusedRNN initializer, ModifierCell
+# ---------------------------------------------------------------------------
+def test_metric_nll_mcc_pcc():
+    import math
+    m = mx.metric.NegativeLogLikelihood()
+    m.update(nd.array(onp.array([0, 1], "float32")),
+             nd.array(onp.array([[0.9, 0.1], [0.2, 0.8]], "float32")))
+    assert abs(m.get()[1] + (math.log(0.9) + math.log(0.8)) / 2) < 1e-6
+    mcc = mx.metric.MCC()
+    mcc.update(nd.array(onp.array([1, 1, 0, 0], "float32")),
+               nd.array(onp.array([[0.1, 0.9], [0.6, 0.4],
+                                   [0.8, 0.2], [0.3, 0.7]], "float32")))
+    assert abs(mcc.get()[1]) < 1e-12  # balanced half-right -> 0
+    pcc = mx.metric.PCC()
+    pcc.update(nd.array(onp.array([0, 1, 2, 0], "float32")),
+               nd.array(onp.eye(3)[[0, 1, 2, 0]].astype("float32")))
+    assert abs(pcc.get()[1] - 1.0) < 1e-9
+    assert mx.metric.create("mcc") is not None
+    assert mx.metric.create("nll_loss") is not None
+
+
+def test_fused_rnn_initializer():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    size = rnn_param_size("lstm", 2, 16, 32, False)
+    arr = nd.zeros((size,))
+    mx.init.FusedRNN(mx.init.Xavier(), 32, 2, "lstm")("parameters", arr)
+    a = arr.asnumpy()
+    assert a[:16 * 32 * 4].std() > 0.01  # Xavier-filled weights
+    total_w = (4 * 32 * 16 + 4 * 32 * 32) + (4 * 32 * 32 + 4 * 32 * 32)
+    b = a[total_w:total_w + 4 * 32]
+    assert onp.allclose(b[32:64], 0.5)   # forget-gate bias (bx half of 1.0)
+    assert onp.allclose(b[:32], 0.0)
+    # end-to-end: an LSTM initialized with it trains
+    from mxnet_tpu.gluon import rnn as grnn
+    lstm = grnn.LSTM(8, num_layers=1, layout="NTC", input_size=4)
+    lstm.initialize(mx.init.FusedRNN(mx.init.Xavier(), 8, 1, "lstm"))
+    out = lstm(nd.array(onp.zeros((2, 5, 4), "float32")))
+    assert out.shape == (2, 5, 8)
+
+
+def test_modifier_cell_exported():
+    from mxnet_tpu.gluon.rnn import ModifierCell, ZoneoutCell
+    assert issubclass(ZoneoutCell, ModifierCell)
+
+
+def test_fused_rnn_string_init_and_dumps_roundtrip():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    size = rnn_param_size("gru", 1, 4, 8, False)
+    arr = nd.zeros((size,))
+    init = mx.init.FusedRNN("xavier", 8, 1, "gru")
+    init("parameters", arr)
+    assert arr.asnumpy().std() > 0.001
+    # dumps emits a registry-resolvable [name, kwargs] payload
+    import json
+    name, kwargs = json.loads(init.dumps())
+    assert name.lower() in ("fusedrnn", "fused_rnn")
+    rebuilt = mx.init.FusedRNN(**kwargs)
+    arr2 = nd.zeros((size,))
+    rebuilt("parameters", arr2)
+    assert arr2.asnumpy().std() > 0.001
+
+
+def test_zoom_in_rotation_no_black_corners():
+    import mxnet_tpu.gluon.data.vision.transforms as T
+    img = mx.nd.array(onp.ones((10, 100, 3), "float32"))
+    out = T.Rotate(30, zoom_in=True)(img).asnumpy()
+    assert (out == 0).mean() < 0.01, (out == 0).mean()
+
+
+def test_pcc_binary_sigmoid_preds():
+    pcc = mx.metric.PCC()
+    pcc.update(nd.array(onp.array([0, 1, 1, 0], "float32")),
+               nd.array(onp.array([[0.1], [0.9], [0.8], [0.2]], "float32")))
+    assert abs(pcc.get()[1] - 1.0) < 1e-9

@@ -4,6 +4,7 @@ import random
 
 import numpy as onp
 
+import mxnet_tpu as mx
 from mxnet_tpu import image, nd
 
 
@@ -68,3 +69,34 @@ def test_sequential_and_force_resize():
                                image.CastAug("float32")])
     out = seq(src)
     assert out.shape == (16, 12, 3)
+
+
+# ---------------------------------------------------------------------------
+# round-3 transform completions (transforms RandomHue/ColorJitter/Lighting/
+# Rotate/RandomRotation/CropResize/RandomApply)
+# ---------------------------------------------------------------------------
+def test_transform_completions():
+    import mxnet_tpu.gluon.data.vision.transforms as T
+    rng = onp.random.RandomState(0)
+    img = mx.nd.array((rng.rand(16, 12, 3) * 255).astype("float32"))
+    for t in [T.RandomHue(0.2), T.RandomColorJitter(0.3, 0.3, 0.3, 0.1),
+              T.RandomLighting(0.1), T.RandomRotation((-20, 20)),
+              T.RandomApply(T.RandomHue(0.1), p=1.0)]:
+        assert t(img).shape == img.shape
+    assert T.CropResize(2, 3, 8, 8, size=6)(img).shape == (6, 6, 3)
+
+
+def test_rotate_exact_cases():
+    import mxnet_tpu.gluon.data.vision.transforms as T
+    rng = onp.random.RandomState(1)
+    img = mx.nd.array((rng.rand(9, 9, 1) * 10).astype("float32"))
+    assert onp.allclose(T.Rotate(0)(img).asnumpy(), img.asnumpy())
+    r90 = T.Rotate(90)(img).asnumpy()[..., 0]
+    assert onp.allclose(r90, onp.rot90(img.asnumpy()[..., 0], k=1), atol=1e-4)
+
+
+def test_random_apply_p0_identity():
+    import mxnet_tpu.gluon.data.vision.transforms as T
+    img = mx.nd.array(onp.ones((4, 4, 3), "float32"))
+    out = T.RandomApply(T.RandomHue(0.5), p=0.0)(img)
+    assert onp.allclose(out.asnumpy(), 1.0)
