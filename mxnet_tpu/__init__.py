@@ -57,6 +57,12 @@ from . import module
 from . import module as mod
 from . import model
 from . import name
+from . import error
+from . import libinfo
+from . import log
+from . import registry
+from . import test_utils
+from .symbol import executor
 from . import contrib
 from .util import np_shape, np_array, is_np_array, set_np, reset_np
 from . import numpy as np

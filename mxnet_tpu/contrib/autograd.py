@@ -1,0 +1,44 @@
+"""contrib.autograd (parity: contrib/autograd.py — the pre-1.0 experimental
+autograd API): thin delegation to the stable mx.autograd surface."""
+from ..autograd import (record as train_section,  # noqa: F401
+                        pause as test_section,
+                        backward as compute_gradient_inner)
+from .. import autograd as _ag
+
+
+def set_is_training(is_train):
+    """Legacy toggle; returns previous state."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+def compute_gradient(outputs):
+    """Compute gradients of outputs w.r.t. marked variables."""
+    _ag.backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss (contrib
+    autograd.py grad_and_loss)."""
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            idx = argnum if isinstance(argnum, list) else [argnum]
+            variables = [args[i] for i in idx]
+        for x in variables:
+            x.attach_grad()
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward(outputs if isinstance(outputs, list) else [outputs])
+        return [x.grad for x in variables], outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing only the gradient."""
+    wrapped = grad_and_loss(func, argnum)
+
+    def only_grad(*args):
+        return wrapped(*args)[0]
+    return only_grad

@@ -1,0 +1,33 @@
+"""contrib.io (parity: contrib/io.py): DataLoaderIter — wrap a gluon
+DataLoader in the legacy DataIter interface."""
+from ..io import DataIter, DataBatch, DataDesc
+
+
+class DataLoaderIter(DataIter):
+    """Iterate a gluon DataLoader as a Module-compatible DataIter
+    (contrib/io.py DataLoaderIter)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        first = next(iter(loader))
+        data, label = first[0], first[1] if len(first) > 1 else None
+        # gluon DataLoader exposes no batch_size attribute; the leading dim
+        # of a real batch is the ground truth
+        super().__init__(batch_size=int(data.shape[0]))
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        self.provide_data = [DataDesc(data_name, tuple(data.shape),
+                                      data.dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape),
+                                       label.dtype)] if label is not None \
+            else []
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def next(self):
+        batch = next(self._iter)  # raises StopIteration at end
+        data, label = batch[0], batch[1] if len(batch) > 1 else None
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else None)

@@ -1,0 +1,2 @@
+"""contrib.ndarray (parity: contrib/ndarray.py): alias of nd.contrib."""
+from ..ndarray.contrib import *  # noqa: F401,F403

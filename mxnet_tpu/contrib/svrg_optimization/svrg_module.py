@@ -1,0 +1,117 @@
+"""SVRGModule (parity: contrib/svrg_optimization/svrg_module.py:30).
+
+Stochastic Variance Reduced Gradient over the legacy Module API: every
+``update_freq`` epochs the module snapshots the weights w~ and the
+full-dataset gradient g~; each minibatch update then uses the
+variance-reduced gradient  g(w) - g_aux(w~) + g~  (the SVRG rule), which
+the base Module applies through its installed optimizer."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...module.module import Module
+from ...ndarray.ndarray import NDArray
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        if not isinstance(update_freq, int) or update_freq < 1:
+            raise ValueError("update_freq must be a positive integer")
+        self.update_freq = update_freq
+        # auxiliary module evaluates gradients at the snapshot weights w~
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._full_grads = {}     # name -> g~ (numpy)
+        self._last_batch = None
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        super().bind(data_shapes, label_shapes, for_training, **kwargs)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training, **kwargs)
+
+    def init_params(self, *args, **kwargs):
+        was_initialized = self.params_initialized
+        super().init_params(*args, **kwargs)
+        if was_initialized and not kwargs.get("force_init", False):
+            # guarded no-op init (e.g. Module.fit re-entering): do NOT
+            # re-seed the aux module — that would clobber the SVRG snapshot
+            # w~ with the current weights mid-schedule
+            return
+        arg, aux = self.get_params()
+        # COPIES, never the live arrays: the main module's jitted optimizer
+        # donates its weight buffers, which would leave the aux module
+        # holding deleted arrays
+        self._mod_aux.set_params(
+            {k: NDArray(v.asnumpy().copy()) for k, v in arg.items()},
+            {k: NDArray(v.asnumpy().copy()) for k, v in aux.items()})
+
+    def update_full_grads(self, train_data):
+        """Snapshot w~ := w and g~ := mean gradient over ALL of train_data
+        (svrg_module.py:292)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params(
+            {k: NDArray(v.asnumpy().copy()) for k, v in arg.items()},
+            {k: NDArray(v.asnumpy().copy()) for k, v in aux.items()})
+        train_data.reset()
+        sums = {}
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward(batch, is_train=True)
+            self._mod_aux.backward()
+            for name, grad in self._mod_aux._exec.grad_dict.items():
+                if grad is None:
+                    continue
+                g = grad.asnumpy()
+                sums[name] = sums.get(name, 0.0) + g
+            nbatch += 1
+        self._full_grads = {k: v / max(nbatch, 1) for k, v in sums.items()}
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(data_batch, is_train)
+        if is_train is None or is_train:
+            self._last_batch = data_batch
+
+    def backward(self, out_grads=None):
+        # main module first (the tape is global and per-record: interleaving
+        # the aux forward before the main backward would clobber the main
+        # module's recorded heads), then the snapshot-weights pass
+        super().backward(out_grads)
+        if self._full_grads and self._last_batch is not None:
+            self._mod_aux.forward(self._last_batch, is_train=True)
+            self._mod_aux.backward(out_grads)
+
+    def _update_svrg_gradients(self):
+        """grad <- grad - grad_aux + g~ in place (svrg_module.py:274)."""
+        import jax.numpy as jnp
+        for name, grad in self._exec.grad_dict.items():
+            if grad is None or name not in self._full_grads:
+                continue
+            g_aux = self._mod_aux._exec.grad_dict.get(name)
+            if g_aux is None:
+                continue
+            new = grad.asnumpy() - g_aux.asnumpy() + self._full_grads[name]
+            grad._set_data(jnp.asarray(new, grad.data.dtype))
+
+    def update(self):
+        if self._full_grads:
+            self._update_svrg_gradients()
+        super().update()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=1, **kwargs):
+        """Module.fit with the SVRG snapshot every ``update_freq`` epochs
+        (svrg_module.py:395). Runs the plain fit loop but refreshes the
+        full gradient at epoch boundaries."""
+        begin_epoch = kwargs.pop("begin_epoch", 0)
+        for epoch in range(begin_epoch, num_epoch):
+            if epoch % self.update_freq == 0:
+                self.update_full_grads(train_data)
+            # one epoch per inner call, with the TRUE epoch number so logs
+            # and epoch/batch callbacks see the real schedule
+            super().fit(train_data, eval_data=eval_data,
+                        eval_metric=eval_metric, begin_epoch=epoch,
+                        num_epoch=epoch + 1, **kwargs)
+        return self

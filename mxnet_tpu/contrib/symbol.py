@@ -1,0 +1,12 @@
+"""contrib.symbol (parity: contrib/symbol.py): the contrib op family
+reachable through the symbolic frontend — delegate attribute lookups to
+mx.sym's generated wrappers (contrib ops are registered with their
+_contrib_/CamelCase names there)."""
+
+
+def __getattr__(name):
+    from .. import symbol as _sym
+    for cand in (name, f"_contrib_{name}"):
+        if hasattr(_sym, cand):
+            return getattr(_sym, cand)
+    raise AttributeError(f"contrib.symbol has no op {name!r}")

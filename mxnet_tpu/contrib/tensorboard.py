@@ -1,0 +1,50 @@
+"""contrib.tensorboard (parity: contrib/tensorboard.py): LogMetricsCallback —
+a batch-end callback streaming metric values to a summary writer. The
+reference needs the external `tensorboard` package; here any object with an
+``add_scalar(name, value, step)`` method works (e.g. torch.utils.tensorboard
+if available), with a JSONL file writer fallback so the callback is usable
+without extra deps."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class _JsonlWriter:
+    """Minimal summary writer: one JSON line per scalar."""
+
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._f = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+
+    def add_scalar(self, name, value, step=None):
+        self._f.write(json.dumps({"ts": time.time(), "name": name,
+                                  "value": float(value), "step": step}) + "\n")
+        self._f.flush()
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging eval metrics (contrib/tensorboard.py:56)."""
+
+    def __init__(self, logging_dir, prefix=None, summary_writer=None):
+        self.prefix = prefix
+        if summary_writer is not None:
+            self._writer = summary_writer
+        else:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer = SummaryWriter(logging_dir)
+            except Exception:
+                self._writer = _JsonlWriter(logging_dir)
+
+    def __call__(self, param):
+        metric = param.eval_metric
+        if metric is None:
+            return
+        pairs = metric.get_name_value() if hasattr(metric, "get_name_value") \
+            else [metric.get()]
+        for name, value in pairs:
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self._writer.add_scalar(name, value, getattr(param, "nbatch", None))

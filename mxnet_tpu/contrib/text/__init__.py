@@ -1,0 +1,6 @@
+"""contrib.text (parity: python/mxnet/contrib/text/): Vocabulary, token
+embeddings, token-count utilities."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,239 @@
+"""Token embeddings (parity: contrib/text/embedding.py): the
+_TokenEmbedding base with file loading, vocabulary composition,
+get_vecs_by_tokens / update_token_vectors, a registry, and CustomEmbedding.
+
+GloVe / FastText pretrained classes exist with the reference's file-name
+registry, but this environment has no network egress — they load from a
+local ``pretrained_file_path`` instead of downloading."""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as onp
+
+from ...base import Registry
+from ...ndarray.ndarray import NDArray
+from . import vocab as _vocab
+
+_REG = Registry("token_embedding")
+
+
+def register(embedding_cls):
+    """Register a _TokenEmbedding subclass (embedding.py:40)."""
+    _REG.register(embedding_cls.__name__.lower())(embedding_cls)
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding by name (embedding.py:63)."""
+    return _REG.get(embedding_name.lower())(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per registered embedding
+    (embedding.py:90)."""
+    if embedding_name is not None:
+        return list(_REG.get(embedding_name.lower())
+                    .pretrained_file_name_sha1.keys())
+    return {name: list(_REG.get(name).pretrained_file_name_sha1.keys())
+            for name in _REG.list()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Base token embedding: a Vocabulary whose indices carry vectors
+    (embedding.py:133)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec=onp.zeros, encoding="utf8"):
+        """Parse a text embedding file: one `token<delim>val...` per line."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError(f"invalid pretrained file path "
+                             f"{pretrained_file_path}")
+        start = len(self._idx_to_token)  # rows 0..start-1: unk + reserved
+        all_elems = []
+        tokens = set()
+        loaded_unknown_vec = None
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                elems = line.rstrip().split(elem_delim)
+                token, vec = elems[0], elems[1:]
+                if len(vec) == 1 and line_num == 0:
+                    continue  # header line (fastText format)
+                if token == self.unknown_token:
+                    if loaded_unknown_vec is None:
+                        loaded_unknown_vec = [float(x) for x in vec]
+                    else:
+                        logging.warning("duplicate unknown token line; skipped")
+                elif token in tokens or token in self._token_to_idx:
+                    logging.warning("duplicate token %s; skipped", token)
+                elif vec:
+                    if self._vec_len == 0:
+                        self._vec_len = len(vec)
+                    if len(vec) != self._vec_len:
+                        logging.warning("line %d has %d dims (expected %d); "
+                                        "skipped", line_num, len(vec),
+                                        self._vec_len)
+                        continue
+                    self._idx_to_token.append(token)
+                    self._token_to_idx[token] = len(self._idx_to_token) - 1
+                    tokens.add(token)
+                    all_elems.extend(float(x) for x in vec)
+        mat = onp.zeros((len(self._idx_to_token), self._vec_len), "float32")
+        # preamble rows (unknown + reserved tokens) get the unknown init
+        unk = onp.asarray(loaded_unknown_vec, "float32") \
+            if loaded_unknown_vec is not None \
+            else onp.asarray(init_unknown_vec(self._vec_len), "float32")
+        mat[:start] = unk
+        if all_elems:
+            mat[start:] = onp.array(all_elems, "float32").reshape(
+                -1, self._vec_len)
+        self._idx_to_vec = NDArray(mat)
+
+    def _index_tokens_from_vocabulary(self, vocabulary):
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _host_matrix(self):
+        """Cached host copy of the embedding matrix — get_vecs_by_tokens
+        would otherwise ship the full (V, d) matrix device→host per call."""
+        cache = getattr(self, "_idx_to_vec_np", None)
+        if cache is None or cache[0] is not self._idx_to_vec:
+            cache = (self._idx_to_vec, self._idx_to_vec.asnumpy())
+            self._idx_to_vec_np = cache
+        return cache[1]
+
+    def _build_for_vocabulary(self, vocabulary):
+        """Re-index this embedding over ``vocabulary`` (one batched lookup —
+        a per-token loop would copy the whole matrix per token)."""
+        vecs = self.get_vecs_by_tokens(
+            list(vocabulary.idx_to_token)).asnumpy()
+        self._index_tokens_from_vocabulary(vocabulary)
+        self._idx_to_vec = NDArray(vecs)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get index 0's vector
+        (embedding.py:370)."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        if not lower_case_backup:
+            indices = [self.token_to_idx.get(t, 0) for t in tokens]
+        else:
+            indices = [self.token_to_idx[t] if t in self.token_to_idx
+                       else self.token_to_idx.get(t.lower(), 0)
+                       for t in tokens]
+        mat = self._host_matrix()[indices]
+        return NDArray(mat[0] if to_reduce else mat)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite the vectors of known tokens (embedding.py:415)."""
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+        nv = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors)
+        nv = nv.reshape(len(tokens), -1)
+        mat = self._idx_to_vec.asnumpy().copy()
+        for token, vec in zip(tokens, nv):
+            if token not in self.token_to_idx:
+                raise ValueError(f"token {token!r} is unknown; only known "
+                                 "token vectors can be updated")
+            mat[self.token_to_idx[token]] = vec
+        self._idx_to_vec = NDArray(mat)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding loaded from a user text file: `token<delim>v1<delim>v2...`
+    (embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=onp.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary)
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embedding (embedding.py:481). No network egress here: pass a
+    local ``pretrained_file_path`` to one of the known-format files."""
+
+    pretrained_file_name_sha1 = {
+        "glove.42B.300d.txt": None, "glove.6B.50d.txt": None,
+        "glove.6B.100d.txt": None, "glove.6B.200d.txt": None,
+        "glove.6B.300d.txt": None, "glove.840B.300d.txt": None,
+        "glove.twitter.27B.25d.txt": None, "glove.twitter.27B.50d.txt": None,
+        "glove.twitter.27B.100d.txt": None,
+        "glove.twitter.27B.200d.txt": None,
+    }
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 pretrained_file_path=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        if pretrained_file_path is None:
+            raise ValueError(
+                "no network egress in this environment: pass "
+                "pretrained_file_path to a local GloVe text file "
+                f"(known names: {sorted(self.pretrained_file_name_sha1)})")
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText embedding (embedding.py:553); local-file loading only."""
+
+    pretrained_file_name_sha1 = {
+        "wiki.simple.vec": None, "wiki.en.vec": None,
+        "crawl-300d-2M.vec": None,
+    }
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 pretrained_file_path=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        if pretrained_file_path is None:
+            raise ValueError(
+                "no network egress in this environment: pass "
+                "pretrained_file_path to a local fastText .vec file")
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            self._build_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._index_tokens_from_vocabulary(vocabulary)
+        parts = [emb.get_vecs_by_tokens(list(self.idx_to_token)).asnumpy()
+                 for emb in token_embeddings]
+        mat = onp.concatenate(parts, axis=-1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = NDArray(mat.astype("float32"))

@@ -1,0 +1,19 @@
+"""Token counting utilities (parity: contrib/text/utils.py)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str`` split by the two delimiters
+    (contrib/text/utils.py:26). Returns (and optionally updates) a
+    collections.Counter."""
+    source_str = re.split(token_delim + "|" + seq_delim, source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    counter = counter_to_update if counter_to_update is not None else Counter()
+    counter.update(tokens)
+    return counter

@@ -1,0 +1,103 @@
+"""Vocabulary (parity: contrib/text/vocab.py:28): token indexing with
+frequency thresholds, reserved tokens and an unknown token at index 0."""
+from __future__ import annotations
+
+from collections import Counter
+
+
+class Vocabulary:
+    """Index tokens by frequency (most frequent first; ties broken
+    alphabetically, matching the reference sort).
+
+    Index 0 is the unknown token; reserved tokens follow; then counted
+    tokens filtered by ``min_freq`` and capped at ``most_freq_count``."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            seen = set(reserved_tokens)
+            if unknown_token in seen or len(seen) != len(reserved_tokens):
+                raise ValueError("reserved tokens must be unique and must "
+                                 "not include the unknown token")
+        self._index_unknown_and_reserved_tokens(unknown_token, reserved_tokens)
+        if counter is not None:
+            self._index_counter_keys(counter, unknown_token, reserved_tokens,
+                                     most_freq_count, min_freq)
+
+    def _index_unknown_and_reserved_tokens(self, unknown_token,
+                                           reserved_tokens):
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token]
+        if reserved_tokens is None:
+            self._reserved_tokens = None
+        else:
+            self._reserved_tokens = list(reserved_tokens)
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def _index_counter_keys(self, counter, unknown_token, reserved_tokens,
+                            most_freq_count, min_freq):
+        assert isinstance(counter, Counter), \
+            "counter must be a collections.Counter"
+        unknown_and_reserved = {unknown_token}
+        if reserved_tokens is not None:
+            unknown_and_reserved.update(reserved_tokens)
+        token_freqs = sorted(counter.items(), key=lambda x: x[0])
+        token_freqs.sort(key=lambda x: x[1], reverse=True)
+        token_cap = len(unknown_and_reserved) + (
+            len(counter) if most_freq_count is None else most_freq_count)
+        for token, freq in token_freqs:
+            if freq < min_freq or len(self._idx_to_token) == token_cap:
+                break
+            if token not in unknown_and_reserved:
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        to_reduce = False
+        if not isinstance(tokens, list):
+            tokens = [tokens]
+            to_reduce = True
+        indices = [self._token_to_idx.get(t, 0) for t in tokens]
+        return indices[0] if to_reduce else indices
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s)."""
+        to_reduce = False
+        if not isinstance(indices, list):
+            indices = [indices]
+            to_reduce = True
+        import operator
+        max_idx = len(self._idx_to_token) - 1
+        tokens = []
+        for idx in indices:
+            try:
+                idx = operator.index(idx)  # accepts numpy integer scalars
+            except TypeError:
+                raise ValueError(f"token index {idx!r} is not an integer")
+            if not 0 <= idx <= max_idx:
+                raise ValueError(f"token index {idx} out of range [0, {max_idx}]")
+            tokens.append(self._idx_to_token[idx])
+        return tokens[0] if to_reduce else tokens

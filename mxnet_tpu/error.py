@@ -1,0 +1,38 @@
+"""mx.error (parity: python/mxnet/error.py): typed MXNetError subclasses with
+a registration decorator mapping error-type prefixes in messages to classes."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "register", "InternalError"]
+
+_ERROR_TYPES = {}
+
+
+def register_error(func_name=None, cls=None):
+    """Register an error class keyed by its name (base.py:92). Usable as a
+    bare decorator or with an explicit name."""
+    if callable(func_name):
+        cls, func_name = func_name, None
+
+    def deco(c):
+        _ERROR_TYPES[func_name or c.__name__] = c
+        return c
+    return deco(cls) if cls is not None else deco
+
+
+register = register_error
+
+
+@register_error
+class InternalError(MXNetError):
+    """Internal invariant violation (error.py:31)."""
+
+    def __init__(self, msg):
+        if "InternalError:" not in msg:
+            msg = f"InternalError: {msg}"
+        super().__init__(msg)
+
+
+def get_error_class(name):
+    return _ERROR_TYPES.get(name, MXNetError)

@@ -241,13 +241,9 @@ class RandomLighting(Block):
         self._alpha = alpha
 
     def forward(self, x):
-        from ....image import LightingAug
-        eigval = onp.array([55.46, 4.794, 1.148])
-        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
+        from ....image import LightingAug, _PCA_EIGVAL, _PCA_EIGVEC
         arr = x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
-        return LightingAug(self._alpha, eigval, eigvec)(arr)
+        return LightingAug(self._alpha, _PCA_EIGVAL, _PCA_EIGVEC)(arr)
 
 
 class Rotate(Block):

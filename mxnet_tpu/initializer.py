@@ -289,9 +289,11 @@ class FusedRNN(Initializer):
     def __init__(self, init, num_hidden, num_layers, mode,
                  bidirectional=False, forget_bias=1.0):
         if isinstance(init, str):
-            init = _REG.get(init)()
-        # serialize the inner init by registry name so dumps() round-trips
-        super().__init__(init=type(init).__name__.lower(),
+            init = self._resolve(init)
+        # serialize the inner init as its full dumps() payload (name +
+        # kwargs) so a round-trip rebuilds it with identical settings
+        super().__init__(init=init.dumps() if hasattr(init, "dumps")
+                         else type(init).__name__.lower(),
                          num_hidden=num_hidden,
                          num_layers=num_layers, mode=mode,
                          bidirectional=bidirectional, forget_bias=forget_bias)
@@ -301,6 +303,16 @@ class FusedRNN(Initializer):
         self._mode = mode
         self._bi = bidirectional
         self._forget_bias = forget_bias
+
+    @staticmethod
+    def _resolve(spec):
+        """Registry name ('xavier') or a dumps() payload
+        ('["xavier", {...}]') -> Initializer instance."""
+        try:
+            name, kwargs = json.loads(spec)
+            return _REG.get(name)(**kwargs)
+        except (ValueError, TypeError):
+            return _REG.get(spec)()
 
     def _init_weight(self, desc, arr):
         import numpy as onp

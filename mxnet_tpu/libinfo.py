@@ -1,0 +1,22 @@
+"""mx.libinfo (parity: python/mxnet/libinfo.py): version + library paths.
+The 'library' on this stack is the native runtime .so set under
+mxnet_tpu/native/."""
+from __future__ import annotations
+
+import os
+
+__version__ = "2.0.0"
+
+
+def find_lib_path(prefix="libmxtpu"):
+    """Paths of the native runtime libraries (libinfo.py:25 analog)."""
+    native = os.path.join(os.path.dirname(__file__), "native")
+    libs = [os.path.join(native, f) for f in sorted(os.listdir(native))
+            if f.startswith(prefix) and f.endswith(".so")] \
+        if os.path.isdir(native) else []
+    return libs
+
+
+def find_include_path():
+    """Header directory of the C ABI (libinfo.py:78 analog)."""
+    return os.path.join(os.path.dirname(__file__), "native")

@@ -1,0 +1,36 @@
+"""mx.log (parity: python/mxnet/log.py): logger factory with the PID/level
+format the reference uses."""
+from __future__ import annotations
+
+import logging
+import sys
+
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARNING = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+NOTSET = logging.NOTSET
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (log.py getLogger analog)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_init_done", False):
+        logger.setLevel(level)
+        return logger
+    logger._init_done = True
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger.propagate = False
+    return logger
+
+
+getLogger = get_logger  # reference spelling

@@ -1,0 +1,64 @@
+"""mx.registry (parity: python/mxnet/registry.py): generic per-base-class
+registries with register/alias/create, the machinery behind optimizer,
+initializer and metric registration."""
+from __future__ import annotations
+
+import json
+
+_REGISTRY = {}
+
+
+def get_registry(base_class):
+    """The name->class dict registered under base_class (registry.py:31)."""
+    return dict(_REGISTRY.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """A register() decorator factory for base_class (registry.py:48)."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            f"can only register subclasses of {base_class.__name__}"
+        key = (name or klass.__name__).lower()
+        registry[key] = klass
+        return klass
+    register.__doc__ = f"Register {base_class.__name__} to the {nickname} " \
+                       "factory"
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    """An alias() decorator factory (registry.py get_alias_func)."""
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """A create(name_or_instance, **kwargs) factory (registry.py
+    get_create_func); accepts an instance, a name, or a JSON
+    '[name, kwargs]' payload."""
+    if base_class not in _REGISTRY:
+        _REGISTRY[base_class] = {}
+    registry = _REGISTRY[base_class]
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        name = args[0] if args else kwargs.pop(nickname)
+        if isinstance(name, str) and name.startswith("["):
+            name, kw = json.loads(name)
+            kwargs.update(kw)
+        return registry[name.lower()](*args[1:], **kwargs)
+    create.__doc__ = f"Create a {base_class.__name__} instance from the " \
+                     f"{nickname} registry"
+    return create

