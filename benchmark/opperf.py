@@ -16,8 +16,12 @@ Usage:
 """
 import argparse
 import json
+import os
 import sys
 import time
+
+# runnable as a plain script from anywhere: the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as onp
 
