@@ -1,0 +1,28 @@
+"""Worker body for the 4-process dist_sync test: dense init/push/pull and
+fused pushpull must see contributions from all four ranks."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, size = kv.rank, kv.num_workers
+    assert size == 4, f"expected 4 workers, got {size}"
+    shape = (4, 8)
+    kv.init("w", nd.zeros(shape))
+    kv.push("w", nd.ones(shape) * (rank + 1))   # 1+2+3+4 = 10
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(shape, 10.0),
+                                rtol=1e-6)
+    val = nd.ones(shape) * (rank + 1)
+    kv.pushpull("pp", val, out=val)
+    onp.testing.assert_allclose(val.asnumpy(), onp.full(shape, 10.0),
+                                rtol=1e-6)
+    print(f"worker {rank}/4: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -92,3 +92,22 @@ def test_heartbeat_failure_detection(tmp_path):
         if kv is not None:
             kv.close()  # stop the beat thread; a closed store must go dead
         mx.config.set("MXNET_KVSTORE_HEARTBEAT_DIR", "")
+
+
+def test_dist_sync_kvstore_four_processes():
+    """4-worker dist_sync (the reference's launch.py -n 4 config,
+    tests/nightly/test_distributed_training-gpu.sh:27-34): dense pushpull
+    sums across all four workers."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env.pop("XLA_FLAGS", None)
+    worker = os.path.join(REPO, "tests", "dist_four_worker.py")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--launcher", "local", "--", sys.executable, worker],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"4-proc dist workers failed:\n{out}"
+    for rank in range(4):
+        assert f"worker {rank}/4: OK" in out, out
