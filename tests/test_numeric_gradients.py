@@ -191,3 +191,98 @@ def test_ctc_loss_gradient():
     check_numeric_gradient(
         lambda a: nd.CTCLoss(a, label).sum(), [act],
         eps=1e-2, rtol=5e-2, atol=5e-3)
+
+
+def test_deconvolution_gradient():
+    check_numeric_gradient(
+        lambda x, w: nd.Deconvolution(x, w, no_bias=True, kernel=(2, 2),
+                                      num_filter=2, stride=(2, 2)).sum(),
+        [_arr(1, 2, 4, 4), _arr(2, 2, 2, 2)], eps=1e-3, rtol=3e-2, atol=3e-3)
+
+
+def test_groupnorm_gradient():
+    check_numeric_gradient(
+        lambda x, g, b: nd.GroupNorm(x, g, b, num_groups=2).square().sum(),
+        [_arr(2, 4, 3, 3), _arr(4, offset=1.0), _arr(4)],
+        eps=1e-3, rtol=3e-2, atol=3e-3)
+
+
+def test_instancenorm_gradient():
+    # FD is too noisy against InstanceNorm's eps=1e-3 (reference default,
+    # instance_norm.cc); compare the VJP against jax.grad of a pure
+    # per-instance-norm reimplementation instead
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import autograd
+    x = _arr(2, 3, 4, 4)
+    g = _arr(3, offset=1.0)
+    b = _arr(3)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.InstanceNorm(x, g, b)
+        loss = y.square().sum()
+    loss.backward()
+
+    def pure(xv):
+        m = xv.mean(axis=(2, 3), keepdims=True)
+        v = xv.var(axis=(2, 3), keepdims=True)
+        xn = (xv - m) / jnp.sqrt(v + 1e-3)
+        out = xn * g.data.reshape(1, 3, 1, 1) + b.data.reshape(1, 3, 1, 1)
+        return (out ** 2).sum()
+    expected = jax.grad(pure)(x.data)
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.asarray(expected),
+                                rtol=2e-3, atol=2e-4)
+
+
+def test_batchnorm_train_gradient():
+    # train-mode BN: batch statistics participate in the gradient
+    gamma = _arr(3, offset=1.0)
+    beta = _arr(3)
+    mean = mx.nd.zeros((3,))
+    var = mx.nd.ones((3,))
+
+    def fn(x):
+        from mxnet_tpu import autograd
+        with autograd.record():
+            pass
+        out = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+        return out.square().sum()
+    # run the FD comparison inside a training scope so batch stats are used
+    from mxnet_tpu import autograd
+    x = _arr(4, 3, 2, 2)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BatchNorm(x, gamma, beta, mean, var, fix_gamma=False)
+        loss = y.square().sum()
+    loss.backward()
+    analytic = x.grad.asnumpy().copy()
+    import jax
+    import jax.numpy as jnp
+
+    def pure(xv):
+        m = xv.mean(axis=(0, 2, 3), keepdims=True)
+        v = xv.var(axis=(0, 2, 3), keepdims=True)
+        xn = (xv - m) / jnp.sqrt(v + 1e-5)
+        out = xn * gamma.data.reshape(1, 3, 1, 1) + \
+            beta.data.reshape(1, 3, 1, 1)
+        return (out ** 2).sum()
+    expected = jax.grad(pure)(x.data)
+    onp.testing.assert_allclose(analytic, onp.asarray(expected),
+                                rtol=2e-3, atol=2e-4)
+
+
+def test_roialign_gradient():
+    rois = mx.nd.array(onp.array([[0, 0.5, 0.5, 5.5, 5.5]], "float32"))
+
+    def fn(x):
+        from mxnet_tpu.ops.registry import apply_op
+        return apply_op("_contrib_ROIAlign", x, rois,
+                        pooled_size=(2, 2), spatial_scale=1.0).square().sum()
+    check_numeric_gradient(fn, [_arr(1, 2, 8, 8)], eps=1e-3, rtol=3e-2,
+                           atol=3e-3)
+
+
+def test_upsampling_gradient():
+    check_numeric_gradient(
+        lambda x: nd.UpSampling(x, scale=2, sample_type="nearest").square().sum(),
+        [_arr(1, 2, 3, 3)], eps=1e-3, rtol=2e-2, atol=2e-3)
