@@ -138,6 +138,68 @@ def _record_custom(inputs, outputs, vjp_fn):
 _VJP_CACHE: Dict[Any, Callable] = {}
 
 
+def _placement_scope(heads):
+    """Pin every array the reverse pass creates to the heads' own device.
+
+    Head cotangents / zero-fill cotangents are created with ``jnp.ones/zeros``,
+    which JAX would otherwise place on the *global* default device (the
+    accelerator). With CPU-resident primals that splits one VJP across two
+    backends and every node round-trips host<->device — the reference keeps
+    the whole backward on the array's own context (imperative.cc:376 runs on
+    each op's recorded ctx), and so must we.
+    """
+    import jax
+    from .ndarray.ndarray import NDArray
+    for h in heads:
+        if isinstance(h, NDArray):
+            devs = h.data.devices()
+            if len(devs) == 1:  # sharded heads keep their sharding; skip pin
+                return jax.default_device(next(iter(devs)))
+            break
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class _OnesCot:
+    """Static marker for a default (all-ones) head cotangent.
+
+    Kept symbolic until it reaches the VJP so the ones enter the jitted
+    pullback as a traced constant — XLA folds ``dy * 1`` away and the whole
+    backward of a unary head is one fused pass instead of fill+compute+mul.
+    Carries the head's device so materialization never lands on the global
+    default device (each head keeps its own context in multi-device tapes).
+    """
+    __slots__ = ("shape", "dtype", "device")
+
+    def __init__(self, shape, dtype, device=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.device = device
+
+    def materialize(self):
+        import jax
+        import jax.numpy as jnp
+        if self.device is not None:
+            with jax.default_device(self.device):
+                return jnp.ones(self.shape, self.dtype)
+        return jnp.ones(self.shape, self.dtype)
+
+
+def _head_cot(h):
+    """Default cotangent for a head: symbolic ones pinned to the head's device."""
+    devs = h.data.devices()
+    dev = next(iter(devs)) if len(devs) == 1 else None
+    return _OnesCot(h.shape, h.data.dtype, dev)
+
+
+def _mat(c):
+    return c.materialize() if isinstance(c, _OnesCot) else c
+
+
+def _is_array_cot(c):
+    return c is not None and not isinstance(c, _OnesCot)
+
+
 def _node_vjp(node: TapeNode, out_cots: List):
     """Compute input cotangents for one tape node. Returns list aligned to node.inputs."""
     import jax
@@ -145,7 +207,7 @@ def _node_vjp(node: TapeNode, out_cots: List):
     from .ndarray.ndarray import NDArray
 
     if node.custom_vjp is not None:
-        return node.custom_vjp(out_cots)
+        return node.custom_vjp([_mat(c) for c in out_cots])
 
     # Embedding with sparse_grad: the weight cotangent stays as (ids, rows)
     # parts instead of a dense scatter into the full (vocab, dim) table
@@ -157,7 +219,7 @@ def _node_vjp(node: TapeNode, out_cots: List):
         from .sparse import SparseCotangent
         idx = node.inputs[0].data.reshape(-1).astype(jnp.int32)
         dim = node.outputs[0].shape[-1]
-        cot = out_cots[0].reshape(-1, dim)
+        cot = _mat(out_cots[0]).reshape(-1, dim)
         return [None, SparseCotangent([(idx, cot)], node.inputs[1].shape)]
 
     from .ops import registry as _reg
@@ -172,9 +234,17 @@ def _node_vjp(node: TapeNode, out_cots: List):
                           if x is not None and not isinstance(x, NDArray))
     jax_inputs = tuple(x.data if isinstance(x, NDArray) else x
                        for x in node.inputs if x is not None)
+    # absent (None) and all-ones output cotangents stay OUT of the traced
+    # arguments: both become traced constants inside the jitted pullback, so
+    # XLA folds `dy*1` / drops zero branches instead of us materializing and
+    # shipping filler arrays every call.
+    const_cots = tuple(
+        ("ones" if isinstance(c, _OnesCot) else "zeros") if not _is_array_cot(c)
+        else None
+        for c in out_cots)
     try:
         key = (node.op.name, _reg._freeze(node.attrs), none_slots,
-               nondiff_slots,
+               nondiff_slots, const_cots,
                tuple((getattr(a, "shape", ()), str(getattr(a, "dtype", type(a))))
                      for a in jax_inputs))
         hash(key)
@@ -191,12 +261,15 @@ def _node_vjp(node: TapeNode, out_cots: List):
                 full = [None if i in _slots else next(it) for i in range(_n)]
                 return _base(*full)
 
-        def vjp_all(primals, cots):
+        def vjp_all(primals, cots, _consts=const_cots):
             out, pullback = jax.vjp(fn, *primals)
             outs = out if isinstance(out, (list, tuple)) else (out,)
+            it = iter(cots)
             full_cots = tuple(
-                c if c is not None else jnp.zeros(o.shape, o.dtype)
-                for c, o in zip(cots, outs))
+                (jnp.ones(o.shape, o.dtype) if kind == "ones"
+                 else jnp.zeros(o.shape, o.dtype)) if kind is not None
+                else next(it)
+                for kind, o in zip(_consts, outs))
             return pullback(full_cots if isinstance(out, (list, tuple)) else full_cots[0])
 
         if key is not None:
@@ -205,11 +278,7 @@ def _node_vjp(node: TapeNode, out_cots: List):
         else:
             vjp_exec = vjp_all
 
-    outs = node.outputs
-    cots = tuple(
-        out_cots[i] if out_cots[i] is not None
-        else jnp.zeros(outs[i].shape, outs[i].data.dtype)
-        for i in range(len(outs)))
+    cots = tuple(c for c, kind in zip(out_cots, const_cots) if kind is None)
     dense = list(vjp_exec(jax_inputs, cots))
     if none_slots or nondiff_slots:
         it = iter(dense)
@@ -229,6 +298,8 @@ def _write_grad(x, val):
     """Store an accumulated cotangent into x._grad honouring grad_req and the
     grad buffer's storage type (dense vs row_sparse)."""
     from .sparse import BaseSparseNDArray, RowSparseNDArray, SparseCotangent
+
+    val = _mat(val)
 
     if isinstance(val, SparseCotangent):
         if isinstance(x._grad, RowSparseNDArray):
@@ -269,29 +340,13 @@ def _write_grad(x, val):
         x._grad._set_data(g)
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
-    """Reverse pass from `heads` through the tape (autograd.py:244)."""
+def _accumulate(tape, cots):
+    """Walk the tape in reverse, accumulating input cotangents into ``cots``
+    (keyed by id(NDArray); tape nodes keep the arrays alive)."""
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
     from .sparse import SparseCotangent
 
-    if isinstance(heads, NDArray):
-        heads = [heads]
-        if head_grads is not None and isinstance(head_grads, NDArray):
-            head_grads = [head_grads]
-    if head_grads is None:
-        head_grads = [None] * len(heads)
-
-    # cotangent accumulator keyed by id(NDArray); tape nodes keep arrays alive
-    cots: Dict[int, Any] = {}
-    for h, hg in zip(heads, head_grads):
-        if getattr(h, "_tape_node", None) is None and h._grad_req == "null":
-            raise MXNetError("cannot differentiate a head that was not recorded")
-        g = hg.data if isinstance(hg, NDArray) else (
-            hg if hg is not None else jnp.ones(h.shape, h.data.dtype))
-        cots[id(h)] = g
-
-    tape = _STATE.tape
     for node in reversed(tape):
         out_cots = [cots.get(id(o)) for o in node.outputs]
         if all(c is None for c in out_cots):
@@ -306,22 +361,46 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if prev is None:
                 cots[id(x)] = g
             elif isinstance(g, SparseCotangent):
-                cots[id(x)] = g + prev  # sparse-aware merge / densify
+                cots[id(x)] = g + _mat(prev)  # sparse-aware merge / densify
             else:
-                cots[id(x)] = prev + g
+                cots[id(x)] = _mat(prev) + g
 
-    # write accumulated cotangents into .grad respecting grad_req
-    seen = set()
-    for node in tape:
-        for x in node.inputs + node.outputs:
-            if id(x) in seen or not isinstance(x, NDArray):
-                continue
-            seen.add(id(x))
-            if x._grad is not None and x._grad_req != "null" and id(x) in cots:
-                _write_grad(x, cots[id(x)])
-    for h in heads:  # heads that are themselves leaves
-        if id(h) not in seen and h._grad is not None and id(h) in cots:
-            _write_grad(h, cots[id(h)])
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse pass from `heads` through the tape (autograd.py:244)."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    cots: Dict[int, Any] = {}
+    with _placement_scope(heads):
+        for h, hg in zip(heads, head_grads):
+            if getattr(h, "_tape_node", None) is None and h._grad_req == "null":
+                raise MXNetError("cannot differentiate a head that was not recorded")
+            g = hg.data if isinstance(hg, NDArray) else (
+                hg if hg is not None else _head_cot(h))
+            cots[id(h)] = g
+
+        tape = _STATE.tape
+        _accumulate(tape, cots)
+
+        # write accumulated cotangents into .grad respecting grad_req
+        seen = set()
+        for node in tape:
+            for x in node.inputs + node.outputs:
+                if id(x) in seen or not isinstance(x, NDArray):
+                    continue
+                seen.add(id(x))
+                if x._grad is not None and x._grad_req != "null" and id(x) in cots:
+                    _write_grad(x, cots[id(x)])
+        for h in heads:  # heads that are themselves leaves
+            if id(h) not in seen and h._grad is not None and id(h) in cots:
+                _write_grad(h, cots[id(h)])
 
     if not retain_graph:
         for node in tape:
@@ -334,7 +413,6 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
          train_mode=True):
     """Return gradients of heads w.r.t. variables without touching .grad
     (autograd.py:271). create_graph (higher-order) is supported by re-recording."""
-    import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
     from .sparse import SparseCotangent
 
@@ -349,39 +427,24 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         head_grads = [head_grads]
 
     cots: Dict[int, Any] = {}
-    for h, hg in zip(heads, head_grads):
-        g = hg.data if isinstance(hg, NDArray) else (
-            hg if hg is not None else jnp.ones(h.shape, h.data.dtype))
-        cots[id(h)] = g
-
     retain = create_graph if retain_graph is None else retain_graph
-    for node in reversed(_STATE.tape):
-        out_cots = [cots.get(id(o)) for o in node.outputs]
-        if all(c is None for c in out_cots):
-            continue
-        in_cots = _node_vjp(node, out_cots)
-        for x, g in zip(node.inputs, in_cots):
-            if g is None or not isinstance(x, NDArray):
-                continue
-            if not jnp.issubdtype(x.data.dtype, jnp.inexact):
-                continue
-            prev = cots.get(id(x))
-            if prev is None:
-                cots[id(x)] = g
-            elif isinstance(g, SparseCotangent):
-                cots[id(x)] = g + prev  # sparse-aware merge / densify
-            else:
-                cots[id(x)] = prev + g
+    with _placement_scope(heads):
+        for h, hg in zip(heads, head_grads):
+            g = hg.data if isinstance(hg, NDArray) else (
+                hg if hg is not None else _head_cot(h))
+            cots[id(h)] = g
+        _accumulate(_STATE.tape, cots)
 
     results = []
-    for v in variables:
-        if id(v) not in cots:
-            raise MXNetError("one of the variables is unreachable from heads")
-        c = cots[id(v)]
-        if isinstance(c, SparseCotangent):
-            results.append(c.to_row_sparse(ctx=v.context))
-        else:
-            results.append(NDArray(c, ctx=v.context))
+    with _placement_scope(heads):
+        for v in variables:
+            if id(v) not in cots:
+                raise MXNetError("one of the variables is unreachable from heads")
+            c = cots[id(v)]
+            if isinstance(c, SparseCotangent):
+                results.append(c.to_row_sparse(ctx=v.context))
+            else:
+                results.append(NDArray(_mat(c), ctx=v.context))
     if not retain:
         for node in _STATE.tape:
             for o in node.outputs:
