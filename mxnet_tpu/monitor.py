@@ -1,108 +1,117 @@
-"""Monitor outputs, weights, and gradients for debugging (parity:
-python/mxnet/monitor.py:32 Monitor — interval/stat_func/pattern/sort/
-monitor_all surface, install → tic → forward → toc(_print) workflow).
+"""Per-tensor statistics monitor (public surface parity:
+python/mxnet/monitor.py Monitor — interval/stat_func/pattern/sort/monitor_all,
+install/tic/toc/toc_print).
 
-TPU-native: the reference registers a ctypes callback the C++ executor fires
-per op; here the graph Executor calls the monitor callback as it walks the
-symbol DAG (symbol/executor.py:_eval_graph), with the same name convention
-(``<node>_output``, plus ``<node>_input<i>`` under ``monitor_all``). Stats
-stay lazy jax values until ``toc`` syncs them, mirroring the reference's
-async stat computation.
+TPU-native design, built on this repo's instrumentation-sink pattern (the same
+shape as profiler._dispatch_profiled): a ``Monitor`` is a *sink* of
+``(step, name, lazy stat)`` samples organised as per-name channels. Sources
+push into the sink; the sink never blocks:
+
+* graph executors: ``Executor.set_monitor_callback`` feeds activations as the
+  DAG is walked (``<node>_output``, and ``<node>_input<i>`` with
+  ``monitor_all``);
+* parameter/aux snapshots: drained from each installed executor's
+  ``arg_dict``/``aux_dict`` when a window closes.
+
+Stat values stay device-lazy (one small reduction appended to the async
+stream per tensor); nothing synchronises until the window is rendered in
+``toc``. This keeps monitoring off the dispatch critical path — the property
+the reference gets from computing stats inside the engine workers.
 """
 from __future__ import annotations
 
 import logging
 import re
-from math import sqrt
-
-from .ndarray.ndarray import NDArray
+from collections import OrderedDict
 
 __all__ = ["Monitor"]
 
 
-class Monitor:
-    """Monitor inputs, outputs, weights and gradients of bound executors.
+def _mean_abs(x):
+    """Default statistic: mean absolute value, as an on-device scalar."""
+    from . import ndarray as F
+    return F.norm(x) / (x.size ** 0.5)
 
-    Parameters
-    ----------
-    interval : int
-        Number of batches between collections.
-    stat_func : callable(NDArray) -> NDArray, optional
-        Statistic; default mean absolute value ``norm(x)/sqrt(size)``.
-    pattern : str
-        Regex selecting tensor names to monitor.
-    sort : bool
-        Sort results by name in ``toc``.
-    monitor_all : bool
-        Also monitor op inputs, not just outputs.
+
+def _render(stat):
+    """Format one captured stat (NDArray | list of NDArray) as the tab-joined
+    string surface the reference's log readers expect."""
+    from .ndarray.ndarray import NDArray
+    vals = stat if isinstance(stat, (list, tuple)) else [stat]
+    pieces = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            v = v.asscalar() if v.size == 1 else v.asnumpy()
+        pieces.append(str(v))
+    return "\t".join(pieces) + "\t"
+
+
+class Monitor:
+    """Watch outputs, weights and gradients of bound executors.
+
+    ``interval`` — tic calls between open collection windows; ``stat_func`` —
+    statistic per tensor (default mean absolute value); ``pattern`` — regex
+    filter on tensor names; ``sort`` — render channels in name order;
+    ``monitor_all`` — record op inputs too, not only outputs.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
                  monitor_all=False):
-        if stat_func is None:
-            def asum_stat(x):
-                from . import ndarray as nd_mod
-                return nd_mod.norm(x) / sqrt(x.size)
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _mean_abs
         self.sort = sort
         self.monitor_all = monitor_all
+        self.re_prog = re.compile(pattern)
+        self.step = 0
+        self.activated = False       # window state; public for parity
+        self._channels: "OrderedDict[str, list]" = OrderedDict()
+        self._sources = []           # installed executors (param snapshots)
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            from . import autograd
-            with autograd.pause():  # stats must not land on the gradient tape
-                self.queue.append((self.step, name, self.stat_func(array)))
-        self.stat_helper = stat_helper
+    # -- sink --------------------------------------------------------------
+    def _capture(self, name, array):
+        """Record one sample if the window is open and the name matches."""
+        if not (self.activated and self.re_prog.match(name)):
+            return
+        from . import autograd
+        with autograd.pause():       # stat reductions stay off the grad tape
+            stat = self.stat_func(array)
+        self._channels.setdefault(name, []).append((self.step, stat))
 
+    # -- sources -----------------------------------------------------------
     def install(self, exe):
-        """Install the callback into an Executor (symbol.bind result)."""
-        exe.set_monitor_callback(self.stat_helper, self.monitor_all)
-        self.exes.append(exe)
+        """Attach a bound Executor as a sample source."""
+        exe.set_monitor_callback(self._capture, self.monitor_all)
+        self._sources.append(exe)
 
+    def _snapshot_params(self):
+        """Push one sample per matching argument/aux of every source."""
+        for exe in self._sources:
+            for mapping in (exe.arg_dict, exe.aux_dict):
+                for name, arr in mapping.items():
+                    self._capture(name, arr)
+
+    # -- window control ----------------------------------------------------
     def tic(self):
-        """Start collecting for the current batch; call before forward."""
+        """Advance one step; open a collection window every `interval` steps."""
         if self.step % self.interval == 0:
-            self.queue = []
+            self._channels.clear()
             self.activated = True
         self.step += 1
 
     def toc(self):
-        """Finish collecting; returns list of (step, name, value-string)."""
+        """Close the window and render it: list of (step, name, stat-string)."""
         if not self.activated:
             return []
-        for exe in self.exes:
-            for name, array in zip(exe._symbol.list_arguments(),
-                                   exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-            for name, array in zip(exe._symbol.list_auxiliary_states(),
-                                   exe.aux_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+        self._snapshot_params()
         self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            s = ""
-            for v in v_list:
-                s += (str(v.asscalar()) if v.size == 1 else str(v.asnumpy())) \
-                    + "\t"
-            res.append((n, k, s))
-        self.queue = []
-        return res
+        names = sorted(self._channels) if self.sort else list(self._channels)
+        rows = [(step, name, _render(stat))
+                for name in names
+                for step, stat in self._channels[name]]
+        self._channels.clear()
+        return rows
 
     def toc_print(self):
-        """Finish collecting and log the results."""
-        for n, k, v in self.toc():
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """Close the window and log every rendered row."""
+        for step, name, text in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, text)
