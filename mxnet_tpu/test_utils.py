@@ -121,16 +121,33 @@ def _to_jax(np_arr, like):
 
 
 def check_consistency(fn, inputs_np: List[onp.ndarray], ctx_list: List[Context],
-                      dtypes=("float32",), rtol=None, atol=None):
+                      dtypes=("float32",), rtol=None, atol=None, grad=False):
     """Cross-context/dtype oracle (test_utils.py:1428 pattern): run `fn` on every
-    (ctx, dtype) pair and compare results against the first."""
+    (ctx, dtype) pair and compare results against the first. With ``grad=True``
+    also records the call, backwards it with all-ones head cotangents, and
+    compares every input gradient across the pairs (the reference oracle
+    compares forward AND backward across contexts)."""
+    from . import autograd
+
     results = []
     for ctx in ctx_list:
         for dtype in dtypes:
             args = [NDArray(a, ctx=ctx, dtype=dtype) for a in inputs_np]
-            out = fn(*args)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            results.append([o.asnumpy().astype(onp.float64) for o in outs])
+            if grad:
+                for a in args:
+                    a.attach_grad()
+                with autograd.record():
+                    out = fn(*args)
+                    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+                autograd.backward(outs)
+                row = [o.asnumpy().astype(onp.float64) for o in outs]
+                row += [a.grad.asnumpy().astype(onp.float64) for a in args
+                        if a.grad is not None]
+            else:
+                out = fn(*args)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                row = [o.asnumpy().astype(onp.float64) for o in outs]
+            results.append(row)
     ref = results[0]
     for got in results[1:]:
         for r, g in zip(ref, got):

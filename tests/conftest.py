@@ -3,24 +3,33 @@ exercised without TPU hardware (SURVEY.md test strategy; the reference's
 CPU-default + context-parametrized pattern, tests/python/gpu/test_operator_gpu.py)."""
 import os
 
-# The tests must run on a virtual 8-device CPU mesh, not the tunneled TPU chip
-# (its per-op dispatch latency makes eager tests ~100x slower, and the tunnel is
-# single-tenant). The TPU plugin's sitecustomize (on PYTHONPATH) registers the
-# PJRT plugin at *interpreter startup* and pins jax_platforms via jax.config —
-# the env var alone is ignored. Override the config value back to cpu before the
-# first backend initialization; XLA_FLAGS is read at CPU-client init so setting
-# it here (pre-init) still takes effect.
-_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
-          if not f.startswith("--xla_force_host_platform_device_count")]
-os.environ["XLA_FLAGS"] = " ".join(_flags + ["--xla_force_host_platform_device_count=8"])
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Cross-context oracle mode (tools/cross_context_check.py): keep BOTH the
+# accelerator and CPU platforms registered and run the op families under the
+# TPU default context — the reference's test_operator_gpu.py trick of
+# re-running the CPU suite under a second context (SURVEY §4).
+_CROSS_CTX = os.environ.get("MXNET_TPU_CROSS_CTX") == "1"
+
+if not _CROSS_CTX:
+    # The tests must run on a virtual 8-device CPU mesh, not the tunneled TPU
+    # chip (its per-op dispatch latency makes eager tests ~100x slower, and the
+    # tunnel is single-tenant). The TPU plugin's sitecustomize (on PYTHONPATH)
+    # registers the PJRT plugin at *interpreter startup* and pins jax_platforms
+    # via jax.config — the env var alone is ignored. Override the config value
+    # back to cpu before the first backend initialization; XLA_FLAGS is read at
+    # CPU-client init so setting it here (pre-init) still takes effect.
+    _flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+              if not f.startswith("--xla_force_host_platform_device_count")]
+    os.environ["XLA_FLAGS"] = " ".join(
+        _flags + ["--xla_force_host_platform_device_count=8"])
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-if len(jax.devices()) < 8 or jax.devices()[0].platform != "cpu":  # pragma: no cover
-    raise RuntimeError("test process failed to get the 8-device CPU mesh: "
-                       f"{jax.devices()}")
+if not _CROSS_CTX:
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 8 or jax.devices()[0].platform != "cpu":  # pragma: no cover
+        raise RuntimeError("test process failed to get the 8-device CPU mesh: "
+                           f"{jax.devices()}")
 
 import warnings
 
@@ -33,4 +42,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def ctx():
     import mxnet_tpu as mx
-    return mx.cpu()
+    return mx.tpu(0) if _CROSS_CTX else mx.cpu()
+
+
+if _CROSS_CTX:
+    @pytest.fixture(autouse=True)
+    def _tpu_default_context():
+        """Every test runs with the accelerator as the default context, so all
+        nd/np creations and eager ops exercise the TPU lowering while the
+        numpy-side expected values stay host-computed — the CPU<->TPU oracle."""
+        import mxnet_tpu as mx
+        with mx.tpu(0):
+            yield
