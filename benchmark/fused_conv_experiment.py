@@ -1,0 +1,76 @@
+"""Decision-gate experiment for the conv+BN Pallas epilogue work (round 4).
+
+Compares the fused Pallas kernel (prologue affine+relu, 1x1 GEMM, moment
+epilogue — mxnet_tpu/ops/pallas/fused_conv1x1.py) against the identical
+unfused XLA chain on every distinct 1x1-conv shape of ResNet-50 at batch 128.
+Timing: amortized windows closed by a value fetch (PERF.md methodology).
+
+Run on the TPU host:  python benchmark/fused_conv_experiment.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.pallas.fused_conv1x1 import (
+    conv1x1_bn_act, conv1x1_bn_act_reference)
+
+# (label, M = batch*H*W, K = Cin, N = Cout) — ResNet-50 v1 @224, batch 128
+SHAPES = [
+    ("s2_reduce", 128 * 56 * 56, 64, 64),
+    ("s2_expand", 128 * 56 * 56, 64, 256),
+    ("s2_in", 128 * 56 * 56, 256, 64),
+    ("s3_in", 128 * 28 * 28, 512, 128),
+    ("s3_expand", 128 * 28 * 28, 128, 512),
+    ("s4_in", 128 * 14 * 14, 1024, 256),
+    ("s4_expand", 128 * 14 * 14, 256, 1024),
+    ("s5_in", 128 * 7 * 7, 2048, 512),
+    ("s5_expand", 128 * 7 * 7, 512, 2048),
+]
+
+
+def _amortize(fn, args, n=30, windows=3):
+    outs = fn(*args)
+    _ = float(outs[0].ravel()[0].astype(jnp.float32))
+    meds = []
+    for _w in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            outs = fn(*args)
+        _ = float(outs[0].ravel()[0].astype(jnp.float32))
+        meds.append((time.perf_counter() - t0) / n * 1e3)
+    meds.sort()
+    return meds[len(meds) // 2]
+
+
+def main():
+    rng = onp.random.RandomState(0)
+    jax.jit(lambda: jnp.zeros(()))()  # wake the backend
+    print(f"{'shape':12s} {'M':>8s} {'K':>5s} {'N':>5s} "
+          f"{'XLA ms':>8s} {'Pallas ms':>10s} {'speedup':>8s}")
+    tot_x = tot_p = 0.0
+    reference = jax.jit(conv1x1_bn_act_reference, static_argnames=("relu",))
+    for label, m, k, n in SHAPES:
+        x = jnp.asarray(rng.rand(m, k).astype("float32") - 0.3, jnp.bfloat16)
+        w = jnp.asarray(rng.rand(k, n).astype("float32") * 0.05, jnp.bfloat16)
+        s = jnp.asarray(rng.rand(k).astype("float32") + 0.5)
+        t = jnp.asarray(rng.rand(k).astype("float32") - 0.5)
+        bm = 448 if m % 448 == 0 else 512
+        tx = _amortize(reference, (x, w, s, t))
+        tp = _amortize(
+            lambda *a: conv1x1_bn_act(*a, block_m=bm), (x, w, s, t))
+        tot_x += tx
+        tot_p += tp
+        print(f"{label:12s} {m:8d} {k:5d} {n:5d} {tx:8.3f} {tp:10.3f} "
+              f"{tx / tp:7.2f}x")
+    print(f"{'TOTAL':12s} {'':8s} {'':5s} {'':5s} {tot_x:8.3f} {tot_p:10.3f} "
+          f"{tot_x / tot_p:7.2f}x")
+
+
+if __name__ == "__main__":
+    main()

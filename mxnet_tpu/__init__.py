@@ -7,6 +7,19 @@ collectives via jax.sharding. See SURVEY.md for the component-by-component mappi
 """
 __version__ = "2.0.0"
 
+import os as _os
+
+# MXNet float32 means float32: the reference's fp32 CUDA/MKLDNN kernels
+# accumulate in full precision, but XLA:TPU lowers f32 matmuls/convs to
+# bf16 MXU passes by default, silently giving fp32 users ~3-digit results
+# (caught by the CPU<->TPU cross-context oracle, tests/test_cross_context.py).
+# Default to full-precision f32 contractions; perf-critical paths opt into
+# bf16 explicitly via dtypes/AMP (all shipped benches do), which this flag
+# does not affect. Override with MXNET_MATMUL_PRECISION=default|high|highest.
+import jax as _jax
+_jax.config.update("jax_default_matmul_precision",
+                   _os.environ.get("MXNET_MATMUL_PRECISION", "highest"))
+
 from .base import Context, MXNetError, cpu, gpu, tpu, num_gpus, current_context
 from . import base
 from . import ops

@@ -1,14 +1,30 @@
 """contrib.io (parity: contrib/io.py): DataLoaderIter — wrap a gluon
 DataLoader in the legacy DataIter interface."""
+from ..base import MXNetError
 from ..io import DataIter, DataBatch, DataDesc
 
 
 class DataLoaderIter(DataIter):
     """Iterate a gluon DataLoader as a Module-compatible DataIter
-    (contrib/io.py DataLoaderIter)."""
+    (contrib/io.py DataLoaderIter).
+
+    The loader must be re-iterable (``iter(loader)`` restarts from the top,
+    as gluon DataLoaders do): construction consumes one probe batch to infer
+    shapes/dtypes, then restarts. A one-shot generator would silently lose
+    its first batch, so it is rejected.
+    """
 
     def __init__(self, loader, data_name="data", label_name="softmax_label"):
-        first = next(iter(loader))
+        try:
+            first = next(iter(loader))
+        except StopIteration:
+            raise MXNetError("DataLoaderIter: the loader is empty (no batches "
+                             "to infer shapes from)") from None
+        if iter(loader) is iter(loader):
+            raise MXNetError(
+                "DataLoaderIter needs a re-iterable loader (a gluon "
+                "DataLoader); a one-shot generator would lose the probe "
+                "batch consumed for shape inference")
         data, label = first[0], first[1] if len(first) > 1 else None
         # gluon DataLoader exposes no batch_size attribute; the leading dim
         # of a real batch is the ground truth

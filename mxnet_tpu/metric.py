@@ -306,41 +306,64 @@ class NegativeLogLikelihood(EvalMetric):
 @register("mcc")
 class MCC(EvalMetric):
     """Matthews correlation coefficient for binary classification
-    (metric.py:838): (TP·TN − FP·FN) / sqrt((TP+FP)(TP+FN)(TN+FP)(TN+FN)),
-    accumulated over the confusion counts."""
+    (metric.py:838): (TP·TN − FP·FN) / sqrt((TP+FP)(TP+FN)(TN+FP)(TN+FN)).
 
-    def __init__(self, name="mcc", **kwargs):
+    ``average='macro'`` (reference default, metric.py:868-871) averages the
+    per-batch MCC; ``'micro'`` computes one MCC over confusion counts
+    accumulated across all batches."""
+
+    def __init__(self, name="mcc", average="macro", **kwargs):
+        if average not in ("macro", "micro"):
+            raise ValueError(f"average must be 'macro' or 'micro', got {average!r}")
         super().__init__(name, **kwargs)
+        self._average = average
         self._tp = self._tn = self._fp = self._fn = 0.0
 
     def reset(self):
         super().reset()
         self._tp = self._tn = self._fp = self._fn = 0.0
 
-    def update(self, labels, preds):
+    @staticmethod
+    def _mcc(tp, tn, fp, fn):
         import numpy as onp
+        denom = onp.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return 0.0 if denom == 0 else (tp * tn - fp * fn) / denom
+
+    def update(self, labels, preds):
         for label, pred in zip(_listify(labels), _listify(preds)):
             l = _as_numpy(label).astype(int).ravel()
             p = _as_numpy(pred)
             yhat = p.reshape(l.size, -1).argmax(-1) if p.ndim > 1 and \
                 p.shape[-1] > 1 else (p.ravel() > 0.5).astype(int)
-            self._tp += float(((yhat == 1) & (l == 1)).sum())
-            self._tn += float(((yhat == 0) & (l == 0)).sum())
-            self._fp += float(((yhat == 1) & (l == 0)).sum())
-            self._fn += float(((yhat == 0) & (l == 1)).sum())
-            self.num_inst = 1
-        denom = onp.sqrt((self._tp + self._fp) * (self._tp + self._fn) *
-                         (self._tn + self._fp) * (self._tn + self._fn))
-        self.sum_metric = 0.0 if denom == 0 else \
-            (self._tp * self._tn - self._fp * self._fn) / denom
+            tp = float(((yhat == 1) & (l == 1)).sum())
+            tn = float(((yhat == 0) & (l == 0)).sum())
+            fp = float(((yhat == 1) & (l == 0)).sum())
+            fn = float(((yhat == 0) & (l == 1)).sum())
+            if self._average == "macro":
+                self.sum_metric += self._mcc(tp, tn, fp, fn)
+                self.num_inst += 1
+            else:
+                self._tp += tp
+                self._tn += tn
+                self._fp += fp
+                self._fn += fn
+                self.sum_metric = self._mcc(self._tp, self._tn,
+                                            self._fp, self._fn)
+                self.num_inst = 1
 
 
 @register("pcc")
 class PCC(EvalMetric):
     """Multiclass MCC generalization — the Pearson correlation of the
-    k×k confusion matrix (metric.py:1527)."""
+    k×k confusion matrix (metric.py:1527). Micro-accumulated only, like the
+    reference (its PCC takes no ``average`` parameter, metric.py:1579); an
+    explicit ``average`` kwarg is rejected rather than silently ignored."""
 
-    def __init__(self, name="pcc", **kwargs):
+    def __init__(self, name="pcc", average=None, **kwargs):
+        if average not in (None, "micro"):
+            raise NotImplementedError(
+                "PCC accumulates one confusion matrix across batches "
+                "(micro); per-batch 'macro' averaging is not supported")
         super().__init__(name, **kwargs)
         self._cm = None
 

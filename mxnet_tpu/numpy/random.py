@@ -90,6 +90,33 @@ def multinomial(n, pvals, size=None):
 # sampler family: bernoulli/gumbel/laplace/logistic/pareto/rayleigh/weibull/
 # beta/chisquare/f/power/lognormal; jax.random-backed on the threefry chain)
 # ---------------------------------------------------------------------------
+def _param(v):
+    """Coerce a distribution parameter: NDArray / array-like -> jnp array so
+    arithmetic broadcasts correctly (reference accepts tensor params); python
+    scalars pass through untouched."""
+    import numpy as onp
+    import jax
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    if isinstance(v, NDArray):
+        return v.data.astype(jnp.float32)
+    if isinstance(v, (list, tuple, onp.ndarray, jax.Array)):
+        return jnp.asarray(v, jnp.float32)
+    return v
+
+
+def _psize(size, *params):
+    """numpy semantics: with size=None, the sample shape is the broadcast
+    shape of the (array) parameters."""
+    import jax.numpy as jnp
+    if size is not None:
+        return size
+    shapes = [p.shape for p in params if hasattr(p, "shape")]
+    if not shapes:
+        return None
+    return jnp.broadcast_shapes(*shapes) or None
+
+
 def _draw(sampler, size, dtype=None):
     import jax.numpy as jnp
     from ..base import DTypes
@@ -103,58 +130,75 @@ def _draw(sampler, size, dtype=None):
 
 def bernoulli(prob, size=None, dtype=None, ctx=None, device=None, out=None):
     import jax
-    return _draw(lambda k, s: jax.random.bernoulli(k, prob, s), size, dtype)
+    prob = _param(prob)
+    return _draw(lambda k, s: jax.random.bernoulli(k, prob, s),
+                 _psize(size, prob), dtype)
 
 
 def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: loc + scale * jax.random.gumbel(k, s), size, dtype)
+    loc, scale = _param(loc), _param(scale)
+    return _draw(lambda k, s: loc + scale * jax.random.gumbel(k, s),
+                 _psize(size, loc, scale), dtype)
 
 
 def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: loc + scale * jax.random.laplace(k, s), size, dtype)
+    loc, scale = _param(loc), _param(scale)
+    return _draw(lambda k, s: loc + scale * jax.random.laplace(k, s),
+                 _psize(size, loc, scale), dtype)
 
 
 def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: loc + scale * jax.random.logistic(k, s), size, dtype)
+    loc, scale = _param(loc), _param(scale)
+    return _draw(lambda k, s: loc + scale * jax.random.logistic(k, s),
+                 _psize(size, loc, scale), dtype)
 
 
 def pareto(a=1.0, size=None, dtype=None, ctx=None, out=None):
     # numpy semantics: Lomax (Pareto II) — (1-U)^(-1/a) - 1
     import jax
     import jax.numpy as jnp
+    a = _param(a)
     return _draw(lambda k, s: jnp.exp(jax.random.exponential(k, s) / a) - 1.0,
-                 size, dtype)
+                 _psize(size, a), dtype)
 
 
 def rayleigh(scale=1.0, size=None, dtype=None, ctx=None, out=None):
     import jax
     import jax.numpy as jnp
+    scale = _param(scale)
     return _draw(lambda k, s: scale * jnp.sqrt(2.0 * jax.random.exponential(k, s)),
-                 size, dtype)
+                 _psize(size, scale), dtype)
 
 
 def weibull(a, size=None, dtype=None, ctx=None, out=None):
     import jax
     import jax.numpy as jnp
+    a = _param(a)
     return _draw(lambda k, s: jax.random.exponential(k, s) ** (1.0 / a),
-                 size, dtype)
+                 _psize(size, a), dtype)
 
 
 def beta(a, b, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: jax.random.beta(k, a, b, s), size, dtype)
+    a, b = _param(a), _param(b)
+    return _draw(lambda k, s: jax.random.beta(k, a, b, s),
+                 _psize(size, a, b), dtype)
 
 
 def chisquare(df, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: 2.0 * jax.random.gamma(k, df / 2.0, s), size, dtype)
+    df = _param(df)
+    return _draw(lambda k, s: 2.0 * jax.random.gamma(k, df / 2.0, s),
+                 _psize(size, df), dtype)
 
 
 def f(dfnum, dfden, size=None, dtype=None, ctx=None, out=None):
     import jax
+    dfnum, dfden = _param(dfnum), _param(dfden)
+    size = _psize(size, dfnum, dfden)
     def sampler(k, s):
         k1, k2 = jax.random.split(k)
         num = jax.random.gamma(k1, dfnum / 2.0, s) / dfnum
@@ -165,19 +209,24 @@ def f(dfnum, dfden, size=None, dtype=None, ctx=None, out=None):
 
 def power(a, size=None, dtype=None, ctx=None, out=None):
     import jax
-    return _draw(lambda k, s: jax.random.uniform(k, s) ** (1.0 / a), size, dtype)
+    a = _param(a)
+    return _draw(lambda k, s: jax.random.uniform(k, s) ** (1.0 / a),
+                 _psize(size, a), dtype)
 
 
 def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, out=None):
     import jax
     import jax.numpy as jnp
+    mean, sigma = _param(mean), _param(sigma)
     return _draw(lambda k, s: jnp.exp(mean + sigma * jax.random.normal(k, s)),
-                 size, dtype)
+                 _psize(size, mean, sigma), dtype)
 
 
 def triangular(left, mode, right, size=None, dtype=None, ctx=None, out=None):
     import jax
     import jax.numpy as jnp
+    left, mode, right = _param(left), _param(mode), _param(right)
+    size = _psize(size, left, mode, right)
     def sampler(k, s):
         u = jax.random.uniform(k, s)
         c = (mode - left) / (right - left)

@@ -1,0 +1,115 @@
+"""Fused 1x1-convolution (GEMM) kernels with BN prologue/epilogue — the
+conv+BN mega-kernel PERF.md's roofline analysis called for (round-3 item #2).
+
+ResNet-50's FLOPs are dominated by 1x1 convolutions, which on TPU are plain
+GEMMs over (N*H*W, Cin) x (Cin, Cout). XLA keeps BatchNorm's normalize pass
+as standalone loop fusions (the stats reduction is a fusion barrier), so every
+conv output makes three HBM trips: write y, read y for stats, read y again to
+normalize into the next conv's input. This kernel collapses the trips:
+
+  * prologue: x_hat = relu(x * scale + shift)  applied WHILE READING x — the
+    preceding BatchNorm's normalize+relu folded into this conv's input load
+    (scale/shift are the per-channel gamma/sigma, beta-mu*gamma/sigma terms);
+  * GEMM on the MXU in bf16 with f32 accumulation;
+  * epilogue: per-output-channel sum and sum-of-squares accumulated WHILE
+    WRITING y — the batch moments the NEXT BatchNorm needs, for free.
+
+One read of x, one write of y, stats included: the theoretical-minimum
+traffic for the conv+BN+ReLU chain. Reference analog: the cuDNN fused
+conv-bn-activation path MXNet exposes on GPU (nn/cudnn/ wrappers); here it is
+a TPU-native Pallas kernel instead of a library call.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fused_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, sum_ref, sq_ref,
+                  acc_ref, *, n_m_tiles, relu):
+    """Grid = (m_tiles,). Whole K and Cout stay resident; per M-tile:
+    read x tile -> affine(+relu) -> dot -> write y tile, accumulate moments."""
+    mi = pl.program_id(0)
+
+    x = x_ref[...].astype(jnp.float32)
+    xh = x * scale_ref[...].astype(jnp.float32) + shift_ref[...].astype(jnp.float32)
+    if relu:
+        xh = jnp.maximum(xh, 0.0)
+    y = jax.lax.dot_general(
+        xh.astype(jnp.bfloat16), w_ref[...],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(mi == 0)
+    def _init():
+        acc_ref[0, ...] = jnp.zeros_like(acc_ref[0])
+        acc_ref[1, ...] = jnp.zeros_like(acc_ref[1])
+
+    acc_ref[0, ...] += jnp.sum(y, axis=0)
+    acc_ref[1, ...] += jnp.sum(y * y, axis=0)
+
+    @pl.when(mi == n_m_tiles - 1)
+    def _flush():
+        sum_ref[...] = acc_ref[0, ...].reshape(1, -1)
+        sq_ref[...] = acc_ref[1, ...].reshape(1, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("relu", "block_m", "interpret"))
+def conv1x1_bn_act(x, w, scale, shift, *, relu=True, block_m=512,
+                   interpret=False):
+    """y = relu(x*scale+shift) @ w, plus per-column moments of y.
+
+    Parameters
+    ----------
+    x : (M, K) activation matrix (N*H*W rows), any float dtype.
+    w : (K, Cout) weights (bf16 recommended).
+    scale, shift : (K,) input-side affine (the previous BN folded in).
+    Returns (y (M, Cout) bf16, col_sum (Cout,) f32, col_sumsq (Cout,) f32).
+    """
+    m, k = x.shape
+    k2, n = w.shape
+    assert k == k2, (x.shape, w.shape)
+    n_m_tiles = pl.cdiv(m, block_m)
+
+    kernel = functools.partial(_fused_kernel, n_m_tiles=n_m_tiles, relu=relu)
+    y, s, sq = pl.pallas_call(
+        kernel,
+        grid=(n_m_tiles,),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, k), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_m, n), lambda i: (i, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, n), jnp.float32)],
+        interpret=interpret,
+    )(x, w.astype(jnp.bfloat16), scale.reshape(1, k), shift.reshape(1, k))
+    return y, s[0], sq[0]
+
+
+def conv1x1_bn_act_reference(x, w, scale, shift, *, relu=True):
+    """Unfused XLA chain with identical semantics (the comparison baseline)."""
+    xh = x.astype(jnp.float32) * scale.astype(jnp.float32) \
+        + shift.astype(jnp.float32)
+    if relu:
+        xh = jnp.maximum(xh, 0.0)
+    y = jax.lax.dot_general(xh.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return (y.astype(jnp.bfloat16), jnp.sum(y, axis=0),
+            jnp.sum(y * y, axis=0))

@@ -93,22 +93,31 @@ def check_numeric_gradient(fn, inputs: List[NDArray], grads=None, eps=1e-4,
     y.backward()
     analytic = [x.grad.asnumpy().copy() for x in inputs]
 
+    # Perturbations are built ON DEVICE (base + delta*onehot(i)) rather than
+    # by mutating a host buffer and re-uploading: host mutate-and-reupload of
+    # the same buffer proved unreliable through the tunneled PJRT transfer
+    # path (stale device contents), and the on-device form needs no H2D
+    # transfer per element at all.
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _perturbed(data, idx, delta):
+        flat_d = data.reshape(-1)
+        onehot = (jnp.arange(flat_d.shape[0]) == idx).astype(data.dtype)
+        return (flat_d + onehot * delta).reshape(data.shape)
+
     for k, x in enumerate(inputs):
-        base = x.asnumpy().astype(onp.float64)
-        num_grad = onp.zeros_like(base)
-        flat = base.ravel()
+        base_dev = x.data
+        num_grad = onp.zeros(x.shape, onp.float64)
         ng_flat = num_grad.ravel()
-        for i in range(flat.size):
-            orig = flat[i]
-            flat[i] = orig + eps
-            x._set_data(_to_jax(base.reshape(x.shape), x))
+        for i in range(num_grad.size):
+            x._set_data(_perturbed(base_dev, i, eps))
             f_pos = float(fn(*inputs).asscalar())
-            flat[i] = orig - eps
-            x._set_data(_to_jax(base.reshape(x.shape), x))
+            x._set_data(_perturbed(base_dev, i, -eps))
             f_neg = float(fn(*inputs).asscalar())
-            flat[i] = orig
-            x._set_data(_to_jax(base.reshape(x.shape), x))
             ng_flat[i] = (f_pos - f_neg) / (2 * eps)
+        x._set_data(base_dev)
         assert_almost_equal(analytic[k], num_grad, rtol=rtol, atol=atol,
                             names=(f"analytic[{k}]", f"numeric[{k}]"))
 

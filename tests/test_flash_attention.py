@@ -134,3 +134,30 @@ def test_pallas_backward_matches_dense(causal):
     for w, gt, name in zip(want, got, "q k v".split()):
         onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
                                     rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_shipped_default_blocks_backward(causal):
+    """Exercise the REGISTERED default configuration (block_q=512,
+    block_k=1024) through the full fwd+bwd dispatch at S>1024 — the
+    configuration production training actually runs (ADVICE r3 #5). S is a
+    non-multiple of both blocks so the padding paths of the dq and dk/dv
+    grids are on the hot path too."""
+    rng = onp.random.RandomState(9)
+    B, H, S, D = 1, 1, 1500, 32
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    g = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=causal) * g).sum()
+
+    def f_dense(q, k, v):
+        return (_dense(q, k, v, causal=causal) * g).sum()
+
+    got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for gt, w, name in zip(got, want, "q k v".split()):
+        onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
+                                    rtol=2e-4, atol=2e-4, err_msg=name)

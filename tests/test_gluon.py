@@ -551,3 +551,49 @@ def test_pcc_binary_sigmoid_preds():
     pcc.update(nd.array(onp.array([0, 1, 1, 0], "float32")),
                nd.array(onp.array([[0.1], [0.9], [0.8], [0.2]], "float32")))
     assert abs(pcc.get()[1] - 1.0) < 1e-9
+
+
+def test_metric_mcc_average_semantics():
+    """ADVICE r4: MCC honours average= (macro per-batch vs micro cumulative);
+    PCC rejects unsupported macro instead of silently ignoring it."""
+    labels1 = nd.array(onp.array([1, 1, 0, 0], "float32"))
+    preds1 = nd.array(onp.array([[0.1, 0.9], [0.2, 0.8],
+                                 [0.8, 0.2], [0.7, 0.3]], "float32"))  # perfect
+    labels2 = nd.array(onp.array([1, 1, 0, 0], "float32"))
+    preds2 = nd.array(onp.array([[0.1, 0.9], [0.6, 0.4],
+                                 [0.8, 0.2], [0.3, 0.7]], "float32"))  # mcc 0
+
+    macro = mx.metric.MCC(average="macro")
+    macro.update(labels1, preds1)
+    macro.update(labels2, preds2)
+    assert abs(macro.get()[1] - 0.5) < 1e-12  # mean(1.0, 0.0)
+
+    micro = mx.metric.MCC(average="micro")
+    micro.update(labels1, preds1)
+    micro.update(labels2, preds2)
+    # cumulative confusion: tp=3 tn=3 fp=1 fn=1 -> (9-1)/sqrt(4^4) = 0.5
+    assert abs(micro.get()[1] - 0.5) < 1e-12
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mx.metric.MCC(average="weighted")
+    with _pytest.raises(NotImplementedError):
+        mx.metric.PCC(average="macro")
+
+
+def test_np_random_array_params():
+    """ADVICE r4: samplers accept array-like / NDArray distribution params
+    with numpy broadcast semantics (size=None -> param shape)."""
+    import mxnet_tpu.numpy as np
+    scale = nd.array(onp.array([1.0, 10.0, 100.0], "float32"))
+    s = np.random.rayleigh(scale)
+    assert s.shape == (3,)
+    a = onp.asarray(s.asnumpy())
+    assert (a > 0).all() and a[2] > a[0] / 100  # scale ordering plausible
+    w = np.random.weibull(onp.array([[1.0, 5.0]]), size=(4, 2))
+    assert w.shape == (4, 2)
+    g = np.random.gumbel(loc=nd.array(onp.zeros(5, "float32")),
+                         scale=onp.ones(5))
+    assert g.shape == (5,)
+    b = np.random.beta(onp.array([2.0, 2.0]), 3.0)
+    assert b.shape == (2,)
