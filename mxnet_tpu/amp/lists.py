@@ -37,6 +37,7 @@ FP32_OPS = [
     "linalg_det", "linalg_slogdet", "linalg_syevd", "linalg_gelqf",
     "moments", "mish", "smooth_l1", "_contrib_hawkes_ll", "_contrib_hawkesll",
     "LinearRegressionOutput", "LogisticRegressionOutput", "MAERegressionOutput",
+    "MakeLoss", "make_loss", "SVMOutput", "Correlation",
     "RMSNorm", "SoftmaxActivation", "softrelu", "gelu_tanh", "erf_inv",
     "sum_axis", "_contrib_div_sqrt_dim",
     "rsqrt", "rcbrt", "reciprocal", "cosh", "sinh", "tanh",

@@ -133,3 +133,53 @@ def sample_generalized_negative_binomial(mu, alpha, shape=(), dtype=None):
     return _apply_op("_sample_generalized_negative_binomial", _as_nd(mu),
                      _as_nd(alpha), _rng.take_key(), shape=_shape(shape),
                      dtype=DTypes.canonical(dtype))
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_generalized_negative_binomial", _rng.take_key(),
+                    mu=float(mu), alpha=float(alpha), shape=_shape(shape),
+                    dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+def dirichlet(alpha, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    res = _apply_op("_random_dirichlet", _rng.take_key(), _as_nd(alpha).data,
+                    shape=_shape(shape) or (), dtype=DTypes.canonical(dtype))
+    return _finish(res, ctx, out)
+
+
+# ---------------------------------------------------------------------------
+# *_like samplers (sample_op.cc _random_*_like): draw with the shape of an
+# existing array
+# ---------------------------------------------------------------------------
+def _like(sampler, data, **params):
+    return sampler(shape=tuple(data.shape), ctx=data.context, **params)
+
+
+def uniform_like(data, low=0.0, high=1.0, **kwargs):
+    return _like(uniform, data, low=low, high=high)
+
+
+def normal_like(data, loc=0.0, scale=1.0, **kwargs):
+    return _like(normal, data, loc=loc, scale=scale)
+
+
+def gamma_like(data, alpha=1.0, beta=1.0, **kwargs):
+    return _like(gamma, data, alpha=alpha, beta=beta)
+
+
+def exponential_like(data, lam=1.0, **kwargs):
+    return _like(exponential, data, scale=1.0 / lam)
+
+
+def poisson_like(data, lam=1.0, **kwargs):
+    return _like(poisson, data, lam=lam)
+
+
+def negative_binomial_like(data, k=1, p=1.0, **kwargs):
+    return _like(negative_binomial, data, k=k, p=p)
+
+
+def generalized_negative_binomial_like(data, mu=1.0, alpha=1.0, **kwargs):
+    return _like(generalized_negative_binomial, data, mu=mu, alpha=alpha)

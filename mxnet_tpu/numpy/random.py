@@ -246,3 +246,16 @@ def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
     c = cov.data if isinstance(cov, NDArray) else jnp.asarray(cov)
     shape = () if size is None else ((size,) if isinstance(size, int) else tuple(size))
     return NDArray(jax.random.multivariate_normal(key, m, c, shape or None))
+
+
+def dirichlet(alpha, size=None):
+    """Dirichlet distribution (numpy parity; jax.random.dirichlet on the
+    threefry chain)."""
+    import jax
+    from ..ndarray.ndarray import NDArray
+    key = _rng.take_key()
+    a = _param(alpha)
+    import jax.numpy as jnp
+    a = jnp.asarray(a, jnp.float32)
+    shape = () if size is None else ((size,) if isinstance(size, int) else tuple(size))
+    return NDArray(jax.random.dirichlet(key, a, shape))

@@ -17,6 +17,22 @@ def _dt(dtype):
     return DTypes.jnp(dtype or "float32")
 
 
+def _threefry(key):
+    """jax.random.poisson supports only the threefry bit generator; with the
+    rbg impl active (the TPU default here, random.py _prng_impl), fold the
+    key's raw data into a threefry key deterministically."""
+    try:
+        if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+            if "threefry" in str(jax.random.key_impl(key)):
+                return key
+            data = jax.random.key_data(key).ravel().astype(jnp.uint32)
+            d2 = jnp.concatenate([data, jnp.zeros(2, jnp.uint32)])[:2]
+            return jax.random.wrap_key_data(d2, impl="threefry2x32")
+    except TypeError:
+        pass  # raw uint32 legacy key: already threefry-compatible
+    return key
+
+
 @register("_random_uniform", differentiable=False)
 def random_uniform(key, *, low=0.0, high=1.0, shape=(), dtype=None):
     return jax.random.uniform(key, shape, _dt(dtype), minval=low, maxval=high)
@@ -39,14 +55,14 @@ def random_exponential(key, *, lam=1.0, shape=(), dtype=None):
 
 @register("_random_poisson", differentiable=False)
 def random_poisson(key, *, lam=1.0, shape=(), dtype=None):
-    return jax.random.poisson(key, lam, shape).astype(_dt(dtype))
+    return jax.random.poisson(_threefry(key), lam, shape).astype(_dt(dtype))
 
 
 @register("_random_negative_binomial", differentiable=False)
 def random_negative_binomial(key, *, k=1, p=1.0, shape=(), dtype=None):
     kg, kp = jax.random.split(key)
     lam = jax.random.gamma(kg, k, shape) * (1 - p) / p
-    return jax.random.poisson(kp, lam, shape).astype(_dt(dtype))
+    return jax.random.poisson(_threefry(kp), lam, shape).astype(_dt(dtype))
 
 
 @register("_random_randint", differentiable=False)
@@ -139,7 +155,7 @@ def sample_exponential(lam, key, *, shape=(), dtype=None):
 def sample_poisson(lam, key, *, shape=(), dtype=None):
     return _multisample(key, (lam,), shape,
                         lambda k, ps, s: jax.random.poisson(
-                            k, ps[0], s).astype(_dt(dtype)))
+                            _threefry(k), ps[0], s).astype(_dt(dtype)))
 
 
 @register("_sample_negative_binomial", differentiable=False)
@@ -147,7 +163,7 @@ def sample_negative_binomial(k_param, p, key, *, shape=(), dtype=None):
     def draw(k, ps, s):
         kg, kp = jax.random.split(k)
         lam = jax.random.gamma(kg, ps[0], s) * (1 - ps[1]) / ps[1]
-        return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+        return jax.random.poisson(_threefry(kp), lam, s).astype(_dt(dtype))
     return _multisample(key, (k_param, p), shape, draw)
 
 
@@ -158,5 +174,25 @@ def sample_generalized_negative_binomial(mu, alpha, key, *, shape=(), dtype=None
         mu_i, alpha_i = ps
         r = 1.0 / jnp.maximum(alpha_i, 1e-12)
         lam = jax.random.gamma(kg, r, s) * (mu_i * alpha_i)
-        return jax.random.poisson(kp, lam, s).astype(_dt(dtype))
+        return jax.random.poisson(_threefry(kp), lam, s).astype(_dt(dtype))
     return _multisample(key, (mu, alpha), shape, draw)
+
+
+@register("_random_generalized_negative_binomial", differentiable=False)
+def random_generalized_negative_binomial(key, *, mu=1.0, alpha=1.0, shape=(),
+                                         dtype=None):
+    """Gamma-Poisson mixture with mean mu and dispersion alpha
+    (sample_op.cc GeneralizedNegativeBinomialSampler)."""
+    kg, kp = jax.random.split(key)
+    r = 1.0 / max(alpha, 1e-12)
+    lam = jax.random.gamma(kg, r, shape) * (mu * alpha)
+    return jax.random.poisson(_threefry(kp), lam, shape).astype(_dt(dtype))
+
+
+@register("_random_dirichlet", differentiable=False)
+def random_dirichlet(key, alpha, *, shape=(), dtype=None):
+    """Dirichlet draws (numpy/random/np_random_op.cc _npi_dirichlet):
+    output shape = shape + alpha.shape."""
+    a = jnp.asarray(alpha, jnp.float32)
+    s = shape if isinstance(shape, tuple) else ((shape,) if shape else ())
+    return jax.random.dirichlet(key, a, s).astype(_dt(dtype))

@@ -1,0 +1,21 @@
+"""CI gate for the operator-parity ledger (VERDICT r3 #9): every reference
+forward op must be covered by the registry/namespaces or carry an explicit
+annotation in tools/op_parity.py; stale annotations fail too."""
+import os
+
+import pytest
+
+
+def test_op_parity_ledger_is_exhaustive_and_fresh():
+    if not os.path.isdir("/root/reference/src/operator"):
+        pytest.skip("reference tree not mounted")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "op_parity", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "op_parity.py"))
+    op_parity = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(op_parity)
+    fwd, absent, unannotated, stale = op_parity.audit()
+    assert len(fwd) > 500  # the extraction regexes still find the registry
+    assert not unannotated, f"unannotated absent ops: {unannotated}"
+    assert not stale, f"stale ledger entries: {stale}"

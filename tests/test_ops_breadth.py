@@ -196,3 +196,122 @@ def test_linalg_trian_roundtrip():
     assert p2.shape == (6,)
     b2 = nd.linalg_maketrian(p2, offset=-1).asnumpy()
     onp.testing.assert_allclose(b2, onp.tril(a, -1), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-4 op tail: MakeLoss / SVMOutput / Correlation
+# ---------------------------------------------------------------------------
+def test_make_loss_gradient_semantics():
+    x = nd.array(onp.array([[0.5, -1.0], [2.0, 0.1]], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(x * 2.0, grad_scale=3.0)
+    out.backward()
+    # d(MakeLoss)/dx ignores the cotangent: grad_scale through the *2 chain
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.full((2, 2), 6.0),
+                                rtol=1e-6)
+    # batch normalization divides by batch size
+    x.grad[:] = nd.zeros((2, 2))
+    with autograd.record():
+        out = nd.MakeLoss(x, normalization="batch")
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.full((2, 2), 0.5),
+                                rtol=1e-6)
+    # valid: divide by count(data > valid_thresh); here 3 of 4 elements > 0
+    with autograd.record():
+        out = nd.MakeLoss(x, normalization="valid", valid_thresh=0.0)
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.full((2, 2), 1 / 3),
+                                rtol=1e-6)
+
+
+def test_svm_output_gradients():
+    x = onp.array([[0.5, -0.2, 1.5], [-1.2, 2.0, 0.3]], "float32")
+    lab = onp.array([2, 1], "float32")
+    d = nd.array(x)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(d, nd.array(lab), margin=1.0,
+                           regularization_coefficient=0.7)
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)  # identity fwd
+    out.backward()
+    # L2-SVM (svm_output.cc:50-66): true cls -2r*max(m-x,0); other 2r*max(m+x,0)
+    want = onp.zeros_like(x)
+    for i in range(2):
+        k = int(lab[i])
+        for j in range(3):
+            if j == k:
+                want[i, j] = -2 * 0.7 * max(1.0 - x[i, j], 0.0)
+            else:
+                want[i, j] = 2 * 0.7 * max(1.0 + x[i, j], 0.0)
+    onp.testing.assert_allclose(d.grad.asnumpy(), want, rtol=1e-5)
+    # L1-SVM
+    with autograd.record():
+        out = nd.SVMOutput(d, nd.array(lab), use_linear=True,
+                           regularization_coefficient=0.5)
+    out.backward()
+    want = onp.zeros_like(x)
+    for i in range(2):
+        k = int(lab[i])
+        for j in range(3):
+            if j == k:
+                want[i, j] = -0.5 * float(1.0 > x[i, j])
+            else:
+                want[i, j] = 0.5 * float(1.0 > -x[i, j])
+    onp.testing.assert_allclose(d.grad.asnumpy(), want, rtol=1e-5)
+
+
+def _naive_correlation(a, b, K, md, s1, s2, pad, multiply):
+    B, C, H, W = a.shape
+    kr = (K - 1) // 2
+    border = md + kr
+    ap = onp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bp = onp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    disp = list(range(-md, md + 1, s2))
+    oh = (Hp - 2 * border - 1) // s1 + 1
+    ow = (Wp - 2 * border - 1) // s1 + 1
+    out = onp.zeros((B, len(disp) ** 2, oh, ow), "float64")
+    for n in range(B):
+        for di, dy in enumerate(disp):
+            for dj, dx in enumerate(disp):
+                for y in range(oh):
+                    for xo in range(ow):
+                        y1, x1 = y * s1 + border, xo * s1 + border
+                        acc = 0.0
+                        for c in range(C):
+                            for i in range(-kr, kr + 1):
+                                for j in range(-kr, kr + 1):
+                                    v1 = ap[n, c, y1 + i, x1 + j]
+                                    yy, xx = y1 + i + dy, x1 + j + dx
+                                    v2 = bp[n, c, yy, xx] \
+                                        if 0 <= yy < Hp and 0 <= xx < Wp else 0.0
+                                    acc += v1 * v2 if multiply else abs(v1 - v2)
+                        out[n, di * len(disp) + dj, y, xo] = acc / (K * K * C)
+    return out
+
+
+def test_correlation_matches_naive():
+    rng = onp.random.RandomState(4)
+    a = rng.rand(1, 2, 6, 6).astype("float32")
+    b = rng.rand(1, 2, 6, 6).astype("float32")
+    for K, md, s1, s2, pad, mult in [(1, 1, 1, 1, 1, True),
+                                     (3, 2, 2, 1, 2, True),
+                                     (1, 1, 1, 1, 1, False)]:
+        got = nd.Correlation(nd.array(a), nd.array(b), kernel_size=K,
+                             max_displacement=md, stride1=s1, stride2=s2,
+                             pad_size=pad, is_multiply=mult).asnumpy()
+        want = _naive_correlation(a, b, K, md, s1, s2, pad, mult)
+        assert got.shape == want.shape, (got.shape, want.shape)
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rng = onp.random.RandomState(5)
+    a = nd.array(rng.rand(1, 2, 5, 5).astype("float32"))
+    b = nd.array(rng.rand(1, 2, 5, 5).astype("float32"))
+    check_numeric_gradient(
+        lambda x, y: nd.Correlation(x, y, kernel_size=1, max_displacement=1,
+                                    pad_size=1).sum(),
+        [a, b], eps=1e-3, rtol=2e-2, atol=2e-3)
