@@ -104,8 +104,10 @@ register("MXNET_HOME", os.path.join("~", ".mxnet"), str,
          "Root for datasets/model downloads.")
 register("MXNET_P3_SLICE_SIZE", 1 << 20, int,
          "p3 kvstore: elements per wire slice (priority propagation).")
-register("MXNET_KVSTORE_ASYNC_AVG_PERIOD", 16, int,
-         "dist_async: pushes per key between parameter-averaging allreduces.")
+register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
+         "dist_async: max whole-model push rounds a worker may run ahead of "
+         "the slowest (SSP bound); -1 = unbounded, the reference's pure "
+         "async-apply behavior.")
 register("MXNET_KVSTORE_HEARTBEAT_DIR", "", str,
          "Shared dir for worker heartbeat files (ps-lite heartbeat analog); "
          "empty disables failure detection.")

@@ -12,15 +12,14 @@ TPU-native mapping (SURVEY.md §2.3):
     multi-host collectives over jax.distributed (ICI within slice, DCN across
     hosts); there is no parameter-server process because sync SGD on TPU is
     allreduce-native.
-  - 'dist_async' (ps-lite async push, kvstore_dist.h): the async property is
-    "no waiting on stragglers", not the server. TPU-native mapping: each
-    worker applies its updater to its local replica immediately (zero
-    cross-host traffic on the critical path) and replicas reconcile by
-    periodic parameter averaging (every MXNET_KVSTORE_ASYNC_AVG_PERIOD pushes
-    per key, one allreduce-mean) — the local-SGD formulation of asynchronous
-    PS training. Workers must push each key at the same cadence (true for
-    training loops), matching the reference's assumption that every worker
-    pushes every iteration.
+  - 'dist_async' (ps-lite async push, kvstore_dist_server.h:336-382): true
+    per-push apply on a rank-0-hosted parameter service (async_ps.py) — each
+    worker's gradient is applied to the stored weight the moment it arrives,
+    no barrier, no waiting on stragglers; pulls return the current weight.
+    ICI collectives are bulk-synchronous, so asynchrony runs out-of-band on
+    the host network exactly like the reference's ps-lite TCP van (design
+    note in async_ps.py; SURVEY §7(g)). Optional SSP staleness bound via
+    MXNET_KVSTORE_ASYNC_MAX_STALENESS.
   - failure detection (ps-lite heartbeat → scheduler dead-node count): each
     worker touches a heartbeat file under MXNET_KVSTORE_HEARTBEAT_DIR (set by
     tools/launch.py); num_dead_node counts ranks whose heartbeat is stale.
@@ -61,10 +60,17 @@ class KVStore(KVStoreBase):
             import jax
             self._multi_host = jax.process_count() > 1
             self._async = "async" in kv_type
-            from .. import config
-            self._async_avg_period = config.get(
-                "MXNET_KVSTORE_ASYNC_AVG_PERIOD")
-            self._async_push_count: Dict = {}
+            self._ps_server = None
+            self._ps_client = None
+            if self._async and self._multi_host:
+                from .. import config
+                from .async_ps import AsyncParameterServer, AsyncPSClient
+                staleness = config.get("MXNET_KVSTORE_ASYNC_MAX_STALENESS")
+                if jax.process_index() == 0:
+                    self._ps_server = AsyncParameterServer(
+                        self._server_apply, jax.process_count(),
+                        max_staleness=staleness)
+                self._ps_client = AsyncPSClient(jax.process_index())
             self._start_heartbeat()
         else:
             self._async = False
@@ -110,6 +116,11 @@ class KVStore(KVStoreBase):
             keys = [key] * len(values)
         for k, v in zip(keys, values):
             self._store[k] = NDArray(v.data, ctx=v.context)
+            if getattr(self, "_async_ps_active", False):
+                self._ps_client.init(k, v.asnumpy())  # first writer wins
+        if getattr(self, "_ps_server", None) is not None:
+            # one staleness clock tick == one whole-model push
+            self._ps_server.set_num_keys(len(self._store))
 
     def _allreduce_sum(self, x):
         """True multi-host allreduce of a dense array: shard a leading worker
@@ -247,21 +258,23 @@ class KVStore(KVStoreBase):
         return NDArray(out, ctx=values[0].context)
 
 
-    def _async_maybe_average(self, k):
-        """Periodic parameter averaging for dist_async: one allreduce-mean of
-        the replica every N-th push of this key (local-SGD reconciliation)."""
-        if not (self._async and self._multi_host and self._updater is not None):
-            return
-        cnt = self._async_push_count.get(k, 0) + 1
-        self._async_push_count[k] = cnt
-        if cnt % self._async_avg_period:
-            return
-        from ..sparse import BaseSparseNDArray
-        val = self._store[k]
-        if isinstance(val, BaseSparseNDArray):
-            val = val.todense()
-        avg = self._allreduce_sum(val.data) / self.num_workers
-        self._store[k] = NDArray(avg, ctx=val.context)
+    def _server_apply(self, key, grad_np, weight_np):
+        """Server-side per-push apply (runs in rank 0's service threads):
+        bridge the stored host weight through NDArray, run this process's
+        updater — the update_on_kvstore optimizer, kvstore_dist_server.h
+        set_updater semantics — and write the result back in place."""
+        import numpy as _onp
+        if self._updater is None:
+            raise MXNetError("dist_async needs a kvstore updater "
+                             "(set_optimizer / update_on_kvstore)")
+        g = NDArray(grad_np)
+        w = NDArray(weight_np)
+        self._updater(_key_int(key), g, w)
+        weight_np[...] = _onp.asarray(w.asnumpy(), weight_np.dtype)
+
+    @property
+    def _async_ps_active(self):
+        return self._async and self._multi_host and self._ps_client is not None
 
     def push(self, key, value, priority=0):
         keys, values = _listify(key), _listify(value)
@@ -270,19 +283,23 @@ class KVStore(KVStoreBase):
         from ..sparse import BaseSparseNDArray
         for k, vlist in zip(keys, values):
             vlist = _listify(vlist)
-            # dist_async: local gradients only on the critical path — the
-            # cross-host hop happens in _async_maybe_average instead. Without
-            # an updater there is nothing to reconcile later, so the
-            # aggregate-into-store path keeps the synchronous reduce (the
-            # ps-lite server sums across workers in async mode too).
+            # dist_async: the per-device local sum goes straight to the async
+            # parameter service, which applies it on arrival; no collective
+            # on the critical path. Without an updater the aggregate-into-
+            # store path keeps the synchronous reduce (the ps-lite server
+            # sums across workers in async mode too).
             local_only = self._async and self._updater is not None
             agg = self._reduce(vlist, key=k, cross_host=not local_only)
             sparse_agg = isinstance(agg, BaseSparseNDArray)
+            if self._async_ps_active and self._updater is not None:
+                if sparse_agg:
+                    agg = agg.todense()
+                self._ps_client.push(k, agg.asnumpy())
+                continue
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} not initialized")
                 self._updater(_key_int(k), agg, self._store[k])
-                self._async_maybe_average(k)
             else:
                 if k in self._store and getattr(self, "_accumulate", False):
                     prev = self._store[k]
@@ -300,7 +317,11 @@ class KVStore(KVStoreBase):
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
-            src = self._store[k]
+            if self._async_ps_active and self._updater is not None:
+                src = NDArray(self._ps_client.pull(k),
+                              ctx=self._store[k].context)
+            else:
+                src = self._store[k]
             for o in _listify(olist):
                 src.copyto(o)
 

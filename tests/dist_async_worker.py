@@ -1,0 +1,69 @@
+"""Worker body for the 4-process dist_async test (VERDICT r3 #7).
+
+Distinguishes true async-apply from sync semantics: ranks 0-2 push
+immediately; rank 3 sleeps first. Under async, the fast workers' updates are
+visible in a pull BEFORE the laggard has pushed anything (a sync allreduce
+would block until all four contribute). After everyone finishes, the weight
+reflects every push applied per-arrival (SGD with lr 0.1 is additive, so the
+final value is order-independent: init - 0.1 * sum(all grads))."""
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+LAG = 3.0
+SHAPE = (2, 4)
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, size = kv.rank, kv.num_workers
+    assert size == 4, f"expected 4 workers, got {size}"
+    assert kv.type == "dist_async"
+
+    def updater(key, grad, weight):
+        weight -= 0.1 * grad
+
+    kv.set_updater(updater)
+    kv.init("w", nd.zeros(SHAPE))
+
+    outdir = os.environ["ASYNC_TEST_DIR"]
+    if rank == 3:
+        time.sleep(LAG)
+        t_before_push = time.time()
+        kv.push("w", nd.ones(SHAPE))
+        record = {"rank": rank, "pushed_at": t_before_push}
+    else:
+        kv.push("w", nd.ones(SHAPE) * (rank + 1))
+        out = nd.zeros(SHAPE)
+        kv.pull("w", out=out)
+        t_seen = time.time()
+        seen = float(out.asnumpy()[0, 0])
+        # async: our own push (and possibly peers') already applied while the
+        # laggard is still asleep — the weight moved without rank 3
+        record = {"rank": rank, "seen_nonzero_at": t_seen, "seen": seen}
+        assert seen < 0.0, f"rank {rank}: no update applied before laggard ({seen})"
+
+    with open(os.path.join(outdir, f"r{rank}.json"), "w") as f:
+        json.dump(record, f)
+
+    # converge: wait for all pushes (1+2+3+1 = 7 -> final = -0.7)
+    deadline = time.time() + 60
+    out = nd.zeros(SHAPE)
+    while time.time() < deadline:
+        kv.pull("w", out=out)
+        if abs(float(out.asnumpy()[0, 0]) + 0.7) < 1e-5:
+            break
+        time.sleep(0.1)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full(SHAPE, -0.7),
+                                rtol=1e-5)
+    print(f"worker {rank}/4: ASYNC OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

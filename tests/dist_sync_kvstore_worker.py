@@ -77,22 +77,23 @@ def main():
     kv.pull("comp", out=out)
     onp.testing.assert_allclose(out.asnumpy(), onp.full(shape, 1.0), rtol=1e-6)
 
-    # --- dist_async: local-immediate updates + periodic averaging ----------
-    mx.config.set("MXNET_KVSTORE_ASYNC_AVG_PERIOD", 4)
+    # --- dist_async: true per-push apply on the rank-0 parameter service ---
+    import time
     kva = mx.kv.create("dist_async")
     kva.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, wd=0.0))
     kva.init("aw", nd.zeros((4,)))
     for step in range(3):
         kva.push("aw", nd.ones((4,)) * (rank + 1))
+    # every push is applied on arrival (kvstore_dist_server.h:336-382): both
+    # workers converge to -(3*1 + 3*2) = -9 with no averaging step
     out = nd.zeros((4,))
-    kva.pull("aw", out=out)
-    # before the averaging point the replicas DIVERGE (local-only updates)
-    onp.testing.assert_allclose(out.asnumpy(),
-                                onp.full((4,), -3.0 * (rank + 1)), rtol=1e-6)
-    kva.push("aw", nd.ones((4,)) * (rank + 1))  # 4th push -> allreduce-mean
-    kva.pull("aw", out=out)
-    # (-4*1 + -4*2)/2 = -6 on BOTH workers after reconciliation
-    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), -6.0), rtol=1e-6)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        kva.pull("aw", out=out)
+        if abs(float(out.asnumpy()[0]) + 9.0) < 1e-6:
+            break
+        time.sleep(0.05)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), -9.0), rtol=1e-6)
 
     # --- collective backend (horovod.py pattern) across processes ----------
     kvc = mx.kv.create("collective")

@@ -111,3 +111,32 @@ def test_dist_sync_kvstore_four_processes():
     assert proc.returncode == 0, f"4-proc dist workers failed:\n{out}"
     for rank in range(4):
         assert f"worker {rank}/4: OK" in out, out
+
+
+def test_dist_async_kvstore_four_processes_staleness(tmp_path):
+    """True per-push async apply (kvstore_dist_server.h:336-382 semantics):
+    rank 3 lags 3s; ranks 0-2 must observe applied updates BEFORE rank 3
+    pushes anything (temporal proof that nothing barriers), and the final
+    weight reflects every push. Distinguishes async from sync: dist_sync's
+    allreduce cannot complete until all ranks contribute."""
+    import json
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["ASYNC_TEST_DIR"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--launcher", "local", "--",
+         sys.executable, os.path.join(REPO, "tests", "dist_async_worker.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"async workers failed:\n{out}"
+    for r in range(4):
+        assert f"worker {r}/4: ASYNC OK" in out, out
+    records = {r: json.load(open(tmp_path / f"r{r}.json")) for r in range(4)}
+    laggard_push = records[3]["pushed_at"]
+    for r in range(3):
+        assert records[r]["seen_nonzero_at"] < laggard_push, (
+            f"rank {r} only saw updates after the laggard pushed — "
+            f"that is sync, not async: {records}")
