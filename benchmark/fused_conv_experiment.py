@@ -34,16 +34,57 @@ SHAPES = [
 ]
 
 
-def _amortize(fn, args, n=30, windows=3):
-    outs = fn(*args)
-    _ = float(outs[0].ravel()[0].astype(jnp.float32))
+CHAIN = 100
+
+
+def _chained(fn):
+    """Run CHAIN dependent kernel invocations inside ONE jit: the tunnel's
+    per-dispatch floor (~1-4 ms) would otherwise swamp sub-ms kernels. The
+    1e-30*acc feedback serializes iterations without changing values, and
+    consuming y[0,0] keeps the y write live in the XLA reference (a real
+    network always materializes y)."""
+    @jax.jit
+    def run(x, w, s, t):
+        def body(i, carry):
+            x_, acc = carry
+            y, cs, cq = fn(x_, w, s, t)
+            acc = acc + cs[0] + cq[0] + y[0, 0].astype(jnp.float32)
+            x_ = x + (1e-30 * acc).astype(x.dtype)
+            return (x_, acc)
+        _, acc = jax.lax.fori_loop(0, CHAIN, body, (x, jnp.float32(0.0)))
+        return acc
+    return run
+
+
+_RTT_MS = None
+
+
+def _rtt_ms():
+    """Dispatch+fetch floor of a trivial jitted computation (the constant the
+    tunnel adds to every timed window)."""
+    global _RTT_MS
+    if _RTT_MS is None:
+        f = jax.jit(lambda a: a * 2.0)
+        z = jnp.float32(1.0)
+        float(f(z))
+        ts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            float(f(z))
+            ts.append((time.perf_counter() - t0) * 1e3)
+        _RTT_MS = min(ts)
+        print(f"tunnel dispatch+fetch floor: {_RTT_MS:.1f} ms (subtracted)")
+    return _RTT_MS
+
+
+def _amortize(run, args, windows=5):
+    rtt = _rtt_ms()
+    _ = float(run(*args))
     meds = []
     for _w in range(windows):
         t0 = time.perf_counter()
-        for _ in range(n):
-            outs = fn(*args)
-        _ = float(outs[0].ravel()[0].astype(jnp.float32))
-        meds.append((time.perf_counter() - t0) / n * 1e3)
+        _ = float(run(*args))
+        meds.append(max((time.perf_counter() - t0) * 1e3 - rtt, 0.0) / CHAIN)
     meds.sort()
     return meds[len(meds) // 2]
 
@@ -54,16 +95,15 @@ def main():
     print(f"{'shape':12s} {'M':>8s} {'K':>5s} {'N':>5s} "
           f"{'XLA ms':>8s} {'Pallas ms':>10s} {'speedup':>8s}")
     tot_x = tot_p = 0.0
-    reference = jax.jit(conv1x1_bn_act_reference, static_argnames=("relu",))
     for label, m, k, n in SHAPES:
         x = jnp.asarray(rng.rand(m, k).astype("float32") - 0.3, jnp.bfloat16)
         w = jnp.asarray(rng.rand(k, n).astype("float32") * 0.05, jnp.bfloat16)
         s = jnp.asarray(rng.rand(k).astype("float32") + 0.5)
         t = jnp.asarray(rng.rand(k).astype("float32") - 0.5)
         bm = 448 if m % 448 == 0 else 512
-        tx = _amortize(reference, (x, w, s, t))
+        tx = _amortize(_chained(conv1x1_bn_act_reference), (x, w, s, t))
         tp = _amortize(
-            lambda *a: conv1x1_bn_act(*a, block_m=bm), (x, w, s, t))
+            _chained(lambda *a: conv1x1_bn_act(*a, block_m=bm)), (x, w, s, t))
         tot_x += tx
         tot_p += tp
         print(f"{label:12s} {m:8d} {k:5d} {n:5d} {tx:8.3f} {tp:10.3f} "

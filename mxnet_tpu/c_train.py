@@ -1,0 +1,106 @@
+"""Python driver behind the C training ABI (native/c_train_api.h).
+
+The training-capable slice of the language-binding story (reference:
+cpp-package/include/mxnet-cpp/ symbol.h/executor.h/optimizer.h over the C
+API). libmxtpu_train.so embeds CPython and calls the helpers here; the C++
+header cpp-package/include/mxnet_tpu_cpp/train.hpp wraps the ABI in RAII
+classes. Everything crossing the boundary is str / bytes / float buffers.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _tuplify(v):
+    if isinstance(v, list):
+        return tuple(_tuplify(x) for x in v)
+    return v
+
+
+def sym_variable(name):
+    return mx.sym.Variable(name)
+
+
+def sym_create(op_name, name, inputs, attrs_json):
+    """Build one symbolic op: positional symbol inputs + JSON attrs."""
+    attrs = json.loads(attrs_json) if attrs_json else {}
+    attrs = {k: _tuplify(v) for k, v in attrs.items()}
+    if name:
+        attrs["name"] = name
+    fn = getattr(mx.sym, op_name)
+    return fn(*inputs, **attrs)
+
+
+class _Exec:
+    """Bound trainable executor + buffer marshalling for the C side."""
+
+    def __init__(self, sym, shapes_json):
+        shapes = {k: tuple(v) for k, v in json.loads(shapes_json).items()}
+        self.exe = sym.simple_bind(mx.cpu(), grad_req="write", **shapes)
+        self.arg_names = list(sym.list_arguments())
+
+    # -- introspection ------------------------------------------------------
+    def list_arguments(self):
+        return self.arg_names
+
+    def arg_shape(self, name):
+        return list(self.exe.arg_dict[name].shape)
+
+    def output_shape(self, index):
+        return list(self.exe.outputs[index].shape)
+
+    # -- data movement ------------------------------------------------------
+    def set_arg(self, name, buf):
+        arr = self.exe.arg_dict[name]
+        data = onp.frombuffer(buf, dtype=onp.float32).reshape(arr.shape)
+        arr[:] = nd.array(data)
+
+    def get_arg(self, name):
+        return onp.ascontiguousarray(
+            self.exe.arg_dict[name].asnumpy().astype(onp.float32)).tobytes()
+
+    def get_grad(self, name):
+        g = self.exe.grad_dict[name]
+        return onp.ascontiguousarray(
+            g.asnumpy().astype(onp.float32)).tobytes()
+
+    def get_output(self, index):
+        return onp.ascontiguousarray(
+            self.exe.outputs[index].asnumpy().astype(onp.float32)).tobytes()
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, is_train):
+        self.exe.forward(is_train=bool(is_train))
+
+    def backward(self):
+        self.exe.backward()
+
+
+def simple_bind(sym, shapes_json):
+    return _Exec(sym, shapes_json)
+
+
+class _Opt:
+    """Per-argument optimizer states over the executor's weights
+    (mxnet-cpp optimizer.h Update(index, weight, grad) semantics)."""
+
+    def __init__(self, opt_type, params_json):
+        params = json.loads(params_json) if params_json else {}
+        self.opt = mx.optimizer.create(opt_type, **params)
+        self.states = {}
+
+    def update(self, exec_, name, index):
+        w = exec_.exe.arg_dict[name]
+        g = exec_.exe.grad_dict[name]
+        if index not in self.states:
+            self.states[index] = self.opt.create_state(index, w)
+        self.opt.update(index, w, g, self.states[index])
+
+
+def optimizer_create(opt_type, params_json):
+    return _Opt(opt_type, params_json)

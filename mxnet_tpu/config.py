@@ -104,6 +104,9 @@ register("MXNET_HOME", os.path.join("~", ".mxnet"), str,
          "Root for datasets/model downloads.")
 register("MXNET_P3_SLICE_SIZE", 1 << 20, int,
          "p3 kvstore: elements per wire slice (priority propagation).")
+register("MXNET_TRAIN_REMAT", "none", str,
+         "ParallelTrainStep rematerialization policy: none | conv (save only "
+         "conv outputs, recompute BN/ReLU chains in backward) | full.")
 register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
          "dist_async: max whole-model push rounds a worker may run ahead of "
          "the slowest (SSP bound); -1 = unbounded, the reference's pure "

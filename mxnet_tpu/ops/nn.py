@@ -66,7 +66,12 @@ def convolution(x, weight, bias=None, *, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         y = y + bias.reshape((1, -1) + (1,) * nd)
-    return y
+    # residual-save tag: under the train step's remat policy (MXNET_TRAIN_REMAT
+    # =conv, parallel/train_step.py) only conv outputs are saved for backward;
+    # the BN/ReLU elementwise chain is recomputed instead of round-tripping
+    # HBM. A no-op outside jax.checkpoint.
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(y, "conv_out")
 
 
 @register("Deconvolution", jit=True)

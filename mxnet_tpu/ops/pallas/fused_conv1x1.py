@@ -39,10 +39,14 @@ def _fused_kernel(x_ref, w_ref, scale_ref, shift_ref, y_ref, sum_ref, sq_ref,
     xh = x * scale_ref[...].astype(jnp.float32) + shift_ref[...].astype(jnp.float32)
     if relu:
         xh = jnp.maximum(xh, 0.0)
+    # explicit DEFAULT precision: bf16 operands are exact bf16 regardless, and
+    # Mosaic rejects the global jax_default_matmul_precision=highest setting
+    # (an f32-emulation request) on a bf16 MXU contract
     y = jax.lax.dot_general(
         xh.astype(jnp.bfloat16), w_ref[...],
         (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)
     y_ref[...] = y.astype(y_ref.dtype)
 
     @pl.when(mi == 0)

@@ -156,6 +156,17 @@ class ParallelTrainStep:
                 loss_val = jnp.mean(loss_nd.data.astype(jnp.float32))
                 return loss_val, aux_vals
 
+            from .. import config as _config
+            remat = _config.get("MXNET_TRAIN_REMAT")
+            if remat == "conv":
+                # save only conv outputs for backward; recompute the BN/ReLU
+                # elementwise chains instead of storing+reloading them — the
+                # flops-for-bytes trade that fits an HBM-bound convnet step
+                loss_f = jax.checkpoint(
+                    loss_f, policy=jax.checkpoint_policies.
+                    save_only_these_names("conv_out"))
+            elif remat == "full":
+                loss_f = jax.checkpoint(loss_f)
             (loss_val, aux_vals), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(list(train_params))
 
