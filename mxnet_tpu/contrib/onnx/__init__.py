@@ -4,10 +4,15 @@ import_onnx.py; ~4.2k LoC collapsed to the TPU-relevant subset).
 
 Real ONNX protobuf wire format: the schema subset in ``onnx.proto`` uses the
 official field numbers, so exported models load in onnxruntime/netron and
-models produced elsewhere import here. Covered ops: Conv, Gemm/MatMul,
-BatchNormalization, Relu/Sigmoid/Tanh/Softplus/LeakyRelu, MaxPool/AveragePool
-(+Global), Flatten, Softmax, Add/Sub/Mul/Div, Concat, Reshape, Transpose,
-Dropout, Gather (Embedding).
+models produced elsewhere import here. Covered ops: Conv, Gemm/MatMul (incl.
+batched), BatchNormalization, LayerNormalization, Relu/Sigmoid/Tanh/Softplus/
+Softsign/LeakyRelu/Elu, gelu (exported as the exact Erf decomposition),
+MaxPool/AveragePool (+Global), Flatten, Softmax, Add/Sub/Mul/Div (+scalar
+constants), Sqrt, Erf, Concat, Reshape, Transpose, Dropout, Gather
+(Embedding), MultiBoxPrior (anchors folded to a constant initializer at
+export). Round-trip coverage at model scale: resnet50_v1, a BERT-base encoder
+stack, and SSD-300 heads re-import with matching predictions
+(tests/test_onnx_model_zoo.py).
 """
 from __future__ import annotations
 
@@ -159,8 +164,11 @@ def _export_node(node, ins, extra_init):
         if act not in m:
             raise MXNetError(f"onnx export: unsupported activation {act}")
         return [_mk_node(m[act], ins, [name], name)]
-    if op == "LeakyReLU":
+    if op == "LeakyReLU" and attrs.get("act_type", "leaky") in ("leaky", None):
         return [_mk_node("LeakyRelu", ins[:1], [name], name,
+                         alpha=float(attrs.get("slope", 0.25)))]
+    if op == "LeakyReLU" and attrs.get("act_type") == "elu":
+        return [_mk_node("Elu", ins[:1], [name], name,
                          alpha=float(attrs.get("slope", 0.25)))]
     if op == "BatchNorm":
         bn_ins = list(ins)
@@ -224,6 +232,65 @@ def _export_node(node, ins, extra_init):
         return [_mk_node("Gather", [ins[1], ins[0]], [name], name, axis=0)]
     if op == "dot":
         return [_mk_node("MatMul", ins, [name], name)]
+    if op == "batch_dot":
+        # (B, M, K) x (B, K, N): ONNX MatMul batches leading dims natively
+        if attrs.get("transpose_a") or attrs.get("transpose_b"):
+            tb = name + "_bT"
+            nodes = []
+            a_in, b_in = ins
+            if attrs.get("transpose_a"):
+                ta = name + "_aT"
+                nodes.append(_mk_node("Transpose", [a_in], [ta], ta,
+                                      perm=(0, 2, 1)))
+                a_in = ta
+            if attrs.get("transpose_b"):
+                nodes.append(_mk_node("Transpose", [b_in], [tb], tb,
+                                      perm=(0, 2, 1)))
+                b_in = tb
+            nodes.append(_mk_node("MatMul", [a_in, b_in], [name], name))
+            return nodes
+        return [_mk_node("MatMul", ins, [name], name)]
+    if op == "LayerNorm":
+        return [_mk_node("LayerNormalization", ins, [name], name,
+                         axis=int(attrs.get("axis", -1)),
+                         epsilon=float(attrs.get("eps", 1e-5)))]
+    if op == "sqrt":
+        return [_mk_node("Sqrt", ins, [name], name)]
+    if op == "erf":
+        return [_mk_node("Erf", ins, [name], name)]
+    if op in ("_mul_scalar", "_div_scalar", "_plus_scalar", "_minus_scalar",
+              "_rdiv_scalar", "_rminus_scalar"):
+        scalar = float(attrs.get("scalar", 0.0))
+        c_name = name + "_const"
+        extra_init.append(_np_to_tensorproto(
+            c_name, onp.asarray([scalar], "float32")))
+        onnx_op = {"_mul_scalar": "Mul", "_div_scalar": "Div",
+                   "_plus_scalar": "Add", "_minus_scalar": "Sub",
+                   "_rdiv_scalar": "Div", "_rminus_scalar": "Sub"}[op]
+        order = [c_name, ins[0]] if op.startswith("_r") else [ins[0], c_name]
+        return [_mk_node(onnx_op, order, [name], name)]
+    if op == "LeakyReLU" and attrs.get("act_type") == "gelu":
+        # exact gelu as portable primitives: 0.5 * x * (1 + erf(x / sqrt(2)))
+        rt2 = name + "_rt2"
+        half = name + "_half"
+        one = name + "_one"
+        extra_init.append(_np_to_tensorproto(rt2, onp.asarray([2 ** 0.5], "float32")))
+        extra_init.append(_np_to_tensorproto(half, onp.asarray([0.5], "float32")))
+        extra_init.append(_np_to_tensorproto(one, onp.asarray([1.0], "float32")))
+        return [
+            _mk_node("Div", [ins[0], rt2], [name + "_s"], name + "_s"),
+            _mk_node("Erf", [name + "_s"], [name + "_e"], name + "_e"),
+            _mk_node("Add", [name + "_e", one], [name + "_1pe"], name + "_1pe"),
+            _mk_node("Mul", [ins[0], name + "_1pe"], [name + "_x1pe"],
+                     name + "_x1pe"),
+            _mk_node("Mul", [name + "_x1pe", half], [name], name),
+        ]
+    if op in ("_contrib_MultiBoxPrior", "MultiBoxPrior"):
+        # anchors are a pure function of the feature-map shape: evaluate them
+        # at export time and embed as a constant initializer (finalized once
+        # shapes are inferred; see export_model)
+        extra_init.append(("__multibox_prior__", name, node, dict(attrs)))
+        return []
     raise MXNetError(f"onnx export: operator {op!r} not supported")
 
 
@@ -278,6 +345,15 @@ def export_model(sym, params, input_shape=None, input_type="float32",
                        else f"{src.name}_output{idx}")
         for nd_proto in _export_node(node, ins, extra_init):
             g.node.append(nd_proto)
+    node_shapes: dict = {}
+    if any(isinstance(it, tuple) and it[0] == "__multibox_prior__"
+           for it in extra_init):
+        from ...symbol.executor import _infer_shapes
+        known = {n.name: tuple(s) for n, s in zip(data_inputs, in_shapes)
+                 if s is not None}
+        for pname, arr in params.items():
+            known[pname] = tuple(arr.shape)
+        _infer_shapes(sym, known, partial=True, node_shapes_out=node_shapes)
     for item in extra_init:
         if isinstance(item, tuple) and item[0] == "__ones_like__":
             _, ones_name, ref_name = item
@@ -285,6 +361,21 @@ def export_model(sym, params, input_shape=None, input_type="float32",
             ref = ref.asnumpy() if isinstance(ref, NDArray) else onp.asarray(ref)
             g.initializer.append(_np_to_tensorproto(ones_name,
                                                     onp.ones_like(ref)))
+        elif isinstance(item, tuple) and item[0] == "__multibox_prior__":
+            _, prior_name, node, attrs = item
+            src, idx = node.inputs[0]
+            shape = (known.get(src.name) if src.is_var
+                     else node_shapes.get(id(src), [None])[idx])
+            if shape is None:
+                raise MXNetError(
+                    "onnx export: MultiBoxPrior needs a static input shape "
+                    "(pass input_shape to export_model)")
+            from ... import nd as _nd
+            import jax.numpy as _jnp
+            priors = _nd.contrib.MultiBoxPrior(
+                _nd.NDArray(_jnp.zeros(shape, _jnp.float32)), **attrs)
+            g.initializer.append(_np_to_tensorproto(
+                prior_name, priors.asnumpy().astype("float32")))
         else:
             g.initializer.append(item)
 
@@ -334,7 +425,15 @@ def _import_node(node, sym_mod, tensors, inits):
         out = sym_mod.FullyConnected(*ins, num_hidden=int(w.shape[0]),
                                      no_bias=len(ins) == 2, name=name)
     elif op == "MatMul":
-        out = sym_mod.dot(*ins, name=name)
+        # generic rank (ONNX MatMul batches leading dims): np-semantics matmul
+        out = sym_mod.matmul(*ins, name=name)
+    elif op == "LayerNormalization":
+        out = sym_mod.LayerNorm(*ins, axis=_attr(node, "axis", -1),
+                                eps=_attr(node, "epsilon", 1e-5), name=name)
+    elif op == "Erf":
+        out = sym_mod.erf(*ins, name=name)
+    elif op == "Sqrt":
+        out = sym_mod.sqrt(*ins, name=name)
     elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign"):
         act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
                "Softplus": "softrelu", "Softsign": "softsign"}[op]
@@ -342,6 +441,9 @@ def _import_node(node, sym_mod, tensors, inits):
     elif op == "LeakyRelu":
         out = sym_mod.LeakyReLU(*ins, slope=_attr(node, "alpha", 0.01),
                                 name=name)
+    elif op == "Elu":
+        out = sym_mod.LeakyReLU(*ins, act_type="elu",
+                                slope=_attr(node, "alpha", 1.0), name=name)
     elif op == "BatchNormalization":
         # ONNX always applies the stored scale: disable mxnet's fix_gamma
         out = sym_mod.BatchNorm(*ins, eps=_attr(node, "epsilon", 1e-5),
@@ -416,8 +518,12 @@ def import_model(model_file):
     for vi in g.input:
         if vi.name not in inits:
             tensors[vi.name] = sym_mod.Variable(vi.name)
-    for name in inits:
-        tensors[name] = sym_mod.Variable(name)
+    for name, arr in inits.items():
+        # carry the initializer's shape on the variable so bind-time shape
+        # inference needs no hook for it (scalar constants, priors, ...)
+        v = sym_mod.Variable(name)
+        v._node.attrs["__shape__"] = tuple(arr.shape)
+        tensors[name] = v
 
     out = None
     for node in g.node:
