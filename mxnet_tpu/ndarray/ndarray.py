@@ -225,11 +225,57 @@ class NDArray:
             return kd.astype(bool)
         return None
 
+    def _big_static_int(self, key):
+        """True when integer indexing must reroute through STATIC slices:
+        gather/scatter index OPERANDS are int32-bounded here (x64 disabled) —
+        on arrays past 2^31 elements jax's .at[...] truncates its int64 index
+        request and silently corrupts — while static slice bounds live in the
+        HLO as int64 (large-tensor support, test_large_array.py tier)."""
+        big_arr = self._data.size > 2 ** 31 - 1
+        lim = 2 ** 31 - 1
+
+        def is_int(k):
+            return isinstance(k, (int, onp.integer)) \
+                and not isinstance(k, bool)
+
+        if is_int(key):
+            return big_arr or abs(key) > lim
+        if isinstance(key, tuple):
+            ints = [k for k in key if is_int(k)]
+            return bool(ints) and (big_arr or any(abs(k) > lim for k in ints))
+        return False
+
+    def _get_big_int(self, key):
+        # under jit the slice is a STATIC HLO slice (int64 bounds in the
+        # proto); eager slicing would route through dynamic_slice whose index
+        # operands are int32-parsed
+        import jax
+        import jax.numpy as jnp
+        ks = key if isinstance(key, tuple) else (key,)
+
+        def gather(data):
+            squeeze = []
+            for d, k in enumerate(ks):
+                if isinstance(k, int):
+                    kk = k if k >= 0 else k + data.shape[d]
+                    sl = [slice(None)] * data.ndim
+                    sl[d] = slice(kk, kk + 1)
+                    data = data[tuple(sl)]
+                    squeeze.append(d)
+                elif not (isinstance(k, slice) and k == slice(None)):
+                    raise MXNetError("large-int indexing supports int and "
+                                     "':' components only")
+            return jnp.squeeze(data, axis=tuple(squeeze))
+
+        return NDArray(jax.jit(gather)(self._data), ctx=self._ctx)
+
     def __getitem__(self, key) -> "NDArray":
         from ..ops.registry import apply_op
         mask = self._mask_index(key)
         if mask is not None:
             return NDArray(self._data[mask], ctx=self._ctx)
+        if self._big_static_int(key):
+            return self._get_big_int(key)
         key = _canon_index(key)
         return apply_op("_getitem", self, key=key)
 
@@ -249,6 +295,23 @@ class NDArray:
                 host = onp.array(self.asnumpy())
                 host[onp.asarray(mask)] = onp.asarray(value)
                 self._set_data(jnp.asarray(host))
+            return
+        if self._big_static_int(key):
+            k = key if isinstance(key, (int, onp.integer)) else None
+            if k is None:
+                raise MXNetError("large-tensor assignment supports a single "
+                                 "leading int index only")
+            k = int(k) if k >= 0 else int(k) + self._data.shape[0]
+            v = value._data if isinstance(value, NDArray) else value
+            v = jnp.asarray(v, self._data.dtype).reshape(
+                (1,) + self._data.shape[1:])
+            # static-slice concatenation under jit: slice bounds are int64 in
+            # the HLO; eager slicing (and .at[...] scatter) overflows/
+            # truncates int32 index handling on >2^31-element arrays
+            import jax
+            self._set_data(jax.jit(
+                lambda d, vv: jnp.concatenate([d[:k], vv, d[k + 1:]]))(
+                    self._data, v))
             return
         key = _canon_index(key, raw=True)
         if isinstance(value, NDArray):
