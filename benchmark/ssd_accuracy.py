@@ -45,7 +45,8 @@ def make_batch(rng, batch, size=300, max_objects=2):
     return x, labels
 
 
-def main(steps=int(os.environ.get("SSD_STEPS", 400)), batch=8, lr=0.05):
+def main(steps=int(os.environ.get("SSD_STEPS", 400)), batch=8,
+         lr=float(os.environ.get("SSD_LR", 5e-3))):
     import mxnet_tpu as mx
     from mxnet_tpu import nd, parallel
     from mxnet_tpu.gluon.model_zoo import vision
@@ -58,8 +59,9 @@ def main(steps=int(os.environ.get("SSD_STEPS", 400)), batch=8, lr=0.05):
     mesh = parallel.make_mesh({"dp": 1})
     step = parallel.ParallelTrainStep(
         net, SSDMultiBoxLoss(),
-        mx.optimizer.SGD(learning_rate=lr, momentum=0.9, wd=5e-4), mesh,
-        compute_dtype="bfloat16")
+        mx.optimizer.SGD(learning_rate=lr, momentum=0.9, wd=5e-4,
+                         clip_gradient=2.0), mesh,
+        compute_dtype=os.environ.get("SSD_DTYPE") or None)
 
     rng = onp.random.RandomState(0)
     t0 = time.time()

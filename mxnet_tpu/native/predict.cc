@@ -10,6 +10,8 @@
 // The XLA executable does the compute; this file only marshals buffers.
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -48,6 +50,12 @@ void CapturePyError() {
 
 bool EnsurePython() {
   if (!Py_IsInitialized()) {
+    // promote libpython to global visibility for dlopen-hosted embedders
+    // (perl XS etc.): extension modules resolve against it
+    char soname[64];
+    snprintf(soname, sizeof soname, "libpython%d.%d.so.1.0",
+             PY_MAJOR_VERSION, PY_MINOR_VERSION);
+    dlopen(soname, RTLD_NOW | RTLD_GLOBAL);
     Py_InitializeEx(0);  // no signal handlers: the host app owns them
     // release the GIL acquired by initialization; every entry point takes
     // it back via PyGILState_Ensure. Without this, the initializing thread

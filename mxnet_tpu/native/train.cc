@@ -4,6 +4,8 @@
 // it). Only buffers and strings cross the boundary.
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstring>
 #include <string>
 #include <vector>
@@ -36,6 +38,13 @@ void TrCapturePyError() {
 
 bool TrEnsurePython() {
   if (!Py_IsInitialized()) {
+    // hosts that dlopen this library (perl XS, dlopen-based bindings) load
+    // libpython with local visibility; CPython extension modules need its
+    // symbols GLOBAL. Promote before interpreter init.
+    char soname[64];
+    snprintf(soname, sizeof soname, "libpython%d.%d.so.1.0",
+             PY_MAJOR_VERSION, PY_MINOR_VERSION);
+    dlopen(soname, RTLD_NOW | RTLD_GLOBAL);
     Py_InitializeEx(0);
     PyEval_SaveThread();  // entry points re-acquire via PyGILState_Ensure
   }

@@ -1,0 +1,168 @@
+/* XS glue: AI::MXNetTPU over the C training ABI (c_train_api.h) — the same
+ * layering as the reference perl-package (AI::MXNet over c_api.h). */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+#include "c_train_api.h"
+
+static void* av_to_handles(pTHX_ AV* av, unsigned* n) {
+    *n = av_len(av) + 1;
+    void** out = (void**)malloc(sizeof(void*) * (*n));
+    for (unsigned i = 0; i < *n; ++i) {
+        SV** sv = av_fetch(av, i, 0);
+        out[i] = INT2PTR(void*, SvIV(*sv));
+    }
+    return out;
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+const char*
+last_error()
+  CODE:
+    RETVAL = MXTrGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+sym_variable(const char* name)
+  CODE:
+    void* h = NULL;
+    if (MXTrSymbolVariable(name, &h) != 0) croak("%s", MXTrGetLastError());
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+IV
+sym_create(const char* op, const char* name, AV* inputs, const char* attrs_json)
+  CODE:
+    unsigned n = 0;
+    void** ins = (void**)av_to_handles(aTHX_ inputs, &n);
+    void* h = NULL;
+    int rc = MXTrSymbolCreate(op, name, ins, n, attrs_json, &h);
+    free(ins);
+    if (rc != 0) croak("%s", MXTrGetLastError());
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+IV
+simple_bind(IV sym, const char* shapes_json)
+  CODE:
+    void* h = NULL;
+    if (MXTrSimpleBind(INT2PTR(void*, sym), shapes_json, &h) != 0)
+        croak("%s", MXTrGetLastError());
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+list_arguments(IV exec)
+  PPCODE:
+    unsigned n = 0;
+    char* blob = NULL;
+    if (MXTrExecutorListArguments(INT2PTR(void*, exec), &n, &blob) != 0)
+        croak("%s", MXTrGetLastError());
+    const char* p = blob;
+    for (unsigned i = 0; i < n; ++i) {
+        XPUSHs(sv_2mortal(newSVpv(p, 0)));
+        p += strlen(p) + 1;
+    }
+    MXTrBufFree(blob);
+
+unsigned
+arg_size(IV exec, const char* name)
+  CODE:
+    unsigned s = 0;
+    if (MXTrExecutorArgSize(INT2PTR(void*, exec), name, &s) != 0)
+        croak("%s", MXTrGetLastError());
+    RETVAL = s;
+  OUTPUT:
+    RETVAL
+
+unsigned
+output_size(IV exec, unsigned index)
+  CODE:
+    unsigned s = 0;
+    if (MXTrExecutorOutputSize(INT2PTR(void*, exec), index, &s) != 0)
+        croak("%s", MXTrGetLastError());
+    RETVAL = s;
+  OUTPUT:
+    RETVAL
+
+void
+set_arg(IV exec, const char* name, AV* values)
+  CODE:
+    unsigned n = av_len(values) + 1;
+    float* buf = (float*)malloc(sizeof(float) * n);
+    for (unsigned i = 0; i < n; ++i) {
+        SV** sv = av_fetch(values, i, 0);
+        buf[i] = (float)SvNV(*sv);
+    }
+    int rc = MXTrExecutorSetArg(INT2PTR(void*, exec), name, buf, n);
+    free(buf);
+    if (rc != 0) croak("%s", MXTrGetLastError());
+
+void
+get_output(IV exec, unsigned index)
+  PPCODE:
+    unsigned s = 0;
+    if (MXTrExecutorOutputSize(INT2PTR(void*, exec), index, &s) != 0)
+        croak("%s", MXTrGetLastError());
+    float* buf = (float*)malloc(sizeof(float) * s);
+    if (MXTrExecutorGetOutput(INT2PTR(void*, exec), index, buf, s) != 0) {
+        free(buf);
+        croak("%s", MXTrGetLastError());
+    }
+    EXTEND(SP, s);
+    for (unsigned i = 0; i < s; ++i)
+        PUSHs(sv_2mortal(newSVnv(buf[i])));
+    free(buf);
+
+void
+get_grad(IV exec, const char* name)
+  PPCODE:
+    unsigned s = 0;
+    if (MXTrExecutorArgSize(INT2PTR(void*, exec), name, &s) != 0)
+        croak("%s", MXTrGetLastError());
+    float* buf = (float*)malloc(sizeof(float) * s);
+    if (MXTrExecutorGetGrad(INT2PTR(void*, exec), name, buf, s) != 0) {
+        free(buf);
+        croak("%s", MXTrGetLastError());
+    }
+    EXTEND(SP, s);
+    for (unsigned i = 0; i < s; ++i)
+        PUSHs(sv_2mortal(newSVnv(buf[i])));
+    free(buf);
+
+void
+forward(IV exec, int is_train)
+  CODE:
+    if (MXTrExecutorForward(INT2PTR(void*, exec), is_train) != 0)
+        croak("%s", MXTrGetLastError());
+
+void
+backward(IV exec)
+  CODE:
+    if (MXTrExecutorBackward(INT2PTR(void*, exec)) != 0)
+        croak("%s", MXTrGetLastError());
+
+IV
+optimizer_create(const char* type, const char* params_json)
+  CODE:
+    void* h = NULL;
+    if (MXTrOptimizerCreate(type, params_json, &h) != 0)
+        croak("%s", MXTrGetLastError());
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+optimizer_update(IV opt, IV exec, const char* name, int index)
+  CODE:
+    if (MXTrOptimizerUpdate(INT2PTR(void*, opt), INT2PTR(void*, exec),
+                            name, index) != 0)
+        croak("%s", MXTrGetLastError());

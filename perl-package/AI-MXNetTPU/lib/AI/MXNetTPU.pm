@@ -1,0 +1,55 @@
+package AI::MXNetTPU;
+# Perl language binding (parity surface: the reference perl-package
+# AI::MXNet Symbol/Executor/Optimizer training flow over the C API; here a
+# compact OO layer over the libmxtpu_train C ABI via XS glue, MXNetTPU.xs).
+use strict;
+use warnings;
+our $VERSION = '2.0.0';
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+package AI::MXNetTPU::Symbol;
+sub Variable {
+    my ($class, $name) = @_;
+    return bless { h => AI::MXNetTPU::sym_variable($name) }, $class;
+}
+sub create {
+    my ($class, $op, $name, $inputs, $attrs_json) = @_;
+    my @hs = map { $_->{h} } @$inputs;
+    return bless {
+        h => AI::MXNetTPU::sym_create($op, $name, \@hs, $attrs_json // '')
+    }, $class;
+}
+sub simple_bind {
+    my ($self, $shapes_json) = @_;
+    return bless { h => AI::MXNetTPU::simple_bind($self->{h}, $shapes_json) },
+        'AI::MXNetTPU::Executor';
+}
+
+package AI::MXNetTPU::Executor;
+sub list_arguments { my ($self) = @_;
+    return AI::MXNetTPU::list_arguments($self->{h}); }
+sub arg_size { my ($self, $n) = @_;
+    return AI::MXNetTPU::arg_size($self->{h}, $n); }
+sub set_arg { my ($self, $n, $vals) = @_;
+    AI::MXNetTPU::set_arg($self->{h}, $n, $vals); }
+sub get_output { my ($self, $i) = @_;
+    return AI::MXNetTPU::get_output($self->{h}, $i // 0); }
+sub get_grad { my ($self, $n) = @_;
+    return AI::MXNetTPU::get_grad($self->{h}, $n); }
+sub forward { my ($self, $train) = @_;
+    AI::MXNetTPU::forward($self->{h}, $train ? 1 : 0); }
+sub backward { my ($self) = @_;
+    AI::MXNetTPU::backward($self->{h}); }
+
+package AI::MXNetTPU::Optimizer;
+sub new {
+    my ($class, $type, $params_json) = @_;
+    return bless {
+        h => AI::MXNetTPU::optimizer_create($type, $params_json // '')
+    }, $class;
+}
+sub update { my ($self, $exec, $name, $index) = @_;
+    AI::MXNetTPU::optimizer_update($self->{h}, $exec->{h}, $name, $index); }
+
+1;

@@ -1,0 +1,73 @@
+# Train a tiny classifier from Perl through the C training ABI — the
+# reference perl-package training-flow shape (Symbol -> bind -> SGD loop).
+use strict;
+use warnings;
+use Test::More tests => 3;
+use AI::MXNetTPU;
+
+my $data  = AI::MXNetTPU::Symbol->Variable('data');
+my $label = AI::MXNetTPU::Symbol->Variable('softmax_label');
+my $fc1 = AI::MXNetTPU::Symbol->create('FullyConnected', 'fc1', [$data],
+                                       '{"num_hidden": 16}');
+my $act = AI::MXNetTPU::Symbol->create('Activation', 'act1', [$fc1],
+                                       '{"act_type": "relu"}');
+my $fc2 = AI::MXNetTPU::Symbol->create('FullyConnected', 'fc2', [$act],
+                                       '{"num_hidden": 4}');
+my $net = AI::MXNetTPU::Symbol->create('SoftmaxOutput', 'softmax',
+                                       [$fc2, $label],
+                                       '{"normalization": "batch"}');
+my $B = 16; my $F = 8; my $C = 4;
+my $exec = $net->simple_bind('{"data": [16, 8], "softmax_label": [16]}');
+my @args = $exec->list_arguments();
+ok(scalar(@args) >= 6, 'arguments listed');
+
+srand(7);
+for my $name (@args) {
+    next if $name eq 'data' or $name eq 'softmax_label';
+    my $n = $exec->arg_size($name);
+    my @w = map { ($name =~ /weight/) ? (rand() - 0.5) * 0.6 : 0 } 1 .. $n;
+    $exec->set_arg($name, \@w);
+}
+my $sgd = AI::MXNetTPU::Optimizer->new('sgd', '{"learning_rate": 0.5}');
+
+my (@x, @y);
+sub make_batch {
+    @x = (); @y = ();
+    for my $i (0 .. $B - 1) {
+        my $c = $i % $C;
+        push @y, $c;
+        for my $j (0 .. $F - 1) {
+            push @x, (($j % $C) == $c ? 1.0 : 0.0) + (rand() - 0.5) * 0.4;
+        }
+    }
+}
+
+my ($first_acc, $last_acc);
+for my $step (0 .. 39) {
+    make_batch();
+    $exec->set_arg('data', \@x);
+    $exec->set_arg('softmax_label', \@y);
+    $exec->forward(1);
+    $exec->backward();
+    my @p = $exec->get_output(0);
+    my $correct = 0;
+    for my $i (0 .. $B - 1) {
+        my ($best, $bv) = (0, $p[$i * $C]);
+        for my $c (1 .. $C - 1) {
+            if ($p[$i * $C + $c] > $bv) { $best = $c; $bv = $p[$i * $C + $c]; }
+        }
+        $correct++ if $best == $y[$i];
+    }
+    my $acc = $correct / $B;
+    $first_acc = $acc if $step == 0;
+    $last_acc = $acc;
+    my $idx = 0;
+    for my $name (@args) {
+        $sgd->update($exec, $name, $idx)
+            unless $name eq 'data' or $name eq 'softmax_label';
+        $idx++;
+    }
+}
+ok($last_acc > 0.9, "trained to accuracy $last_acc");
+my @g = $exec->get_grad('fc2_weight');
+ok(scalar(@g) == $exec->arg_size('fc2_weight'), 'gradients readable');
