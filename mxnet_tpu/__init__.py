@@ -20,7 +20,7 @@ import jax as _jax
 _jax.config.update("jax_default_matmul_precision",
                    _os.environ.get("MXNET_MATMUL_PRECISION", "highest"))
 
-from .base import Context, MXNetError, cpu, gpu, tpu, num_gpus, current_context
+from .base import Context, MXNetError, cpu, gpu, tpu, num_gpus, num_tpus, current_context
 from . import base
 from . import ops
 from . import ndarray

@@ -16,7 +16,8 @@ from ...block import HybridBlock
 from ...loss import Loss
 from ....initializer import Constant
 
-__all__ = ["SSD", "ssd_300_vgg16", "SSDMultiBoxLoss", "MApMetric"]
+__all__ = ["SSD", "ssd_300_vgg16", "ssd_96_tiny", "SSDMultiBoxLoss",
+           "MApMetric"]
 
 # per-scale anchor config (example/ssd/symbol/symbol_factory.py get_config('vgg16_reduced', 300))
 _SIZES = [(0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
@@ -81,38 +82,58 @@ class SSD(HybridBlock):
     N = 8732 for 300x300 input.
     """
 
-    def __init__(self, num_classes=20, **kwargs):
+    def __init__(self, num_classes=20, backbone=None, extras_spec=None,
+                 sizes=None, ratios=None, **kwargs):
         super().__init__(**kwargs)
         self.num_classes = num_classes
+        vgg = backbone is None
+        self._sizes = _SIZES if sizes is None else sizes
+        self._ratios = _RATIOS if ratios is None else ratios
+        if len(self._sizes) != len(self._ratios):
+            raise ValueError(
+                f"sizes ({len(self._sizes)} scales) and ratios "
+                f"({len(self._ratios)}) must have one entry per feature scale")
+        if extras_spec is None:
+            # (mid, out, stride, padding) per extra scale (symbol_builder.py)
+            extras_spec = [(256, 512, 2, 1),    # 10x10
+                           (128, 256, 2, 1),    # 5x5
+                           (128, 256, 1, 0),    # 3x3
+                           (128, 256, 1, 0)] if vgg else []
         with self.name_scope():
-            self.backbone = _VGG16Reduced()
+            self.backbone = _VGG16Reduced() if vgg else backbone
             self.extras = nn.HybridSequential()
-            self.extras.add(_ExtraLayer(256, 512, 2, 1),   # 10x10
-                            _ExtraLayer(128, 256, 2, 1),   # 5x5
-                            _ExtraLayer(128, 256, 1, 0),   # 3x3
-                            _ExtraLayer(128, 256, 1, 0))   # 1x1
+            for mid, out, stride, padding in extras_spec:
+                self.extras.add(_ExtraLayer(mid, out, stride, padding))
             self.cls_heads = nn.HybridSequential()
             self.loc_heads = nn.HybridSequential()
-            for sizes, ratios in zip(_SIZES, _RATIOS):
-                na = len(sizes) + len(ratios) - 1
+            for sizes_i, ratios_i in zip(self._sizes, self._ratios):
+                na = len(sizes_i) + len(ratios_i) - 1
                 self.cls_heads.add(nn.Conv2D(na * (num_classes + 1), 3,
                                              padding=1))
                 self.loc_heads.add(nn.Conv2D(na * 4, 3, padding=1))
-            # conv4_3 feature scale (symbol_builder.py L2Normalization scale=20)
-            self.conv4_3_scale = self.params.get(
-                "conv4_3_scale", shape=(1, 512, 1, 1), init=Constant(20.0))
+            if vgg:
+                # conv4_3 feature scale (symbol_builder.py L2Normalization
+                # scale=20); custom backbones skip the normalization
+                self.conv4_3_scale = self.params.get(
+                    "conv4_3_scale", shape=(1, 512, 1, 1), init=Constant(20.0))
 
-    def hybrid_forward(self, F, x, conv4_3_scale):
-        c4, c7 = self.backbone(x)
-        c4 = F.L2Normalization(c4, mode="channel") * conv4_3_scale
-        feats = [c4, c7]
-        f = c7
+    def hybrid_forward(self, F, x, conv4_3_scale=None):
+        feats = list(self.backbone(x))
+        if conv4_3_scale is not None:
+            feats[0] = F.L2Normalization(feats[0], mode="channel") \
+                * conv4_3_scale
+        f = feats[-1]
         for blk in self.extras:
             f = blk(f)
             feats.append(f)
+        if len(feats) != len(self._sizes):
+            raise ValueError(
+                f"anchor config has {len(self._sizes)} scales but the "
+                f"backbone+extras produce {len(feats)} feature maps; pass "
+                "matching sizes=/ratios= when using a custom backbone")
         anchors, cls_preds, loc_preds = [], [], []
-        for i, (f, (sizes, ratios)) in enumerate(zip(feats,
-                                                     zip(_SIZES, _RATIOS))):
+        for i, (f, (sizes, ratios)) in enumerate(
+                zip(feats, zip(self._sizes, self._ratios))):
             anchors.append(F.contrib.MultiBoxPrior(f, sizes=sizes,
                                                    ratios=ratios, clip=False))
             c = self.cls_heads[i](f)
@@ -249,3 +270,39 @@ class MApMetric:
 def ssd_300_vgg16(classes=20, **kwargs):
     """SSD-300 with VGG16-reduced (BASELINE config 4)."""
     return SSD(num_classes=classes, **kwargs)
+
+
+class _TinyFeatures(HybridBlock):
+    """Small two-scale feature extractor for 96x96 inputs (12x12 and 6x6)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stage1 = nn.HybridSequential()          # 96 -> 12
+            for ch in (16, 32, 64):
+                self.stage1.add(
+                    nn.Conv2D(ch, 3, padding=1, activation="relu"),
+                    nn.Conv2D(ch, 3, padding=1, activation="relu"),
+                    nn.MaxPool2D(2, strides=2))
+            self.stage2 = nn.HybridSequential()          # 12 -> 6
+            self.stage2.add(nn.Conv2D(128, 3, padding=1, activation="relu"),
+                            nn.MaxPool2D(2, strides=2))
+
+    def hybrid_forward(self, F, x):
+        f1 = self.stage1(x)
+        return f1, self.stage2(f1)
+
+
+def ssd_96_tiny(classes=3, **kwargs):
+    """Small SSD for 96x96 inputs over the same multibox machinery.
+
+    Four scales (12, 6, 3, 1); 760 anchors. Exists so detection training can
+    be exercised end-to-end (train -> detect -> mAP) cheaply on CPU CI; the
+    full-size path is ssd_300_vgg16.
+    """
+    return SSD(num_classes=classes, backbone=_TinyFeatures(),
+               extras_spec=[(64, 128, 2, 1),    # 6 -> 3
+                            (64, 128, 1, 0)],   # 3 -> 1
+               sizes=[(0.1, 0.16), (0.25, 0.35),
+                      (0.45, 0.6), (0.75, 0.9)],
+               ratios=[(1.0, 2.0, 0.5)] * 4, **kwargs)

@@ -196,3 +196,68 @@ def environment(name, value):
             else:
                 os.environ[name] = old
     return _scope()
+
+
+def get_shapes_detection(num_images, size=96, max_objects=3, num_classes=3,
+                         seed=0, min_frac=4):
+    """Synthetic detection dataset: solid geometric shapes on a noise
+    background (the SSD accuracy-evidence set; reference analogue:
+    example/ssd's train/evaluate pipeline run on a small real set).
+
+    Classes are distinguished by geometry alone (color is random):
+    0 = filled square, 1 = disc, 2 = cross. Returns
+
+        images : (N, 3, size, size) float32 in [0, 1]
+        labels : (N, max_objects, 5) float32 rows [cls, x1, y1, x2, y2]
+                 (corner format, normalized to [0, 1]; -1 rows are padding)
+
+    Placements are rejection-sampled so boxes barely overlap (IoU <= 0.2):
+    every labeled object stays visible, so the ground truth is exact and a
+    perfect detector can reach mAP ~1.0.
+    """
+    rng = onp.random.RandomState(seed)
+    imgs = onp.empty((num_images, 3, size, size), onp.float32)
+    labels = -onp.ones((num_images, max_objects, 5), onp.float32)
+
+    def _iou(a, b):
+        ix = max(0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / max(ua, 1)
+
+    for i in range(num_images):
+        img = rng.uniform(0.0, 0.25, (3, size, size)).astype(onp.float32)
+        placed = []
+        j = 0
+        for _ in range(rng.randint(1, max_objects + 1)):
+            cls = rng.randint(num_classes)
+            for _try in range(20):
+                s = rng.randint(size // min_frac, size // 2)
+                x1 = rng.randint(0, size - s)
+                y1 = rng.randint(0, size - s)
+                box = (x1, y1, x1 + s, y1 + s)
+                if all(_iou(box, p) <= 0.2 for p in placed):
+                    break
+            else:
+                continue
+            placed.append(box)
+            color = rng.uniform(0.6, 1.0, 3).astype(onp.float32)
+            yy, xx = onp.mgrid[0:s, 0:s]
+            c = (s - 1) / 2.0
+            if cls == 0:
+                mask = onp.ones((s, s), bool)
+            elif cls == 1:
+                mask = (yy - c) ** 2 + (xx - c) ** 2 <= (s / 2.0) ** 2
+            else:
+                t = max(s // 4, 1)
+                mask = (onp.abs(xx - c) <= t / 2.0) | (onp.abs(yy - c) <= t / 2.0)
+            region = img[:, y1:y1 + s, x1:x1 + s]
+            img[:, y1:y1 + s, x1:x1 + s] = onp.where(
+                mask[None], color[:, None, None], region)
+            labels[i, j] = [cls, x1 / size, y1 / size,
+                            (x1 + s) / size, (y1 + s) / size]
+            j += 1
+        imgs[i] = img
+    return imgs, labels

@@ -1,0 +1,98 @@
+"""Model backwards-compatibility harness (parity:
+tests/nightly/model_backwards_compatibility_check/ — checkpoints written by
+old framework versions must keep loading and predicting identically on the
+current one).
+
+Every directory under tests/fixtures/compat/ is a frozen artifact set written
+by tools/gen_compat_fixtures.py under SOME past version; this test sweeps all
+of them forever. When a serialization path changes, add a new vN directory —
+never regenerate an old one (that would defeat the guard).
+"""
+import glob
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+FIXTURE_ROOT = os.path.join(os.path.dirname(__file__), "fixtures", "compat")
+VERSIONS = sorted(os.path.basename(d)
+                  for d in glob.glob(os.path.join(FIXTURE_ROOT, "v*")))
+
+
+def test_fixture_versions_exist():
+    assert VERSIONS, f"no compat fixtures under {FIXTURE_ROOT}"
+
+
+@pytest.mark.parametrize("version", VERSIONS)
+def test_manifest_is_complete(version):
+    d = os.path.join(FIXTURE_ROOT, version)
+    with open(os.path.join(d, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    on_disk = sorted(f for f in os.listdir(d) if f != "MANIFEST.json")
+    assert manifest["files"] == on_disk
+
+
+@pytest.mark.parametrize("version", VERSIONS)
+def test_params_files_keep_reference_byte_layout(version):
+    """The .params files must stay in the reference binary layout (magic
+    0x112; see tests/test_checkpoint_format.py) in every frozen version."""
+    d = os.path.join(FIXTURE_ROOT, version)
+    for name in ("module_mlp-0001.params", "gluon_cnn-0000.params"):
+        with open(os.path.join(d, name), "rb") as f:
+            header = f.read(8)
+        magic = int.from_bytes(header[:8], "little")
+        assert magic == 0x112, f"{version}/{name}: magic {magic:#x}"
+
+
+@pytest.mark.parametrize("version", VERSIONS)
+def test_module_checkpoint_loads_and_predicts(version):
+    """mx.model.load_checkpoint on an old checkpoint reproduces the stored
+    predictions bit-for-tolerance."""
+    d = os.path.join(FIXTURE_ROOT, version)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        os.path.join(d, "module_mlp"), 1)
+    x = onp.load(os.path.join(d, "input.npy"))
+    expected = onp.load(os.path.join(d, "expected_module.npy"))
+    exe = sym.simple_bind(mx.cpu(), data=x.shape, grad_req="null")
+    exe.copy_params_from(arg_params, aux_params)
+    out = exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    onp.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("version", VERSIONS)
+def test_gluon_parameters_load_and_predict(version):
+    """HybridBlock.load_parameters on an old .params file reproduces the
+    stored predictions (requires rebuilding the same architecture, as the
+    reference harness does)."""
+    from mxnet_tpu import gluon
+    d = os.path.join(FIXTURE_ROOT, version)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+                gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(10))
+    net.load_parameters(os.path.join(d, "gluon_cnn.params"))
+    x = onp.load(os.path.join(d, "input_img.npy"))
+    expected = onp.load(os.path.join(d, "expected_gluon.npy"))
+    out = net(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("version", VERSIONS)
+def test_exported_symbol_imports_and_predicts(version):
+    """SymbolBlock.imports on an old export (symbol json + params) works
+    architecture-free — the json alone must keep describing the graph."""
+    from mxnet_tpu import gluon
+    d = os.path.join(FIXTURE_ROOT, version)
+    net = gluon.SymbolBlock.imports(
+        os.path.join(d, "gluon_cnn-symbol.json"), ["data"],
+        os.path.join(d, "gluon_cnn-0000.params"))
+    x = onp.load(os.path.join(d, "input_img.npy"))
+    expected = onp.load(os.path.join(d, "expected_gluon.npy"))
+    out = net(nd.array(x)).asnumpy()
+    onp.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)

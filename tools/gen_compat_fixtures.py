@@ -1,0 +1,113 @@
+"""Generate model backwards-compatibility fixtures.
+
+Reference analogue: tests/nightly/model_backwards_compatibility_check/ —
+models saved by OLD framework versions must keep loading (and predicting
+identically) on every newer version. Each release that touches any
+serialization path should add a new `tests/fixtures/compat/v<N>/` directory
+with this script (run under that release) and NEVER modify older ones;
+tests/test_model_compat.py sweeps every committed version directory forever.
+
+Artifacts per version (all tiny, CPU-generated, deterministic weights):
+  module_mlp-symbol.json / module_mlp-0001.params   mx.model.save_checkpoint
+  gluon_cnn.params                                  HybridBlock.save_parameters
+  gluon_cnn-symbol.json / gluon_cnn-0000.params     HybridBlock.export
+  input.npy                                          fixed test input
+  expected_module.npy / expected_gluon.npy           predictions to reproduce
+  MANIFEST.json                                      versions + file list
+
+Usage:
+    python tools/gen_compat_fixtures.py --version v1
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx                                     # noqa: E402
+from mxnet_tpu import nd                                   # noqa: E402
+from mxnet_tpu import gluon                                # noqa: E402
+
+
+def build_module_mlp(out_dir):
+    """Symbol/Module-API MLP with fixed weights -> save_checkpoint files."""
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc2")
+
+    rng = onp.random.RandomState(42)
+    args = {
+        "fc1_weight": nd.array(rng.randn(32, 16).astype("float32") * 0.1),
+        "fc1_bias": nd.array(rng.randn(32).astype("float32") * 0.1),
+        "fc2_weight": nd.array(rng.randn(8, 32).astype("float32") * 0.1),
+        "fc2_bias": nd.array(rng.randn(8).astype("float32") * 0.1),
+    }
+    mx.model.save_checkpoint(os.path.join(out_dir, "module_mlp"), 1,
+                             net, args, {})
+
+    x = rng.randn(4, 16).astype("float32")
+    exe = net.simple_bind(mx.cpu(), data=(4, 16), grad_req="null")
+    exe.copy_params_from(args, {})
+    out = exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    return x, out
+
+
+def build_gluon_cnn(out_dir, x_img):
+    """Gluon CNN with fixed weights -> save_parameters + export files."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+                gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(10))
+    net.initialize(mx.init.Zero())
+    net.hybridize()
+    net(nd.array(x_img))      # materialize deferred shapes
+    rng = onp.random.RandomState(7)
+    for name, p in net.collect_params().items():
+        p.set_data(nd.array(rng.randn(*p.shape).astype("float32") * 0.1))
+    out = net(nd.array(x_img)).asnumpy()
+    net.save_parameters(os.path.join(out_dir, "gluon_cnn.params"))
+    net.export(os.path.join(out_dir, "gluon_cnn"))
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--version", default="v1")
+    p.add_argument("--out-root", default=os.path.join(
+        os.path.dirname(__file__), "..", "tests", "fixtures", "compat"))
+    args = p.parse_args()
+    out_dir = os.path.join(args.out_root, args.version)
+    os.makedirs(out_dir, exist_ok=True)
+
+    x, out_module = build_module_mlp(out_dir)
+    rng = onp.random.RandomState(3)
+    x_img = rng.rand(2, 3, 8, 8).astype("float32")
+    out_gluon = build_gluon_cnn(out_dir, x_img)
+
+    onp.save(os.path.join(out_dir, "input.npy"), x)
+    onp.save(os.path.join(out_dir, "input_img.npy"), x_img)
+    onp.save(os.path.join(out_dir, "expected_module.npy"), out_module)
+    onp.save(os.path.join(out_dir, "expected_gluon.npy"), out_gluon)
+
+    from mxnet_tpu import libinfo
+    manifest = {
+        "fixture_version": args.version,
+        "framework_version": getattr(libinfo, "__version__", "unknown"),
+        "files": sorted(f for f in os.listdir(out_dir)
+                        if f != "MANIFEST.json"),
+    }
+    with open(os.path.join(out_dir, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(f"wrote {out_dir}: {manifest['files']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
