@@ -509,14 +509,38 @@ def _on_tpu():
         return False
 
 
+# Below this sequence length the tiled kernel pays more in padding than it
+# saves in HBM traffic, and Mosaic rejects sub-tile dot operands outright on
+# real hardware ("Bad lhs type" for e.g. S=16/D=32 — hit by BERT-tiny
+# configs). The dense path is exact, differentiable, and at these sizes the
+# (S x S) score matrix is small enough that materializing it is the FAST
+# choice.
+_MIN_PALLAS_S = 128
+
+
+def _dense_attention(q, k, v, sm_scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        S, Sk = q.shape[2], k.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((S, Sk), bool)), s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
 @register("flash_attention", jit=True)
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=None):
     """Fused attention over (B, H, S, D). Pallas kernel on TPU; interpreter
-    (still the same kernel) elsewhere so tests exercise identical code."""
+    (still the same kernel) elsewhere so tests exercise identical code.
+    Sub-tile sequences (S < 128) take a dense XLA path instead — see
+    _MIN_PALLAS_S above."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[2] < _MIN_PALLAS_S:
+        return _dense_attention(q, k, v, float(sm_scale), bool(causal))
     if interpret is None:
         interpret = not _on_tpu()
     return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),

@@ -10,8 +10,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(script, *args, timeout=420):
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    # repo-only PYTHONPATH: an inherited accelerator-plugin site path would
+    # re-pin jax onto the (single-tenant) TPU tunnel despite JAX_PLATFORMS
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     r = subprocess.run([sys.executable, os.path.join(REPO, script), *args],
                        capture_output=True, text=True, timeout=timeout,
                        env=env)

@@ -161,3 +161,32 @@ def test_shipped_default_blocks_backward(causal):
     for gt, w, name in zip(got, want, "q k v".split()):
         onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
                                     rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_small_shapes_take_dense_path(causal):
+    """S below the tile minimum must dispatch to the dense XLA path — on real
+    hardware Mosaic rejects sub-tile dot operands ("Bad lhs type" at
+    S=16/D=32, the BERT-tiny config from examples/bert), so tiny models
+    crashed outright before the fallback. Values and grads must match the
+    dense reference exactly (it IS the dense reference)."""
+    from mxnet_tpu.ops.pallas.flash_attention import _MIN_PALLAS_S
+    rng = onp.random.RandomState(11)
+    B, H, S, D = 2, 2, 16, 32
+    assert S < _MIN_PALLAS_S
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    k = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    v = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
+    g = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+
+    got = flash_attention(q, k, v, causal=causal)
+    want = _dense(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=1e-5, atol=1e-5)
+    got_g = jax.grad(lambda *a: (flash_attention(*a, causal=causal) * g).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(lambda *a: (_dense(*a, causal=causal) * g).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+    for gt, w, name in zip(got_g, want_g, "q k v".split()):
+        onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
+                                    rtol=1e-5, atol=1e-5, err_msg=name)
