@@ -655,6 +655,11 @@ def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=Fal
         from .pallas.flash_attention import flash_attention
         out = flash_attention(qh, kh, vh, causal=causal)
         return out.transpose(0, 2, 1, 3).reshape(N, Lq, heads * D)
+    if mask is None:
+        # same dense SDPA the flash op's sub-tile fallback uses — one copy
+        from .pallas.flash_attention import _dense_attention
+        out = _dense_attention(qh, kh, vh, 1.0 / math.sqrt(D), causal)
+        return out.transpose(0, 2, 1, 3).reshape(N, Lq, heads * D)
     att = jnp.einsum("nhld,nhmd->nhlm", qh, kh,
                      preferred_element_type=jnp.float32) / math.sqrt(D)
     if causal:

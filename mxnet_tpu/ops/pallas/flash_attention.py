@@ -34,6 +34,19 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU lane width; lse is broadcast across it for layout legality
 
 
+def _dot_precision(dtype):
+    """Explicit contraction precision for every kernel dot (Mosaic ignores
+    no kwarg — it inherits the GLOBAL jax_default_matmul_precision=highest
+    set in mxnet_tpu/__init__.py, and REJECTS that f32-emulation request on
+    bf16 MXU operands: "Bad lhs type" at compile time, real hardware only —
+    interpret mode never sees it; tests/test_pallas_source_guards.py pins
+    the kwarg's presence). bf16 operands: DEFAULT — a single MXU pass is
+    already exact bf16. f32 operands: HIGHEST — keeps the package's
+    fp32-exactness contract inside the kernel too."""
+    return (jax.lax.Precision.DEFAULT if dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
 def _masked_scores(q, k_blk, sm_scale, mask_causal, mask_tail, q_offset,
                    k_offset, block_q, block_k, seq_len):
     """q @ k^T * scale with the causal/padded-tail masks this block class
@@ -42,7 +55,8 @@ def _masked_scores(q, k_blk, sm_scale, mask_causal, mask_tail, q_offset,
     8x-slower fp32 rate. Shared by the forward and both backward kernels so
     the masking logic exists exactly once."""
     s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * sm_scale
+                            preferred_element_type=jnp.float32,
+                            precision=_dot_precision(q.dtype)) * sm_scale
     if mask_causal or mask_tail:
         cols = k_offset + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -147,7 +161,8 @@ def _attention_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_new = l_acc * alpha + jnp.sum(p, axis=1)
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(v_blk.dtype))
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
@@ -274,12 +289,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
                            q_offset, k_offset, block_q, block_k, seq_len)
         p = jnp.exp(s - lse_ref[0, :, 0][:, None])
         dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_dot_precision(g.dtype))
         ds = (p * (dp - delta_ref[0, :, 0][:, None]) * sm_scale).astype(
             k_blk.dtype)
         acc_scr[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(ds.dtype))
 
     _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
                    seq_len, _do)
@@ -323,14 +340,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         p_lo = p.astype(g.dtype)
         dv_scr[...] += jax.lax.dot_general(
             p_lo, g, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(p_lo.dtype))
         dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_dot_precision(g.dtype))
         ds = (p * (dp - delta_ref[0, :, 0][:, None]) * sm_scale).astype(
             q.dtype)
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_dot_precision(ds.dtype))
 
     _mask_dispatch(pl, work, causal, q_offset, k_offset, block_q, block_k,
                    seq_len, _do)

@@ -114,6 +114,7 @@ def conv1x1_bn_act_reference(x, w, scale, shift, *, relu=True):
         xh = jnp.maximum(xh, 0.0)
     y = jax.lax.dot_general(xh.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
                             (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.DEFAULT)
     return (y.astype(jnp.bfloat16), jnp.sum(y, axis=0),
             jnp.sum(y * y, axis=0))

@@ -1,0 +1,54 @@
+"""Source-level guards for Pallas kernel code.
+
+Mosaic-only compile failures cannot be caught by the CPU suite (interpret
+mode ignores them), so the properties that broke on real hardware are pinned
+at the source level here.
+
+Guard 1 — explicit contraction precision: the package sets
+jax_default_matmul_precision=highest (fp32-exact contractions for fp32
+users, mxnet_tpu/__init__.py). Mosaic REJECTS that global on a bf16 MXU
+contract ("Bad lhs type") at kernel compile time, which took down both the
+flash-attention path (BERT bench, bert-tiny examples) and would have taken
+down fused_conv1x1 — on real TPUs only. Every dot inside a Pallas kernel
+file must therefore pass precision= explicitly.
+"""
+import ast
+import glob
+import os
+
+import pytest
+
+PALLAS_DIR = os.path.join(os.path.dirname(__file__), "..", "mxnet_tpu",
+                          "ops", "pallas")
+KERNEL_FILES = sorted(glob.glob(os.path.join(PALLAS_DIR, "*.py")))
+
+
+def _dot_calls(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = None
+            if isinstance(f, ast.Attribute):
+                name = f.attr
+            elif isinstance(f, ast.Name):
+                name = f.id
+            if name in ("dot_general", "dot"):
+                yield node
+
+
+def test_kernel_files_exist():
+    assert KERNEL_FILES, PALLAS_DIR
+
+
+@pytest.mark.parametrize("path", KERNEL_FILES,
+                         ids=[os.path.basename(p) for p in KERNEL_FILES])
+def test_every_kernel_dot_pins_precision(path):
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    missing = [n.lineno for n in _dot_calls(tree)
+               if not any(kw.arg == "precision" for kw in n.keywords)]
+    assert not missing, (
+        f"{os.path.basename(path)}: dot_general/dot at line(s) {missing} "
+        "without an explicit precision= — Mosaic rejects the global "
+        "jax_default_matmul_precision=highest on bf16 operands on real TPUs "
+        "('Bad lhs type'); pass precision=jax.lax.Precision.DEFAULT")
