@@ -199,20 +199,44 @@ def bench_bert():
     _emit("bert_base_pretrain_tok_s_per_chip", tok_s, "tokens/s", None)
 
 
+def _section(name, fn):
+    """Isolate one bench section: a crashed section must not take down the
+    later ones, and its failure must be VISIBLE in the JSON stream — a
+    missing metric row reads as 'not run', which is how a kernel-compile
+    regression hid the BERT number for half a round."""
+    try:
+        fn()
+        return True
+    except Exception as e:  # noqa: BLE001 — report-and-continue by design
+        import traceback
+        traceback.print_exc()
+        # full schema (value/unit/vs_baseline) so JSONL consumers parse it,
+        # and routed through _EMITTED so the headline tail re-emit still
+        # fires — the error row must never end up as the recorded tail line
+        row = {"metric": f"{name}_error", "value": None, "unit": "error",
+               "vs_baseline": None,
+               "error": f"{type(e).__name__}: {e}"[:500]}
+        _EMITTED.append(row)
+        print(json.dumps(row), flush=True)
+        return False
+
+
 def main():
     # ORDER = survival priority under an external timeout: the two metrics of
     # record (resnet b32 train, bert pretrain) emit before the secondary
     # rows, so a killed run still reports the headline numbers.
     which = os.environ.get("BENCH_ONLY", "").split(",") if \
         os.environ.get("BENCH_ONLY") else ["resnet", "bert", "infer"]
+    ok = True
     if "resnet" in which:
-        bench_resnet(batches=(32,))
+        ok &= _section("resnet50_train", lambda: bench_resnet(batches=(32,)))
     if "bert" in which:
-        bench_bert()
+        ok &= _section("bert_base_pretrain", bench_bert)
     if "resnet" in which:
-        bench_resnet(batches=(128,))
+        ok &= _section("resnet50_train_b128",
+                       lambda: bench_resnet(batches=(128,)))
     if "infer" in which:
-        bench_resnet_inference()
+        ok &= _section("resnet50_infer", bench_resnet_inference)
     # the driver records only the TAIL of this output: re-emit JUST the two
     # metrics of record (bert, then resnet b32 last) so they are the final
     # lines, while the priority-first order above still survives an external
@@ -220,11 +244,13 @@ def main():
     # drop them instead of double-counting the duplicated measurements.
     headline = ("bert_base_pretrain_tok_s_per_chip",
                 "resnet50_train_img_s_per_chip")
-    rows = {r["metric"]: r for r in _EMITTED}
+    rows = {r["metric"]: r for r in _EMITTED
+            if r.get("error") is None}
     tail_rows = [rows[m] for m in headline if m in rows]
     if len(_EMITTED) > len(tail_rows):
         for row in tail_rows:
             print(json.dumps({**row, "summary": True}), flush=True)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":
