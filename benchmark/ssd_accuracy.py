@@ -23,7 +23,7 @@ def main():
     from train_shapes import evaluate, train
     from mxnet_tpu.test_utils import get_shapes_detection
 
-    steps = int(os.environ.get("SSD_STEPS", 1500))
+    steps = int(os.environ.get("SSD_STEPS", 1200))
     batch = int(os.environ.get("SSD_BATCH", 32))
     lr = float(os.environ.get("SSD_LR", 1e-3))
     bf16 = os.environ.get("SSD_DTYPE", "bfloat16") == "bfloat16"

@@ -18,7 +18,7 @@ pipeline: benchmark/ssd_accuracy.py wraps it for the committed-evidence JSON
 line, and tests/test_ssd.py runs the same dataset/metric at tiny scale.
 
 Usage (on-chip numbers recorded in PERF.md):
-    python examples/ssd/train_shapes.py --steps 1500
+    python examples/ssd/train_shapes.py --steps 1200
 """
 import argparse
 import time
@@ -44,7 +44,7 @@ def evaluate(net, val_imgs, val_labels, batch_size, ctx, threshold=0.01):
     return metric.get()[1]
 
 
-def train(steps=1500, batch_size=32, steps_per_dispatch=25, train_images=512,
+def train(steps=1200, batch_size=32, steps_per_dispatch=25, train_images=512,
           lr=1e-3, bf16=True, seed=0, log=print):
     """Train SSD-300 on the shapes set; returns (net, ctx, imgs_per_s).
 
@@ -53,9 +53,13 @@ def train(steps=1500, batch_size=32, steps_per_dispatch=25, train_images=512,
     imgs, labels = get_shapes_detection(train_images, size=300, seed=seed)
     ctx = mx.tpu(0) if mx.num_tpus() else mx.cpu()
     net = vision.get_model("ssd_300_vgg16", classes=3)
-    net.initialize(mx.init.Xavier(), ctx=ctx)
+    # materialize deferred-shape params with ONE batch-1 forward on the CPU
+    # backend: only the shapes matter here, ParallelTrainStep re-places the
+    # params on the mesh anyway, and this skips compiling a throwaway
+    # batch-1 graph on the accelerator
+    net.initialize(mx.init.Xavier())
+    net(nd.array(imgs[:1]))
     net.hybridize()
-    net(nd.array(imgs[:1], ctx=ctx))   # materialize deferred-shape params
 
     import jax
     dp = jax.device_count()
@@ -81,11 +85,15 @@ def train(steps=1500, batch_size=32, steps_per_dispatch=25, train_images=512,
     imgs_dev = jax.device_put(jnp.asarray(imgs), mesh.replicated())
     labels_dev = jax.device_put(jnp.asarray(labels), mesh.replicated())
 
+    # the dataset arrays must be jit ARGUMENTS, not closure captures — jax
+    # bakes closed-over arrays into the program as constants, and a ~550 MB
+    # constant blob blows up compilation (the tunnel's compile endpoint
+    # rejects the payload outright with HTTP 413)
     @jax.jit
-    def gather(idx):
-        return (jnp.take(imgs_dev, idx.reshape(-1), axis=0)
+    def gather(imgs_d, labels_d, idx):
+        return (jnp.take(imgs_d, idx.reshape(-1), axis=0)
                 .reshape(idx.shape + imgs.shape[1:]),
-                jnp.take(labels_dev, idx.reshape(-1), axis=0)
+                jnp.take(labels_d, idx.reshape(-1), axis=0)
                 .reshape(idx.shape + labels.shape[1:]))
 
     rng = onp.random.RandomState(7)
@@ -93,19 +101,20 @@ def train(steps=1500, batch_size=32, steps_per_dispatch=25, train_images=512,
     done = 0
     while done < steps:
         idx = rng.randint(0, len(imgs), (k, b)).astype("int32")
-        xs, ys = gather(jnp.asarray(idx))
+        xs, ys = gather(imgs_dev, labels_dev, jnp.asarray(idx))
         losses = step.step_n(xs, ys)
         done += k
         log(f"step {done:5d} loss {float(losses.asnumpy()[-1]):7.3f} "
             f"t={time.time() - t0:6.1f}s")
     imgs_per_s = steps * b / (time.time() - t0)
     step.sync_to_block()
+    net.collect_params().reset_ctx(ctx)   # params were materialized on cpu
     return net, ctx, imgs_per_s
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=1500)
+    p.add_argument("--steps", type=int, default=1200)
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--steps-per-dispatch", type=int, default=25)
     p.add_argument("--train-images", type=int, default=512)
