@@ -529,13 +529,21 @@ def _on_tpu():
         return False
 
 
-# Below this sequence length the tiled kernel pays more in padding than it
-# saves in HBM traffic, and Mosaic rejects sub-tile dot operands outright on
-# real hardware ("Bad lhs type" for e.g. S=16/D=32 — hit by BERT-tiny
-# configs). The dense path is exact, differentiable, and at these sizes the
-# (S x S) score matrix is small enough that materializing it is the FAST
-# choice.
-_MIN_PALLAS_S = 128
+# Below this sequence length the COMPILED kernel loses to dense attention on
+# the chip: measured fwd+bwd at B64/H12/D64 bf16 (v5e) — S=128 tie
+# (5.2 ms both), S=256 dense 6.4 ms vs pallas 8.7 ms, S=512 pallas 15.2 ms
+# vs dense 17.2 ms. Below the tile minimum Mosaic also rejects sub-tile dot
+# operands outright ("Bad lhs type" at S=16 — BERT-tiny configs). The dense
+# path is exact and differentiable; its (S x S) scores stay small at these
+# lengths, and the S<=1024 backward is dense recompute either way. The gate
+# applies only to the compiled-on-TPU path so interpret-mode tests keep
+# exercising the kernel at every size.
+_MIN_PALLAS_S = 512
+# Below the tile minimum the kernel is also the wrong choice on every OTHER
+# backend: the interpreter is orders of magnitude slower than dense XLA, so
+# default dispatch goes dense there too — only an explicit interpret=True
+# (tests) runs the kernel at sub-tile sizes.
+_MIN_KERNEL_S = 128
 
 
 def _dense_attention(q, k, v, sm_scale, causal):
@@ -555,13 +563,16 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     interpret=None):
     """Fused attention over (B, H, S, D). Pallas kernel on TPU; interpreter
     (still the same kernel) elsewhere so tests exercise identical code.
-    Sub-tile sequences (S < 128) take a dense XLA path instead — see
-    _MIN_PALLAS_S above."""
+    Short sequences (S < 512) on the compiled TPU path take a dense XLA
+    route instead — measured faster there, and Mosaic rejects sub-tile
+    shapes outright; see _MIN_PALLAS_S above."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    if q.shape[2] < _MIN_PALLAS_S:
-        return _dense_attention(q, k, v, float(sm_scale), bool(causal))
+    explicit = interpret is not None
     if interpret is None:
         interpret = not _on_tpu()
+    if (not interpret and q.shape[2] < _MIN_PALLAS_S) or \
+            (not explicit and q.shape[2] < _MIN_KERNEL_S):
+        return _dense_attention(q, k, v, float(sm_scale), bool(causal))
     return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
                   int(block_k), bool(interpret))

@@ -164,29 +164,47 @@ def test_shipped_default_blocks_backward(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_small_shapes_take_dense_path(causal):
-    """S below the tile minimum must dispatch to the dense XLA path — on real
-    hardware Mosaic rejects sub-tile dot operands ("Bad lhs type" at
-    S=16/D=32, the BERT-tiny config from examples/bert), so tiny models
-    crashed outright before the fallback. Values and grads must match the
-    dense reference exactly (it IS the dense reference)."""
-    from mxnet_tpu.ops.pallas.flash_attention import _MIN_PALLAS_S
+def test_short_seq_dense_route_and_fidelity(causal, monkeypatch):
+    """Short sequences on the COMPILED TPU path must route to dense XLA
+    attention: on real hardware Mosaic rejects sub-tile dot operands ("Bad
+    lhs type" at S=16 — the BERT-tiny config crashed outright before the
+    fallback), and the measured v5e crossover puts dense ahead of the kernel
+    below S=512 anyway. Two properties pinned here:
+
+    1. routing — with the TPU path forced, S < _MIN_PALLAS_S dispatches to
+       _dense_attention (the kernel is never entered);
+    2. fidelity — the dense fallback matches the kernel (interpret mode) in
+       values and grads at the same small shapes, so the routing change can
+       never change results.
+    """
+    from mxnet_tpu.ops.pallas import flash_attention as fa
     rng = onp.random.RandomState(11)
     B, H, S, D = 2, 2, 16, 32
-    assert S < _MIN_PALLAS_S
+    assert S < fa._MIN_PALLAS_S
     q = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
     k = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
     v = jnp.asarray(rng.randn(B, H, S, D).astype("float32") * 0.3)
     g = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
 
-    got = flash_attention(q, k, v, causal=causal)
-    want = _dense(q, k, v, causal=causal)
-    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
-                                rtol=1e-5, atol=1e-5)
-    got_g = jax.grad(lambda *a: (flash_attention(*a, causal=causal) * g).sum(),
+    # 1. routing: pretend we are on the compiled TPU path
+    hits = []
+    real_dense = fa._dense_attention
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(fa, "_dense_attention",
+                        lambda *a: hits.append(1) or real_dense(*a))
+    routed = fa.flash_attention(q, k, v, causal=causal)
+    assert hits, "short-seq TPU dispatch did not take the dense path"
+    monkeypatch.setattr(fa, "_dense_attention", real_dense)
+
+    # 2. fidelity: dense fallback == kernel (interpret) at the same shape
+    sm = 1.0 / D ** 0.5
+    want = fa._flash(q, k, v, sm, causal, 16, 16, True)   # interpret kernel
+    onp.testing.assert_allclose(onp.asarray(routed), onp.asarray(want),
+                                rtol=2e-5, atol=2e-5)
+    got_g = jax.grad(lambda *a: (real_dense(*a, sm, causal) * g).sum(),
                      argnums=(0, 1, 2))(q, k, v)
-    want_g = jax.grad(lambda *a: (_dense(*a, causal=causal) * g).sum(),
-                      argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(lambda *a: (fa._flash(*a, sm, causal, 16, 16, True)
+                                  * g).sum(), argnums=(0, 1, 2))(q, k, v)
     for gt, w, name in zip(got_g, want_g, "q k v".split()):
         onp.testing.assert_allclose(onp.asarray(gt), onp.asarray(w),
-                                    rtol=1e-5, atol=1e-5, err_msg=name)
+                                    rtol=2e-4, atol=2e-4, err_msg=name)
