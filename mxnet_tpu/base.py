@@ -18,7 +18,7 @@ import numpy as onp
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context", "num_gpus",
-    "Registry", "env", "DTypes",
+    "num_tpus", "Registry", "env", "DTypes",
 ]
 
 

@@ -550,8 +550,12 @@ def _dense_attention(q, k, v, sm_scale, causal):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
+        # bottom-right aligned for Lq != Lk (the KV-cache decode convention:
+        # the LAST query row sees every key), which degenerates to plain
+        # tril when Lq == Lk
         S, Sk = q.shape[2], k.shape[2]
-        s = jnp.where(jnp.tril(jnp.ones((S, Sk), bool)), s, _NEG_INF)
+        s = jnp.where(jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S), s,
+                      _NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
@@ -571,7 +575,12 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     explicit = interpret is not None
     if interpret is None:
         interpret = not _on_tpu()
-    if (not interpret and q.shape[2] < _MIN_PALLAS_S) or \
+    # The kernel assumes Lq == Lk throughout (its padding and reshapes take
+    # S from q), so ANY cross-length call goes dense; equal lengths below
+    # the tile minimum go dense for Mosaic legality / dispatch-cost reasons
+    # (advisor r4 + r5 review).
+    if q.shape[2] != k.shape[2] or \
+            (not interpret and q.shape[2] < _MIN_PALLAS_S) or \
             (not explicit and q.shape[2] < _MIN_KERNEL_S):
         return _dense_attention(q, k, v, float(sm_scale), bool(causal))
     return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),

@@ -23,17 +23,63 @@ PALLAS_DIR = os.path.join(os.path.dirname(__file__), "..", "mxnet_tpu",
 KERNEL_FILES = sorted(glob.glob(os.path.join(PALLAS_DIR, "*.py")))
 
 
+def _call_name(node):
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
 def _dot_calls(tree):
     for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = None
-            if isinstance(f, ast.Attribute):
-                name = f.attr
-            elif isinstance(f, ast.Name):
-                name = f.id
-            if name in ("dot_general", "dot"):
-                yield node
+        if isinstance(node, ast.Call) and \
+                _call_name(node) in ("dot_general", "dot"):
+            yield node
+
+
+def _kernel_fn_names(tree):
+    """Names of functions handed to pallas_call as the kernel body. Kernels
+    are usually wrapped — ``kernel = functools.partial(_fwd_kernel, ...)``
+    then ``pallas_call(kernel, ...)`` — so Name references are chased
+    transitively through single-target assignments until they bottom out at
+    FunctionDefs (r5 review: without this the guard scanned nothing)."""
+    defs = {n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)}
+    binds = {}   # assigned name -> names referenced in its value expression
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            binds.setdefault(node.targets[0].id, set()).update(
+                a.id for a in ast.walk(node.value) if isinstance(a, ast.Name))
+    seeds = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "pallas_call":
+            for arg in ast.walk(node.args[0]) if node.args else []:
+                if isinstance(arg, ast.Name):
+                    seeds.add(arg.id)
+    seen, stack = set(), list(seeds)
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        stack.extend(binds.get(name, ()))
+    return seen & defs
+
+
+def _kernel_body_contractions(tree):
+    """einsum/matmul/dot calls INSIDE pallas kernel bodies — these run under
+    Mosaic, where the global precision policy is rejected on bf16 operands,
+    exactly like dot_general (advisor r4: the dot-only guard had an einsum
+    blind spot)."""
+    kernels = _kernel_fn_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in kernels:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and _call_name(sub) in (
+                        "einsum", "matmul", "dot", "dot_general"):
+                    yield sub
 
 
 def test_kernel_files_exist():
@@ -52,3 +98,19 @@ def test_every_kernel_dot_pins_precision(path):
         "without an explicit precision= — Mosaic rejects the global "
         "jax_default_matmul_precision=highest on bf16 operands on real TPUs "
         "('Bad lhs type'); pass precision=jax.lax.Precision.DEFAULT")
+
+
+@pytest.mark.parametrize("path", KERNEL_FILES,
+                         ids=[os.path.basename(p) for p in KERNEL_FILES])
+def test_kernel_body_contractions_pin_precision(path):
+    """Contractions spelled as einsum/matmul/dot inside a pallas_call kernel
+    body hit the same Mosaic precision legality as dot_general; the original
+    dot-only guard would let them slip through (advisor r4)."""
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    missing = [n.lineno for n in _kernel_body_contractions(tree)
+               if not any(kw.arg == "precision" for kw in n.keywords)]
+    assert not missing, (
+        f"{os.path.basename(path)}: einsum/matmul/dot inside a pallas kernel "
+        f"body at line(s) {missing} without precision= — these lower through "
+        "Mosaic where the global precision policy is rejected on bf16")
