@@ -663,8 +663,10 @@ def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=Fal
     att = jnp.einsum("nhld,nhmd->nhlm", qh, kh,
                      preferred_element_type=jnp.float32) / math.sqrt(D)
     if causal:
+        # bottom-right aligned for Lq != Lk, same convention as
+        # _dense_attention (the last query row sees every key)
         Lk = kh.shape[2]
-        cm = jnp.tril(jnp.ones((Lq, Lk), bool))
+        cm = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
         att = jnp.where(cm, att, -jnp.inf)
     if mask is not None:
         att = jnp.where(mask.astype(bool), att, -jnp.inf)

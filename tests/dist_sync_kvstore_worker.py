@@ -76,6 +76,11 @@ def main():
     kv.push("comp", g)  # residual 0.3 + 0.3 = 0.6 >= 0.5 → each sends +0.5
     kv.pull("comp", out=out)
     onp.testing.assert_allclose(out.asnumpy(), onp.full(shape, 1.0), rtol=1e-6)
+    # tagged line so the multichip dryrun can certify this sub-check from the
+    # artifact tail (VERDICT r4 #4)
+    print(f"worker {rank}: COMPRESSED-WIRE OK "
+          f"({packed.nbytes}B uint8 wire for {probe.data.nbytes}B fp32)",
+          flush=True)
 
     # --- dist_async: true per-push apply on the rank-0 parameter service ---
     import time

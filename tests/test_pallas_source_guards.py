@@ -53,11 +53,16 @@ def _kernel_fn_names(tree):
             binds.setdefault(node.targets[0].id, set()).update(
                 a.id for a in ast.walk(node.value) if isinstance(a, ast.Name))
     seeds = set()
+    n_calls = 0
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and _call_name(node) == "pallas_call":
-            for arg in ast.walk(node.args[0]) if node.args else []:
-                if isinstance(arg, ast.Name):
-                    seeds.add(arg.id)
+            n_calls += 1
+            exprs = list(node.args[:1]) + [kw.value for kw in node.keywords
+                                           if kw.arg == "kernel"]
+            for expr in exprs:
+                for arg in ast.walk(expr):
+                    if isinstance(arg, ast.Name):
+                        seeds.add(arg.id)
     seen, stack = set(), list(seeds)
     while stack:
         name = stack.pop()
@@ -65,7 +70,15 @@ def _kernel_fn_names(tree):
             continue
         seen.add(name)
         stack.extend(binds.get(name, ()))
-    return seen & defs
+    resolved = seen & defs
+    # anti-vacuity: a file that calls pallas_call but resolves no kernel
+    # FunctionDef means this detector went blind (kernel passed as a lambda
+    # or through a binding shape it cannot chase) — fail loudly rather than
+    # silently scanning nothing (r5 review)
+    assert not n_calls or resolved, (
+        "pallas_call present but no kernel function resolved — extend "
+        "_kernel_fn_names for this binding pattern")
+    return resolved
 
 
 def _kernel_body_contractions(tree):
