@@ -107,6 +107,21 @@ register("MXNET_P3_SLICE_SIZE", 1 << 20, int,
 register("MXNET_TRAIN_REMAT", "none", str,
          "ParallelTrainStep rematerialization policy: none | conv (save only "
          "conv outputs, recompute BN/ReLU chains in backward) | full.")
+register("MXNET_BN_ONEPASS", True, bool,
+         "BatchNorm: compute batch moments in ONE pass over the input "
+         "(f32-accumulated E[x^2]-mu^2, clamped) instead of the two-pass "
+         "mean-then-variance form — saves a full activation read per BN "
+         "layer in forward. The bf16 fast path (MXNET_BN_BF16_REDUCE) is "
+         "inherently one-pass and ignores this flag; to get the two-pass "
+         "f32 reference formulation on bf16 inputs, set BOTH flags to 0.")
+register("MXNET_BN_BF16_REDUCE", True, bool,
+         "BatchNorm: when the input is bfloat16, keep every materialized "
+         "tensor bf16 and apply the normalize with f32 scale/shift "
+         "in-register (cuDNN fp16-AMP BatchNorm semantics: half tensors, "
+         "float stats and f32 gradient accumulation; always one-pass "
+         "moments). Measured 2204->2660 img/s on ResNet-50 b128 v5e. Set 0 "
+         "to run bf16 inputs through the f32-promoted path (whose moment "
+         "form MXNET_BN_ONEPASS then controls).")
 register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
          "dist_async: max whole-model push rounds a worker may run ahead of "
          "the slowest (SSP bound); -1 = unbounded, the reference's pure "

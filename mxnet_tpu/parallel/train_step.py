@@ -46,7 +46,7 @@ class ParallelTrainStep:
 
     def __init__(self, block, loss, optimizer, mesh: DeviceMesh, *,
                  data_spec=None, label_spec=None, extra_specs: Sequence = (),
-                 donate: bool = True, compute_dtype=None):
+                 donate: bool = True, compute_dtype=None, param_format=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -59,6 +59,16 @@ class ParallelTrainStep:
         self._step_fn = None
         self._step_n_fns: Dict[int, Callable] = {}
         self._t = 0
+        # param_format="auto": let XLA choose the parameter/optimizer-state
+        # memory layouts (AOT lower+compile with Layout.AUTO) and keep the
+        # carried state in those layouts across steps — kills the per-step
+        # re-layout copies XLA otherwise inserts at the jit boundary when its
+        # preferred layout differs from the default row-major one
+        if param_format not in (None, "auto"):
+            raise MXNetError(f"param_format must be None or 'auto', "
+                             f"got {param_format!r}")
+        self._param_format = param_format
+        self._autoformat_cache: Dict = {}
 
         params = list(block.collect_params().values())
         for p in params:
@@ -200,11 +210,18 @@ class ParallelTrainStep:
         import jax
         step = self._make_raw_step()
         t_sh, a_sh, rep = self._shardings()
+        donate = (0, 1, 2) if self._donate else ()
+        if self._param_format == "auto":
+            self._step_fn = self._autoformat_jit(
+                step, t_sh, a_sh,
+                (self._data_sharding, self._label_sharding,
+                 tuple(self._extra_shardings), rep, rep, rep, rep),
+                rep, donate)
+            return
         in_shardings = (t_sh, a_sh, self._state_shardings,
                         self._data_sharding, self._label_sharding,
                         tuple(self._extra_shardings), rep, rep, rep, rep)
         out_shardings = (rep, t_sh, a_sh, self._state_shardings)
-        donate = (0, 1, 2) if self._donate else ()
         self._step_fn = jax.jit(step, in_shardings=in_shardings,
                                 out_shardings=out_shardings,
                                 donate_argnums=donate)
@@ -246,17 +263,91 @@ class ParallelTrainStep:
             return losses, train, aux, states
 
         t_sh, a_sh, rep = self._shardings()
+        donate = (0, 1, 2) if self._donate else ()
+        if self._param_format == "auto":
+            fn = self._autoformat_jit(
+                step_n, t_sh, a_sh,
+                (self._stacked(self._data_sharding),
+                 self._stacked(self._label_sharding),
+                 tuple(self._stacked(s) for s in self._extra_shardings),
+                 rep, rep, rep, rep),
+                rep, donate)
+            self._step_n_fns[n] = fn
+            return fn
         in_shardings = (t_sh, a_sh, self._state_shardings,
                         self._stacked(self._data_sharding),
                         self._stacked(self._label_sharding),
                         tuple(self._stacked(s) for s in self._extra_shardings),
                         rep, rep, rep, rep)
         out_shardings = (rep, t_sh, a_sh, self._state_shardings)
-        donate = (0, 1, 2) if self._donate else ()
         fn = jax.jit(step_n, in_shardings=in_shardings,
                      out_shardings=out_shardings, donate_argnums=donate)
         self._step_n_fns[n] = fn
         return fn
+
+    def _autoformat_jit(self, fn, t_sh, a_sh, tail_shardings, loss_sh, donate):
+        """AOT path for param_format='auto': compile with Layout.AUTO on the
+        carried state (params/aux/opt states), re-place that state into the
+        layouts XLA chose, and keep it there via donation + matching output
+        formats — the boundary re-layout copies disappear from steady state.
+
+        Executables are cached per data-signature (shapes/dtypes of the
+        non-state args), so shape changes retrace like the default jit path
+        instead of crashing; when a different executable than the last-used
+        one runs, the carried state is re-placed into that executable's
+        formats first (device_put is a no-op when the layout already
+        matches), so step()/step_n() interleaving stays correct."""
+        import jax
+        from jax.experimental.layout import Format, Layout
+
+        def fmtf(sh):
+            return Format(Layout.AUTO, sh)
+
+        jfn = jax.jit(fn,
+                      in_shardings=([fmtf(s) for s in t_sh],
+                                    [fmtf(s) for s in a_sh],
+                                    jax.tree_util.tree_map(
+                                        fmtf, self._state_shardings))
+                      + tail_shardings,
+                      out_shardings=(loss_sh, [fmtf(s) for s in t_sh],
+                                     [fmtf(s) for s in a_sh],
+                                     jax.tree_util.tree_map(
+                                         fmtf, self._state_shardings)),
+                      donate_argnums=donate)
+        cache = self._autoformat_cache
+
+        def wrapper(*args):
+            leaves, treedef = jax.tree_util.tree_flatten(args[3:])
+            key = (id(jfn), treedef,
+                   tuple((l.shape, str(l.dtype)) for l in leaves))
+            comp = cache.get(key)
+            if comp is None:
+                # AUTO-layout args must lower from abstract ShapeDtypeStructs,
+                # not concrete arrays (which carry a fixed layout)
+                def sds(a):
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                abstract = tuple(jax.tree_util.tree_map(sds, args[i])
+                                 for i in range(3))
+                comp = jfn.lower(*abstract, *args[3:]).compile()
+                cache[key] = comp
+            if cache.get("owner") is not comp:
+                # move the carried state into THIS executable's formats and
+                # persist it so later dispatches skip the transfer
+                informats = comp.input_formats[0]
+                placed = tuple(
+                    jax.tree_util.tree_map(jax.device_put, args[i],
+                                           informats[i])
+                    for i in range(3))
+                for j, i in enumerate(self._trainable_idx):
+                    self._params[i] = placed[0][j]
+                for j, i in enumerate(self._aux_idx):
+                    self._params[i] = placed[1][j]
+                self._opt_states = list(placed[2])
+                cache["owner"] = comp
+                args = placed + args[3:]
+            return comp(*args)
+
+        return wrapper
 
     # ------------------------------------------------------------------
     def step(self, x, y, *extras):

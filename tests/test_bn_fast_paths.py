@@ -1,0 +1,129 @@
+"""Numerics of the BatchNorm fast paths (round-5 ResNet byte-ledger work).
+
+Two config-gated variants of the BatchNorm op (ops/nn.py, parity
+nn/batch_norm.cc) exist because the round-4 profile showed the two-pass
+f32-promoted formulation dominates ResNet-50's non-conv HBM traffic:
+
+- MXNET_BN_ONEPASS: one-pass f32 moments (E[x^2]-mu^2, clamped) for f32
+  inputs — saves a full activation read per BN in forward.
+- MXNET_BN_BF16_REDUCE: for bf16 inputs, materialized tensors stay bf16 and
+  the normalize uses f32 scale/shift in-register (cuDNN's fp16 AMP BatchNorm
+  semantics: half tensors, float stats and gradient accumulation).
+
+Both must match the reference two-pass f32 path to accumulation tolerance —
+forward, backward (dx, dgamma, dbeta), and moving-stat updates.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bn_all(x, gamma, beta, mean, var, training, flag=None):
+    """Run the registry BatchNorm fwd+bwd under an optional config flag;
+    returns (out, dx, dgamma, dbeta, new_mean, new_var) as numpy."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+
+    prev_onepass = mx.config.get("MXNET_BN_ONEPASS")
+    prev_bf16 = mx.config.get("MXNET_BN_BF16_REDUCE")
+    try:
+        mx.config.set("MXNET_BN_ONEPASS", flag == "onepass")
+        mx.config.set("MXNET_BN_BF16_REDUCE", flag == "bf16")
+        fn = get_op("BatchNorm").fn
+
+        def f(x_, g_, b_):
+            out, nm, nv = fn(x_, g_, b_, jnp.asarray(mean), jnp.asarray(var),
+                             eps=1e-5, momentum=0.9, fix_gamma=False,
+                             training=training)
+            return jnp.sum(out.astype(jnp.float32) *
+                           jnp.cos(jnp.arange(out.size, dtype=jnp.float32)
+                                   .reshape(out.shape))), (out, nm, nv)
+
+        (loss, (out, nm, nv)), grads = jax.value_and_grad(
+            f, argnums=(0, 1, 2), has_aux=True)(
+            jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta))
+        return tuple(onp.asarray(a, dtype=onp.float32)
+                     for a in (out, grads[0], grads[1], grads[2], nm, nv))
+    finally:
+        mx.config.set("MXNET_BN_ONEPASS", prev_onepass)
+        mx.config.set("MXNET_BN_BF16_REDUCE", prev_bf16)
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_onepass_matches_twopass_f32(training):
+    rng = onp.random.RandomState(0)
+    x = (rng.randn(8, 16, 7, 7) * 2 + 3).astype("float32")  # nonzero mean
+    gamma = rng.rand(16).astype("float32") + 0.5
+    beta = rng.randn(16).astype("float32")
+    mean = rng.randn(16).astype("float32")
+    var = rng.rand(16).astype("float32") + 0.1
+
+    ref = _bn_all(x, gamma, beta, mean, var, training, flag=None)
+    got = _bn_all(x, gamma, beta, mean, var, training, flag="onepass")
+    for r, g, name in zip(ref, got, ("out", "dx", "dgamma", "dbeta",
+                                     "new_mean", "new_var")):
+        onp.testing.assert_allclose(g, r, rtol=2e-4, atol=2e-4,
+                                    err_msg=f"onepass {name} diverged")
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_bf16_fast_matches_f32_reference(training):
+    """bf16 inputs: the fast path must agree with the f32 two-pass reference
+    run on the same bf16-quantized input, to bf16 output tolerance; the
+    moving stats and the (f32-accumulated) parameter grads much tighter."""
+    rng = onp.random.RandomState(1)
+    x32 = (rng.randn(8, 16, 7, 7) * 2 + 3).astype("float32")
+    import jax.numpy as jnp
+    x16 = onp.asarray(jnp.asarray(x32, jnp.bfloat16))
+    gamma = rng.rand(16).astype("float32") + 0.5
+    beta = rng.randn(16).astype("float32")
+    mean = rng.randn(16).astype("float32")
+    var = rng.rand(16).astype("float32") + 0.1
+
+    ref = _bn_all(x16, gamma, beta, mean, var, training, flag=None)
+    got = _bn_all(x16, gamma, beta, mean, var, training, flag="bf16")
+    names = ("out", "dx", "dgamma", "dbeta", "new_mean", "new_var")
+    # bf16 tensors: ~3 decimal digits; element tolerances scale with that
+    tols = {"out": 0.05, "dx": 0.05, "dgamma": 0.03, "dbeta": 0.03,
+            "new_mean": 0.02, "new_var": 0.02}
+    for r, g, name in zip(ref, got, names):
+        scale = max(1.0, float(onp.max(onp.abs(r))))
+        assert onp.max(onp.abs(g - r)) / scale < tols[name], (
+            f"bf16 fast path {name} diverged: "
+            f"max|delta|/scale={onp.max(onp.abs(g - r)) / scale:.4f}")
+
+
+def test_bf16_fast_training_converges():
+    """End-to-end guard: a small conv+BN net in bf16 compute with the fast
+    path ON must fit a separable problem (loss must fall by >5x), so the
+    gradient path through the fast BN is learnable, not just close."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import loss as gloss, nn
+
+    prev = mx.config.get("MXNET_BN_BF16_REDUCE")
+    mx.config.set("MXNET_BN_BF16_REDUCE", True)
+    try:
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Dense(2))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.array(onp.zeros((2, 1, 8, 8), "float32")))
+        import jax
+        mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        step = parallel.ParallelTrainStep(
+            net, gloss.SoftmaxCrossEntropyLoss(),
+            mx.optimizer.Adam(learning_rate=0.01), mesh,
+            compute_dtype="bfloat16")
+        rng = onp.random.RandomState(2)
+        y = rng.randint(0, 2, (64,)).astype("float32")
+        x = rng.randn(64, 1, 8, 8).astype("float32") + y[:, None, None, None]
+        first = last = None
+        for _ in range(60):
+            loss = float(step(x, y).asscalar())
+            first = first if first is not None else loss
+            last = loss
+        assert last < first / 5, (first, last)
+    finally:
+        mx.config.set("MXNET_BN_BF16_REDUCE", prev)

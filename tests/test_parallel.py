@@ -122,6 +122,42 @@ def test_train_step_batchnorm_aux_updates():
     assert not onp.allclose(before, after)
 
 
+def test_param_format_auto_matches_default():
+    """param_format='auto' (XLA-chosen carried-state layouts via AOT
+    compile) must train to the same weights as the default layout path."""
+    def run(auto):
+        onp.random.seed(5)
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=8), nn.BatchNorm(), nn.Dense(4))
+        net.initialize()
+        net(mx.nd.array(onp.zeros((2, 8), "float32")))
+        mesh = parallel.make_mesh({"dp": 8})
+        step = parallel.ParallelTrainStep(
+            net, gloss.L2Loss(), mx.optimizer.SGD(learning_rate=0.05), mesh,
+            param_format="auto" if auto else None)
+        xs = onp.random.randn(3, 16, 8).astype("float32")
+        ys = onp.random.randn(3, 16, 4).astype("float32")
+        losses = step.step_n(xs, ys)          # AOT path
+        losses2 = step.step_n(xs, ys)         # steady state (cached compile)
+        # single-step interleave + a batch-shape change: both must retrace /
+        # re-own the carried state rather than crash or corrupt (r5 review)
+        l_single = step(xs[0, :8], ys[0, :8])
+        losses3 = step.step_n(xs[:, :8], ys[:, :8])
+        step.sync_to_block()
+        return (net[0].weight.data().asnumpy(), losses.asnumpy(),
+                losses2.asnumpy(), float(l_single.asscalar()),
+                losses3.asnumpy())
+
+    w_ref, l_ref, l2_ref, ls_ref, l3_ref = run(False)
+    w_auto, l_auto, l2_auto, ls_auto, l3_auto = run(True)
+    onp.testing.assert_allclose(l_auto, l_ref, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(l2_auto, l2_ref, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(ls_auto, ls_ref, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(l3_auto, l3_ref, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(w_auto, w_ref, rtol=1e-5, atol=1e-6)
+
+
 def test_ring_attention_matches_dense():
     import jax
     import jax.numpy as jnp
