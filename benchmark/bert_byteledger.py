@@ -36,6 +36,10 @@ def main():
     backbone = bert.bert_base(max_length=seq)
     model = bert.BERTForPretraining(backbone)
     model.initialize(mx.init.Normal(0.02))
+    if os.environ.get("BBL_GELU_TANH") == "1":
+        # A/B: the original-BERT tanh GELU approximation vs exact erf
+        for layer in backbone.encoder._layers:
+            layer.ffn._act = "gelu_tanh"
     n_pred = max(1, int(seq * 0.15))
 
     class _PretrainStep(HybridBlock):

@@ -122,6 +122,14 @@ register("MXNET_BN_BF16_REDUCE", True, bool,
          "moments). Measured 2204->2660 img/s on ResNet-50 b128 v5e. Set 0 "
          "to run bf16 inputs through the f32-promoted path (whose moment "
          "form MXNET_BN_ONEPASS then controls).")
+register("MXNET_OPT_BF16_MOMENTS", False, bool,
+         "Adam/AdamW: store the first/second moments in bfloat16 (EMA "
+         "arithmetic still runs on in-register f32 upcasts). Halves the "
+         "optimizer-state HBM traffic per step. Off by default: the second "
+         "moment's tiny EMA increments ((1-beta2)*g^2) round away against a "
+         "bf16-stored v once v is ~2^9 times larger, biasing v low on long "
+         "horizons — validated short-horizon in tests/test_bn_fast_paths.py"
+         "-style convergence gates before benchmark use.")
 register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
          "dist_async: max whole-model push rounds a worker may run ahead of "
          "the slowest (SSP bound); -1 = unbounded, the reference's pure "

@@ -48,7 +48,14 @@ class SelfAttention(HybridBlock):
 
 
 class PositionwiseFFN(HybridBlock):
-    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
+    """FFN with the original-BERT tanh GELU (google-research/bert
+    modeling.py gelu) as default: numerically ~1e-3 of the erf-exact form
+    and measured 17% faster end-to-end on v5e (PERF.md round 5 — the erf
+    VJP forces an extra saved pre-activation tensor through the MLP matmul
+    fusions). Pass activation="gelu" for the erf-exact variant."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu_tanh",
+                 **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.ffn1 = Dense(hidden_size, flatten=False, in_units=units)
@@ -66,12 +73,14 @@ class PositionwiseFFN(HybridBlock):
 class TransformerEncoderLayer(HybridBlock):
     """Post-LN transformer encoder layer (BERT convention)."""
 
-    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="gelu_tanh", **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.attention = SelfAttention(units, num_heads, dropout)
             self.ln1 = LayerNorm(in_channels=units)
-            self.ffn = PositionwiseFFN(units, hidden_size, dropout)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation=activation)
             self.ln2 = LayerNorm(in_channels=units)
 
     def forward(self, x, mask=None):
@@ -82,13 +91,13 @@ class TransformerEncoderLayer(HybridBlock):
 
 class BERTEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
-                 **kwargs):
+                 activation="gelu_tanh", **kwargs):
         super().__init__(**kwargs)
         self._layers = []
         with self.name_scope():
             for i in range(num_layers):
                 layer = TransformerEncoderLayer(units, hidden_size, num_heads,
-                                                dropout)
+                                                dropout, activation=activation)
                 self.register_child(layer, f"layer{i}")
                 self._layers.append(layer)
 
@@ -103,7 +112,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, num_layers=12, units=768, hidden_size=3072, num_heads=12,
                  vocab_size=30522, max_length=512, type_vocab_size=2,
-                 dropout=0.1, **kwargs):
+                 dropout=0.1, activation="gelu_tanh", **kwargs):
         super().__init__(**kwargs)
         self._units = units
         with self.name_scope():
@@ -113,7 +122,7 @@ class BERTModel(HybridBlock):
             self.embed_ln = LayerNorm(in_channels=units)
             self.embed_drop = Dropout(dropout)
             self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
-                                       dropout)
+                                       dropout, activation=activation)
             self.pooler = Dense(units, activation="tanh", flatten=False,
                                 in_units=units)
 

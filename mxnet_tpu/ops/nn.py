@@ -373,15 +373,26 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.
 @register("LayerNorm", jit=True)
 def layer_norm(x, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
     acc = jnp.float32
-    xa = x.astype(acc)
-    mean = jnp.mean(xa, axis=axis, keepdims=True)
-    var = jnp.mean(jnp.square(xa - mean), axis=axis, keepdims=True)
-    inv = lax.rsqrt(var + eps)
+    from .. import config as _config
+    xa = x.astype(acc)   # in-register upcast; fused into whatever reads x
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    out = (xa - mean) * inv * gamma.astype(acc).reshape(shape) \
-        + beta.astype(acc).reshape(shape)
-    out = out.astype(x.dtype)
+    if x.dtype == jnp.bfloat16 and _config.get("MXNET_BN_BF16_REDUCE"):
+        # same recipe as BatchNorm's bf16 fast path: one-pass f32 moments,
+        # f32 scale/shift in-register, every materialized tensor bf16
+        mean = jnp.mean(xa, axis=axis, keepdims=True)
+        sq = jnp.mean(jnp.square(xa), axis=axis, keepdims=True)
+        var = jnp.maximum(sq - jnp.square(mean), 0.0)
+        inv = lax.rsqrt(var + eps)
+        a = inv * gamma.astype(acc).reshape(shape)
+        b = beta.astype(acc).reshape(shape) - mean * a
+        out = (x * a + b).astype(x.dtype)
+    else:
+        mean = jnp.mean(xa, axis=axis, keepdims=True)
+        var = jnp.mean(jnp.square(xa - mean), axis=axis, keepdims=True)
+        inv = lax.rsqrt(var + eps)
+        out = ((xa - mean) * inv * gamma.astype(acc).reshape(shape)
+               + beta.astype(acc).reshape(shape)).astype(x.dtype)
     if output_mean_var:
         return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
     return out

@@ -312,22 +312,31 @@ class Adam(Optimizer):
 
     def create_state(self, index, weight):
         import jax.numpy as jnp
+        from .. import config as _config
         dt = jnp.float32 if weight.data.dtype in (jnp.bfloat16, jnp.float16) \
             else weight.data.dtype
+        # bf16 moment STORAGE (EMA math stays f32 in-register, see _rule) —
+        # halves optimizer-state HBM traffic (MXNET_OPT_BF16_MOMENTS doc)
+        if _config.get("MXNET_OPT_BF16_MOMENTS") and \
+                jnp.issubdtype(weight.data.dtype, jnp.floating):
+            dt = jnp.bfloat16
         return (_zeros_like_nd(weight, dt), _zeros_like_nd(weight, dt))
 
     def _rule(self, w, g, state, lr, wd, t):
         import jax.numpy as jnp
         m, v = state
-        g32 = g.astype(m.dtype) + wd * w.astype(m.dtype)
-        m = self.beta1 * m + (1 - self.beta1) * g32
-        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        acc = jnp.float32 if jnp.issubdtype(m.dtype, jnp.floating) else m.dtype
+        m32, v32 = m.astype(acc), v.astype(acc)
+        g32 = g.astype(acc) + wd * w.astype(acc)
+        m32 = self.beta1 * m32 + (1 - self.beta1) * g32
+        v32 = self.beta2 * v32 + (1 - self.beta2) * jnp.square(g32)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         corrected_lr = lr * math.sqrt(coef2) / coef1 if isinstance(t, int) \
             else lr * jnp.sqrt(coef2) / coef1
-        upd = corrected_lr * m / (jnp.sqrt(v) + self.epsilon)
-        return (w.astype(m.dtype) - upd).astype(w.dtype), (m, v)
+        upd = corrected_lr * m32 / (jnp.sqrt(v32) + self.epsilon)
+        return ((w.astype(acc) - upd).astype(w.dtype),
+                (m32.astype(m.dtype), v32.astype(v.dtype)))
 
 
 @register
