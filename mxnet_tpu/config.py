@@ -128,8 +128,8 @@ register("MXNET_OPT_BF16_MOMENTS", False, bool,
          "optimizer-state HBM traffic per step. Off by default: the second "
          "moment's tiny EMA increments ((1-beta2)*g^2) round away against a "
          "bf16-stored v once v is ~2^9 times larger, biasing v low on long "
-         "horizons — validated short-horizon in tests/test_bn_fast_paths.py"
-         "-style convergence gates before benchmark use.")
+         "horizons. Short-horizon convergence gate: tests/test_optimizer_ops"
+         ".py::test_adam_bf16_moments_close_and_converges.")
 register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
          "dist_async: max whole-model push rounds a worker may run ahead of "
          "the slowest (SSP bound); -1 = unbounded, the reference's pure "

@@ -263,6 +263,15 @@ def _zeros_like_nd(weight, dtype=None):
                    ctx=weight.context)
 
 
+def _ema_acc_dtype(state_dtype):
+    """EMA arithmetic dtype for a stored moment dtype: half-precision
+    storage (MXNET_OPT_BF16_MOMENTS) upcasts to f32 in-register; f32/f64
+    states keep their own precision."""
+    import jax.numpy as jnp
+    return jnp.float32 if state_dtype in (jnp.bfloat16, jnp.float16) \
+        else state_dtype
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum (optimizer_op.cc sgd_update/sgd_mom_update)."""
@@ -325,7 +334,7 @@ class Adam(Optimizer):
     def _rule(self, w, g, state, lr, wd, t):
         import jax.numpy as jnp
         m, v = state
-        acc = jnp.float32 if jnp.issubdtype(m.dtype, jnp.floating) else m.dtype
+        acc = _ema_acc_dtype(m.dtype)
         m32, v32 = m.astype(acc), v.astype(acc)
         g32 = g.astype(acc) + wd * w.astype(acc)
         m32 = self.beta1 * m32 + (1 - self.beta1) * g32
@@ -346,14 +355,18 @@ class AdamW(Adam):
     def _rule(self, w, g, state, lr, wd, t):
         import jax.numpy as jnp
         m, v = state
-        g32 = g.astype(m.dtype)
-        m = self.beta1 * m + (1 - self.beta1) * g32
-        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g32)
+        acc = _ema_acc_dtype(m.dtype)
+        m32, v32 = m.astype(acc), v.astype(acc)
+        g32 = g.astype(acc)
+        m32 = self.beta1 * m32 + (1 - self.beta1) * g32
+        v32 = self.beta2 * v32 + (1 - self.beta2) * jnp.square(g32)
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         corrected_lr = lr * jnp.sqrt(coef2) / coef1
-        upd = corrected_lr * m / (jnp.sqrt(v) + self.epsilon) + lr * wd * w.astype(m.dtype)
-        return (w.astype(m.dtype) - upd).astype(w.dtype), (m, v)
+        upd = corrected_lr * m32 / (jnp.sqrt(v32) + self.epsilon) \
+            + lr * wd * w.astype(acc)
+        return ((w.astype(acc) - upd).astype(w.dtype),
+                (m32.astype(m.dtype), v32.astype(v.dtype)))
 
 
 @register

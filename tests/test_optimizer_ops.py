@@ -264,7 +264,8 @@ def test_multi_mp_lamb_update_runs_and_descends():
                                 rtol=1e-3)
 
 
-def test_adam_bf16_moments_close_and_converges():
+@pytest.mark.parametrize("opt_name", ["adam", "adamw"])
+def test_adam_bf16_moments_close_and_converges(opt_name):
     """MXNET_OPT_BF16_MOMENTS (bf16 moment STORAGE, f32 EMA arithmetic —
     VERDICT r4 #3's optimizer-traffic lever): single updates must track the
     f32-state reference to bf16 storage tolerance, and a short training run
@@ -288,8 +289,8 @@ def test_adam_bf16_moments_close_and_converges():
             import jax
             mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
             step = parallel.ParallelTrainStep(
-                net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=3e-3),
-                mesh)
+                net, gloss.L2Loss(),
+                mx.optimizer.create(opt_name, learning_rate=3e-3), mesh)
             if flag:  # the states must actually be stored in bf16
                 leaves = jax.tree_util.tree_leaves(step._opt_states)
                 assert all(l.dtype == jnp.bfloat16 for l in leaves), \
