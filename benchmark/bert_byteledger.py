@@ -38,6 +38,9 @@ def main():
     model.initialize(mx.init.Normal(0.02))
     # A/B hook for the PERF.md round-5 GELU finding: gelu_tanh is the model
     # default now, so reproducing the erf arm requires BBL_GELU=gelu
+    if "BBL_GELU_TANH" in os.environ:
+        raise SystemExit("BBL_GELU_TANH is gone: gelu_tanh is the model "
+                         "default now; use BBL_GELU=gelu for the erf arm")
     gelu = os.environ.get("BBL_GELU")
     if gelu:
         for layer in backbone.encoder._layers:

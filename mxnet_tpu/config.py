@@ -122,6 +122,13 @@ register("MXNET_BN_BF16_REDUCE", True, bool,
          "moments). Measured 2204->2660 img/s on ResNet-50 b128 v5e. Set 0 "
          "to run bf16 inputs through the f32-promoted path (whose moment "
          "form MXNET_BN_ONEPASS then controls).")
+register("MXNET_FLASH_BWD_BLOCK_Q", 0, int,
+         "Flash-attention Pallas BACKWARD kernels: q-block size override "
+         "(0 = inherit the forward's block_q). The backward tiles carry "
+         "~3x the forward's VMEM working set, so its optimum differs.")
+register("MXNET_FLASH_BWD_BLOCK_K", 0, int,
+         "Flash-attention Pallas backward: k-block size override "
+         "(0 = inherit the forward's block_k).")
 register("MXNET_OPT_BF16_MOMENTS", False, bool,
          "Adam/AdamW: store the first/second moments in bfloat16 (EMA "
          "arithmetic still runs on in-register f32 upcasts). Halves the "

@@ -234,6 +234,22 @@ def all_finite(*arrays, init_output=True):
     return ok
 
 
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
+    """dot with sparse dispatch (dot-inl.h storage-type dispatch): csr/row-
+    sparse operands route to the sparse contractions, dense to the MXU op."""
+    from ..base import MXNetError
+    from ..sparse import BaseSparseNDArray
+    from ..sparse import dot as _sparse_dot
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        if kwargs:
+            raise MXNetError(f"dot: unsupported keyword arguments for "
+                             f"sparse operands: {sorted(kwargs)}")
+        return _sparse_dot(lhs, rhs, transpose_a=transpose_a,
+                           transpose_b=transpose_b)
+    return _apply_op("dot", lhs, rhs, transpose_a=transpose_a,
+                     transpose_b=transpose_b, **kwargs)
+
+
 # ---------------------------------------------------------------------------
 # generated wrappers for every registered op not manually defined above
 # ---------------------------------------------------------------------------

@@ -77,6 +77,8 @@ def while_loop(cond: Callable, func: Callable, loop_vars, max_iterations=None):
 
     if max_iterations is None:
         raise MXNetError("while_loop requires max_iterations (static bound)")
+    if isinstance(loop_vars, NDArray):
+        loop_vars = [loop_vars]
     lv = tuple(v.data for v in loop_vars)
 
     probe_out, _ = func(*[_wrap(v) for v in lv])
@@ -99,6 +101,10 @@ def while_loop(cond: Callable, func: Callable, loop_vars, max_iterations=None):
         outs = out if isinstance(out, (list, tuple)) else [out]
         bufs = tuple(buf.at[i].set(o.data if isinstance(o, NDArray) else o)
                      for buf, o in zip(bufs, outs))
+        # a single returned loop var must stay a 1-tuple to match the carry
+        # pytree (found by the r5 edge tier: zero-iteration single-var loop)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = (new_vars,)
         return (i + 1, _unwrap(new_vars), bufs)
 
     n, final_vars, bufs = lax.while_loop(c, b, (jnp.int32(0), lv, out_bufs))

@@ -204,12 +204,21 @@ def _make_np_wrapper(name):
             import jax.numpy as jnp
             try:
                 sig = inspect.signature(getattr(jnp, name))
-                names = [p.name for p in sig.parameters.values()]
+                params = list(sig.parameters.values())
                 # sequence-first functions consume ALL arrays as jnp's first
                 # parameter, so positionals continue from index 1 there
                 base_idx = 1 if name in _SEQ_FUNCS else len(arrays)
                 for i, val in enumerate(rest):
-                    kwargs[names[base_idx + i]] = val
+                    p = params[base_idx + i]
+                    if p.kind == inspect.Parameter.POSITIONAL_ONLY:
+                        # e.g. jnp.where's x/y: these cannot be passed by
+                        # keyword, so they stay positional inputs. Scalars
+                        # pass through RAW — wrapping them in a strongly-
+                        # typed 0-d array would defeat jax weak-type
+                        # promotion and widen f16/bf16 outputs to f32
+                        arrays.append(val)
+                    else:
+                        kwargs[p.name] = val
             except (ValueError, TypeError, IndexError):
                 raise MXNetError(f"np.{name}: unsupported positional arguments")
         return _reg.invoke(op, arrays, kwargs)

@@ -98,7 +98,12 @@ def ones_like(x):
 
 @register("clip")
 def clip(x, *, a_min=None, a_max=None):
-    return jnp.clip(x, a_min, a_max)
+    # bounds cast to the INPUT dtype first (tensor/matrix_op.cc clip keeps
+    # the operand dtype; jnp.clip would promote int inputs to the float
+    # bound's dtype)
+    def b(v):
+        return None if v is None else jnp.asarray(v).astype(x.dtype)
+    return jnp.clip(x, b(a_min), b(a_max))
 
 
 @register("cast")

@@ -24,12 +24,19 @@ import jax.numpy as jnp
 
 from ..registry import register
 
-# defaults from an on-chip v5e sweep (S=4096, D=64, causal): 512/1024 runs
-# ~30% faster than 128/128 (fewer grid steps, larger MXU ops) and ~10-25%
-# faster than jax.experimental.pallas.ops.tpu.flash_attention at the same
-# shapes; both clamp to S for short sequences
+# defaults from on-chip v5e sweeps (D=64, causal): 512/1024 runs ~30%
+# faster than 128/128 at S=4096 (fewer grid steps, larger MXU ops) and
+# ~10-25% faster than jax.experimental.pallas.ops.tpu.flash_attention at
+# the same shapes; both clamp to S for short sequences. At very long
+# context the optimum shifts up: S>=16384 runs ~30% faster fwd and ~12%
+# faster bwd at 1024/1024 (r5 sweep, benchmark/flash_bwd_sweep.py) —
+# resolved adaptively in flash_attention() when the caller does not
+# override the blocks.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+_LONG_S = 16384
+_LONG_BLOCK_Q = 1024
+_LONG_BLOCK_K = 1024
 _NEG_INF = -1e30
 _LANES = 128  # TPU lane width; lse is broadcast across it for layout legality
 
@@ -368,8 +375,14 @@ def _pallas_bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
     the scan-based blockwise backward)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ... import config as _config
 
     B, H, S, D = q.shape
+    # backward-specific block sizes (the bwd kernels' working set is ~3x the
+    # forward's per tile, so its optimum differs; r5 sweep in
+    # benchmark/flash_bwd_sweep.py)
+    block_q = int(_config.get("MXNET_FLASH_BWD_BLOCK_Q") or block_q)
+    block_k = int(_config.get("MXNET_FLASH_BWD_BLOCK_K") or block_k)
     bq = min(block_q, S)
     bk = min(block_k, S)
     Sp = -(-S // max(bq, bk)) * max(bq, bk)
@@ -563,8 +576,7 @@ def _dense_attention(q, k, v, sm_scale, causal):
 
 @register("flash_attention", jit=True)
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Fused attention over (B, H, S, D). Pallas kernel on TPU; interpreter
     (still the same kernel) elsewhere so tests exercise identical code.
     Short sequences (S < 512) on the compiled TPU path take a dense XLA
@@ -583,5 +595,12 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
             (not interpret and q.shape[2] < _MIN_PALLAS_S) or \
             (not explicit and q.shape[2] < _MIN_KERNEL_S):
         return _dense_attention(q, k, v, float(sm_scale), bool(causal))
+    # None = adaptive default (an EXPLICIT block size is always honored):
+    # 1024/1024 from S>=16K, 512/1024 below (r5 sweep)
+    long_ctx = q.shape[2] >= _LONG_S
+    if block_q is None:
+        block_q = _LONG_BLOCK_Q if long_ctx else DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = _LONG_BLOCK_K if long_ctx else DEFAULT_BLOCK_K
     return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
                   int(block_k), bool(interpret))
