@@ -110,7 +110,10 @@ def bench_resnet_inference():
     """Forward-only throughput, batch 128 bf16 (the perf.md:188-200
     benchmark_score.py config)."""
     batch = int(os.environ.get("BENCH_INFER_BATCH", 128))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    # 60 steps/window: the per-window value-fetch RTT (~100 ms through the
+    # tunnel) inflates per-call time by RTT/steps — at 20 steps that was
+    # ~5 ms on a ~11 ms forward (r5 int8 experiment found it)
+    steps = int(os.environ.get("BENCH_STEPS", 60))
     warmup = int(os.environ.get("BENCH_WARMUP", 3))
 
     import jax

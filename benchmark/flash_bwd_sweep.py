@@ -42,21 +42,15 @@ def main():
     fwd_fl = 2 * per_matmul
     bwd_fl = 5 * per_matmul
 
-    def timed(fn, *args):
-        out = fn(*args)
-        jax.tree_util.tree_map(
+    from _timing import time_chained
+
+    def fetch(out):
+        return jax.tree_util.tree_map(
             lambda a: float(jnp.asarray(a).ravel()[0].astype(jnp.float32)),
             out)
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            for _ in range(chain):   # N queued repeats, closed by one fetch
-                o = fn(*args)
-            jax.tree_util.tree_map(
-                lambda a: float(jnp.asarray(a).ravel()[0]
-                                .astype(jnp.float32)), o)
-            ts.append((time.perf_counter() - t0) / chain)
-        return statistics.median(ts)
+
+    def timed(fn, *args):
+        return time_chained(fn, args, reps=reps, chain=chain, fetch=fetch)
 
     @jax.jit
     def fwd(q, k, v):

@@ -125,10 +125,15 @@ register("MXNET_BN_BF16_REDUCE", True, bool,
 register("MXNET_FLASH_BWD_BLOCK_Q", 0, int,
          "Flash-attention Pallas BACKWARD kernels: q-block size override "
          "(0 = inherit the forward's block_q). The backward tiles carry "
-         "~3x the forward's VMEM working set, so its optimum differs.")
+         "~3x the forward's VMEM working set, so its optimum differs. "
+         "Consulted at kernel-build time and the built executable is "
+         "cached per op/shape signature — set BEFORE the first backward "
+         "at a given shape; later changes do not rebuild cached kernels "
+         "(same trace-time semantics as MXNET_TRAIN_REMAT).")
 register("MXNET_FLASH_BWD_BLOCK_K", 0, int,
          "Flash-attention Pallas backward: k-block size override "
-         "(0 = inherit the forward's block_k).")
+         "(0 = inherit the forward's block_k). Trace-time semantics: see "
+         "MXNET_FLASH_BWD_BLOCK_Q.")
 register("MXNET_OPT_BF16_MOMENTS", False, bool,
          "Adam/AdamW: store the first/second moments in bfloat16 (EMA "
          "arithmetic still runs on in-register f32 upcasts). Halves the "

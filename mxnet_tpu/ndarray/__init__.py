@@ -234,18 +234,26 @@ def all_finite(*arrays, init_output=True):
     return ok
 
 
+_SPARSE_MOD = None
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs):
     """dot with sparse dispatch (dot-inl.h storage-type dispatch): csr/row-
-    sparse operands route to the sparse contractions, dense to the MXU op."""
-    from ..base import MXNetError
-    from ..sparse import BaseSparseNDArray
-    from ..sparse import dot as _sparse_dot
-    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+    sparse operands route to the sparse contractions, dense to the MXU op.
+    The sparse module binds lazily ONCE (this is the eager hot path — the
+    p95 dispatch gate in test_eager_latency.py covers it)."""
+    global _SPARSE_MOD
+    if _SPARSE_MOD is None:
+        from .. import sparse as _SPARSE_MOD_  # noqa: N806
+        _SPARSE_MOD = _SPARSE_MOD_
+    if isinstance(lhs, _SPARSE_MOD.BaseSparseNDArray) or \
+            isinstance(rhs, _SPARSE_MOD.BaseSparseNDArray):
         if kwargs:
+            from ..base import MXNetError
             raise MXNetError(f"dot: unsupported keyword arguments for "
                              f"sparse operands: {sorted(kwargs)}")
-        return _sparse_dot(lhs, rhs, transpose_a=transpose_a,
-                           transpose_b=transpose_b)
+        return _SPARSE_MOD.dot(lhs, rhs, transpose_a=transpose_a,
+                               transpose_b=transpose_b)
     return _apply_op("dot", lhs, rhs, transpose_a=transpose_a,
                      transpose_b=transpose_b, **kwargs)
 

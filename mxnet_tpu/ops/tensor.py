@@ -16,7 +16,7 @@ from .registry import register
 # ---------------------------------------------------------------------------
 # shape manipulation
 # ---------------------------------------------------------------------------
-@register("reshape")
+@register("reshape", jit=True)
 def reshape(x, *, shape, reverse=False):
     """Reshape with the reference's special codes 0 (copy dim), -1 (infer),
     -2 (copy rest), -3 (merge two), -4 (split) — matrix_op.cc Reshape."""
@@ -50,40 +50,40 @@ def reshape(x, *, shape, reverse=False):
     return jnp.reshape(x, tuple(out))
 
 
-@register("transpose")
+@register("transpose", jit=True)
 def transpose(x, *, axes=None):
     return jnp.transpose(x, axes)
 
 
-@register("swapaxes")
+@register("swapaxes", jit=True)
 def swapaxes(x, *, dim1=0, dim2=1):
     return jnp.swapaxes(x, dim1, dim2)
 
 
-@register("flatten")
+@register("flatten", jit=True)
 def flatten(x):
     """Collapse all but the first axis (matrix_op.cc Flatten)."""
     return jnp.reshape(x, (x.shape[0], -1))
 
 
-@register("expand_dims")
+@register("expand_dims", jit=True)
 def expand_dims(x, *, axis):
     return jnp.expand_dims(x, axis)
 
 
-@register("squeeze")
+@register("squeeze", jit=True)
 def squeeze(x, *, axis=None):
     return jnp.squeeze(x, axis=axis)
 
 
-@register("broadcast_to")
+@register("broadcast_to", jit=True)
 def broadcast_to(x, *, shape):
     shape = tuple(d if s == 0 else s for s, d in zip(shape, x.shape)) \
         if len(shape) == x.ndim else tuple(shape)
     return jnp.broadcast_to(x, shape)
 
 
-@register("broadcast_axis")
+@register("broadcast_axis", jit=True)
 def broadcast_axis(x, *, axis, size):
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
@@ -93,17 +93,17 @@ def broadcast_axis(x, *, axis, size):
     return jnp.broadcast_to(x, tuple(shape))
 
 
-@register("concat")
+@register("concat", jit=True)
 def concat(*arrays, dim=1):
     return jnp.concatenate(arrays, axis=dim)
 
 
-@register("stack")
+@register("stack", jit=True)
 def stack(*arrays, axis=0):
     return jnp.stack(arrays, axis=axis)
 
 
-@register("split")
+@register("split", jit=True)
 def split(x, *, num_outputs, axis=1, squeeze_axis=False):
     parts = jnp.split(x, num_outputs, axis=axis)
     if squeeze_axis:
@@ -111,7 +111,7 @@ def split(x, *, num_outputs, axis=1, squeeze_axis=False):
     return tuple(parts) if num_outputs > 1 else parts[0]
 
 
-@register("split_v2")
+@register("split_v2", jit=True)
 def split_v2(x, *, indices_or_sections, axis=0, squeeze_axis=False):
     if isinstance(indices_or_sections, (list, tuple)):
         parts = jnp.split(x, list(indices_or_sections), axis=axis)
@@ -122,7 +122,7 @@ def split_v2(x, *, indices_or_sections, axis=0, squeeze_axis=False):
     return tuple(parts)
 
 
-@register("slice")
+@register("slice", jit=True)
 def slice_op(x, *, begin, end, step=None):
     idx = []
     step = step or (None,) * len(begin)
@@ -131,7 +131,7 @@ def slice_op(x, *, begin, end, step=None):
     return x[tuple(idx)]
 
 
-@register("slice_axis")
+@register("slice_axis", jit=True)
 def slice_axis(x, *, axis, begin, end):
     if end is None or end == 0 and begin > 0:
         end = x.shape[axis]
@@ -140,7 +140,7 @@ def slice_axis(x, *, axis, begin, end):
     return x[tuple(idx)]
 
 
-@register("slice_like")
+@register("slice_like", jit=True)
 def slice_like(x, shape_like, *, axes=None):
     axes = range(x.ndim) if not axes else axes
     idx = [slice(None)] * x.ndim
@@ -154,22 +154,22 @@ def _getitem(x, *, key):
     return x[key]
 
 
-@register("reverse")
+@register("reverse", jit=True)
 def reverse(x, *, axis):
     return jnp.flip(x, axis=axis)
 
 
-@register("tile")
+@register("tile", jit=True)
 def tile(x, *, reps):
     return jnp.tile(x, reps)
 
 
-@register("repeat")
+@register("repeat", jit=True)
 def repeat(x, *, repeats, axis=None):
     return jnp.repeat(x, repeats, axis=axis)
 
 
-@register("pad")
+@register("pad", jit=True)
 def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
     """Pad (src/operator/pad.cc): pad_width is the flat 2*ndim tuple as in the
     reference; mode constant/edge/reflect."""
@@ -180,7 +180,7 @@ def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
     return jnp.pad(x, pw, mode=jmode)
 
 
-@register("depth_to_space")
+@register("depth_to_space", jit=True)
 def depth_to_space(x, *, block_size):
     n, c, h, w = x.shape
     b = block_size
@@ -189,7 +189,7 @@ def depth_to_space(x, *, block_size):
     return y.reshape(n, c // (b * b), h * b, w * b)
 
 
-@register("space_to_depth")
+@register("space_to_depth", jit=True)
 def space_to_depth(x, *, block_size):
     n, c, h, w = x.shape
     b = block_size
@@ -198,23 +198,23 @@ def space_to_depth(x, *, block_size):
     return y.reshape(n, c * b * b, h // b, w // b)
 
 
-@register("diag")
+@register("diag", jit=True)
 def diag(x, *, k=0):
     return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k, axis1=-2, axis2=-1)
 
 
-@register("shape_array", differentiable=False)
+@register("shape_array", differentiable=False, jit=True)
 def shape_array(x):
     return jnp.asarray(x.shape, dtype=jnp.int64 if False else jnp.int32)
 
 
-@register("size_array", differentiable=False)
+@register("size_array", differentiable=False, jit=True)
 def size_array(x):
     import numpy as onp
     return jnp.asarray([int(onp.prod(x.shape))], dtype=jnp.int32)
 
 
-@register("where")
+@register("where", jit=True)
 def where(cond, a, b):
     return jnp.where(cond.astype(bool) if cond.dtype != jnp.bool_ else cond, a, b)
 
@@ -222,27 +222,27 @@ def where(cond, a, b):
 # ---------------------------------------------------------------------------
 # indexing
 # ---------------------------------------------------------------------------
-@register("take")
+@register("take", jit=True)
 def take(x, indices, *, axis=0, mode="clip"):
     """Gather along axis (indexing_op.cc Take); modes clip/wrap like the reference."""
     idx = indices.astype(jnp.int32)
     return jnp.take(x, idx, axis=axis, mode=mode)
 
 
-@register("batch_take")
+@register("batch_take", jit=True)
 def batch_take(x, indices):
     idx = indices.astype(jnp.int32)
     return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
 
 
-@register("pick")
+@register("pick", jit=True)
 def pick(x, indices, *, axis=-1, keepdims=False, mode="clip"):
     idx = jnp.expand_dims(indices.astype(jnp.int32), axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
     return out if keepdims else jnp.squeeze(out, axis=axis)
 
 
-@register("gather_nd")
+@register("gather_nd", jit=True)
 def gather_nd(x, indices):
     """gather_nd (indexing_op.cc): indices shape (M, ...) indexes first M dims."""
     idx = indices.astype(jnp.int32)
@@ -250,7 +250,7 @@ def gather_nd(x, indices):
     return x[tuple(idx[i] for i in range(m))]
 
 
-@register("scatter_nd")
+@register("scatter_nd", jit=True)
 def scatter_nd(data, indices, *, shape):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
@@ -265,19 +265,19 @@ def _scatter_set_nd(lhs, data, indices, *, shape=None):
     return lhs.at[tuple(idx[i] for i in range(m))].set(data)
 
 
-@register("index_add")
+@register("index_add", jit=True)
 def index_add(lhs, data, indices):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
     return lhs.at[tuple(idx[i] for i in range(m))].add(data)
 
 
-@register("index_copy")
+@register("index_copy", jit=True)
 def index_copy(old, idx, new):
     return old.at[idx.astype(jnp.int32)].set(new)
 
 
-@register("one_hot", differentiable=False)
+@register("one_hot", differentiable=False, jit=True)
 def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
     from ..base import DTypes
     oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=DTypes.jnp(dtype))
@@ -294,7 +294,7 @@ def boolean_mask_dense(data, mask, *, axis=0):
     return data * m.reshape(shape)
 
 
-@register("sequence_mask")
+@register("sequence_mask", jit=True)
 def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, value=0.0,
                   axis=0):
     """SequenceMask (src/operator/sequence_mask.cc): data is (seq, batch, ...) when
@@ -313,7 +313,7 @@ def sequence_mask(data, sequence_length=None, *, use_sequence_length=False, valu
     return jnp.where(pos < sl, data, jnp.asarray(value, data.dtype))
 
 
-@register("sequence_last")
+@register("sequence_last", jit=True)
 def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
     seq_axis = axis
     if not use_sequence_length or sequence_length is None:
@@ -324,7 +324,7 @@ def sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis
         dmoved, idx.reshape((1, -1) + (1,) * (dmoved.ndim - 2)), axis=0)[0]
 
 
-@register("sequence_reverse")
+@register("sequence_reverse", jit=True)
 def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=axis)
@@ -341,13 +341,13 @@ def sequence_reverse(data, sequence_length=None, *, use_sequence_length=False, a
 # ---------------------------------------------------------------------------
 # ordering (reference: ordering_op.cc via CUB; here XLA sort)
 # ---------------------------------------------------------------------------
-@register("sort")
+@register("sort", jit=True)
 def sort(x, *, axis=-1, is_ascend=True):
     out = jnp.sort(x, axis=axis)
     return out if is_ascend else jnp.flip(out, axis=axis)
 
 
-@register("argsort", differentiable=False)
+@register("argsort", differentiable=False, jit=True)
 def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
     from ..base import DTypes
     out = jnp.argsort(x, axis=axis)
@@ -356,7 +356,7 @@ def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
     return out.astype(DTypes.jnp(dtype))
 
 
-@register("topk", differentiable=False)
+@register("topk", differentiable=False, jit=True)
 def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     from ..base import DTypes
     xm = jnp.moveaxis(x, axis, -1)
@@ -388,7 +388,7 @@ def unique(x):
 # ---------------------------------------------------------------------------
 # init / ranges
 # ---------------------------------------------------------------------------
-@register("arange_like", differentiable=False)
+@register("arange_like", differentiable=False, jit=True)
 def arange_like(x, *, start=0.0, step=1.0, repeat=1, axis=None):
     if axis is None:
         n = int(jnp.size(x)) if not hasattr(x, "shape") else int(
@@ -437,7 +437,7 @@ def matmul(a, b):
     return jnp.matmul(a, b)
 
 
-@register("khatri_rao")
+@register("khatri_rao", jit=True)
 def khatri_rao(*arrays):
     out = arrays[0]
     for a in arrays[1:]:
@@ -463,12 +463,12 @@ def linalg_gemm(a, b, c, *, transpose_a=False, transpose_b=False, alpha=1.0, bet
     return alpha * jnp.matmul(a, b) + beta * c
 
 
-@register("linalg_potrf")
+@register("linalg_potrf", jit=True)
 def linalg_potrf(a):
     return jnp.linalg.cholesky(a)
 
 
-@register("linalg_trsm")
+@register("linalg_trsm", jit=True)
 def linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
     import jax.scipy.linalg as jsl
     if rightside:
@@ -480,7 +480,7 @@ def linalg_trsm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0
     return jsl.solve_triangular(a, alpha * b, lower=lower, trans=1 if transpose else 0)
 
 
-@register("linalg_trmm")
+@register("linalg_trmm", jit=True)
 def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0):
     tri = jnp.tril(a) if lower else jnp.triu(a)
     if transpose:
@@ -488,51 +488,51 @@ def linalg_trmm(a, b, *, transpose=False, rightside=False, lower=True, alpha=1.0
     return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
 
 
-@register("linalg_syrk")
+@register("linalg_syrk", jit=True)
 def linalg_syrk(a, *, transpose=False, alpha=1.0):
     at = jnp.swapaxes(a, -1, -2)
     return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
 
 
-@register("linalg_sumlogdiag")
+@register("linalg_sumlogdiag", jit=True)
 def linalg_sumlogdiag(a):
     return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
 
 
-@register("linalg_extractdiag")
+@register("linalg_extractdiag", jit=True)
 def linalg_extractdiag(a, *, offset=0):
     return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
 
 
-@register("linalg_makediag")
+@register("linalg_makediag", jit=True)
 def linalg_makediag(a, *, offset=0):
     return jax.vmap(jnp.diag, in_axes=0)(a.reshape(-1, a.shape[-1])).reshape(
         a.shape[:-1] + (a.shape[-1] + abs(offset),) * 2) if a.ndim > 1 else jnp.diag(a, k=offset)
 
 
-@register("linalg_svd")
+@register("linalg_svd", jit=True)
 def linalg_svd(a):
     u, s, vt = jnp.linalg.svd(a, full_matrices=False)
     return u, s, vt
 
 
-@register("linalg_inverse")
+@register("linalg_inverse", jit=True)
 def linalg_inverse(a):
     return jnp.linalg.inv(a)
 
 
-@register("linalg_det")
+@register("linalg_det", jit=True)
 def linalg_det(a):
     return jnp.linalg.det(a)
 
 
-@register("linalg_slogdet")
+@register("linalg_slogdet", jit=True)
 def linalg_slogdet(a):
     sign, logdet = jnp.linalg.slogdet(a)
     return sign, logdet
 
 
-@register("linalg_potri")
+@register("linalg_potri", jit=True)
 def linalg_potri(a):
     """Inverse of the SPD matrix whose Cholesky factor is ``a`` (la_op.cc
     potri): (a a^T)^-1 via two triangular solves — one MXU-friendly
@@ -542,7 +542,7 @@ def linalg_potri(a):
     return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
 
 
-@register("linalg_syevd")
+@register("linalg_syevd", jit=True)
 def linalg_syevd(a):
     """Symmetric eigendecomposition (la_op.cc syevd): returns (U, L) with
     rows of U the eigenvectors (reference layout: a = U^T diag(L) U)."""
@@ -550,7 +550,7 @@ def linalg_syevd(a):
     return jnp.swapaxes(v, -1, -2), w
 
 
-@register("linalg_gelqf")
+@register("linalg_gelqf", jit=True)
 def linalg_gelqf(a):
     """LQ factorization of a full-rank wide matrix (la_op.cc gelqf):
     a = L Q with Q orthonormal rows — the QR of a^T transposed."""
@@ -558,7 +558,7 @@ def linalg_gelqf(a):
     return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
 
 
-@register("linalg_extracttrian")
+@register("linalg_extracttrian", jit=True)
 def linalg_extracttrian(a, *, offset=0, lower=True):
     """Pack the triangular part of each matrix into a vector (la_op.cc
     ExtractTrian): row-major walk over the kept triangle."""

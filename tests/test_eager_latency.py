@@ -124,3 +124,82 @@ def test_eager_jit_op_latency_gate():
 
     med = _median_ms(conv)
     assert med < 60.0, f"eager conv dispatch regressed: {med:.1f} ms/call"
+
+
+def test_eager_dispatch_p95_under_100us():
+    """VERDICT r4 #5 gate: p95 eager DISPATCH (cpu ctx, warm caches) under
+    100 us across representative async-execution ops. These ops complete
+    asynchronously (or near-free) on XLA:CPU, so wall time ~= framework
+    dispatch: attr freeze + executor-cache hit + jitted-call + output wrap.
+    Best-of-3 windows makes the gate robust to transient host load."""
+    import time
+    import numpy as onp
+
+    # small inputs: keeps XLA:CPU's inline execution negligible so the
+    # window measures dispatch, not compute
+    x = mx.nd.array(onp.random.rand(64, 64).astype("float32"))
+    y = mx.nd.array(onp.random.rand(64, 64).astype("float32"))
+    ops = {
+        "negative": lambda: mx.nd.negative(x),
+        "exp": lambda: mx.nd.exp(x),
+        "broadcast_add": lambda: mx.nd.broadcast_add(x, y),
+        "sum_axis": lambda: mx.nd.sum(x, axis=1),
+        "concat": lambda: mx.nd.concat(x, y, dim=0),
+        "cast": lambda: mx.nd.cast(x, dtype="float16"),
+    }
+    for name, f in ops.items():
+        for _ in range(30):
+            f()
+        best_p95 = None
+        for _ in range(3):
+            ts = []
+            for _ in range(400):
+                t0 = time.perf_counter_ns()
+                f()
+                ts.append(time.perf_counter_ns() - t0)
+            ts.sort()
+            p95 = ts[int(len(ts) * 0.95)] / 1e3
+            best_p95 = p95 if best_p95 is None else min(best_p95, p95)
+        assert best_p95 < 100.0, (
+            f"{name}: eager dispatch p95 {best_p95:.1f} us (>100) — the "
+            "cached-executable fast path regressed (registry jit=True "
+            "flip, r5)")
+
+
+def test_eager_tail_ops_match_raw_jax():
+    """The remaining 300+ us 'tail' ops (max-to-scalar, gemm) are XLA:CPU
+    executing the computation synchronously inline — NOT framework dispatch.
+    Pin that attribution: the nd op must cost no more than the identical raw
+    jax.jit call plus a 100 us dispatch allowance."""
+    import time
+    import statistics
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    xn = onp.random.rand(256, 256).astype("float32")
+    x = mx.nd.array(xn)
+    xj = jnp.asarray(xn)
+    pairs = {
+        "max": (lambda: mx.nd.max(x), jax.jit(jnp.max), (xj,)),
+        "dot": (lambda: mx.nd.dot(x, x), jax.jit(jnp.dot), (xj, xj)),
+    }
+    for name, (ours, raw, raw_args) in pairs.items():
+        for _ in range(30):
+            ours()
+            raw(*raw_args)
+
+        def med(f, args=()):
+            ts = []
+            for _ in range(200):
+                t0 = time.perf_counter_ns()
+                f(*args)
+                ts.append(time.perf_counter_ns() - t0)
+            return statistics.median(ts) / 1e3
+
+        t_ours = min(med(ours) for _ in range(3))
+        t_raw = min(med(raw, raw_args) for _ in range(3))
+        assert t_ours < t_raw * 1.5 + 100.0, (
+            f"{name}: nd op {t_ours:.0f} us vs raw jax.jit {t_raw:.0f} us — "
+            "framework dispatch is adding real overhead beyond the runtime's "
+            "own synchronous execution")
