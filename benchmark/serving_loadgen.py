@@ -1,0 +1,175 @@
+"""Closed-loop load generator for mxnet_tpu.serving (ISSUE r6 benchmark).
+
+N closed-loop clients each keep exactly one request in flight against a
+ModelEndpoint behind the dynamic batcher; at each concurrency level the
+harness reports served img/s and request-latency p50/p99 — the curve that
+shows dynamic batching converting concurrency into device-batch occupancy
+(served throughput should climb toward the direct full-batch rate while p99
+stays bounded by batch_timeout + step time).
+
+Two endpoints are exercised per run: ResNet-50 bf16 and (optionally) the
+``quantize_net``-produced int8 variant of the same weights — the public-API
+int8 path VERDICT r5 asked to make servable.
+
+Env knobs (benchmark/_timing.py conventions: warm first, median over reps,
+one honest value-fetch per window — here the per-request futures already
+synchronize, so the loadgen measures wall-clock over whole windows):
+
+  SLG_MODEL=resnet50_v1   model-zoo name
+  SLG_IMG=224             input H=W (smaller for CPU smoke runs)
+  SLG_CLASSES=1000
+  SLG_DTYPES=bf16,int8    comma list of {f32, bf16, int8}
+  SLG_CONC=1,2,4,8,16     concurrency sweep
+  SLG_SECONDS=5           measured window per level
+  SLG_MAX_BATCH=32        endpoint max batch / largest bucket
+  SLG_TIMEOUT_MS=5        batcher deadline
+  SLG_CALIB=4             int8 calibration batches
+
+Prints one JSON line per (dtype, concurrency):
+  {"dtype":..., "conc":..., "img_s":..., "p50_ms":..., "p99_ms":...,
+   "occupancy":..., "compiles":..., "batches":...}
+and a final per-dtype summary line with the direct (unserved) single-batch
+forward rate for reference.
+"""
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def _build_net(name, classes, img, dtype):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(name, classes=classes)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(onp.zeros((1, 3, img, img), "float32")))
+    if dtype == "bf16":
+        net.cast("bfloat16")
+        net(mx.nd.array(onp.zeros((1, 3, img, img), "float32"))
+            .astype("bfloat16"))
+    elif dtype == "int8":
+        from mxnet_tpu.contrib.quantization import quantize_net
+        rng = onp.random.default_rng(7)
+        calib_n = int(os.environ.get("SLG_CALIB", 4))
+        calib = [mx.nd.array(rng.random((4, 3, img, img), dtype="float32"))
+                 for _ in range(calib_n)]
+        net = quantize_net(net, calib_data=calib, calib_mode="naive")
+    return net
+
+
+def _direct_rate(net, img, in_dtype, batch, reps=3):
+    """Reference: direct full-batch forward img/s (no serving layer),
+    chain-amortized per benchmark/_timing.py."""
+    import mxnet_tpu as mx
+    from benchmark._timing import time_chained
+
+    x = mx.nd.array(onp.random.default_rng(0).random(
+        (batch, 3, img, img), dtype="float32"))
+    if in_dtype == "bfloat16":
+        x = x.astype("bfloat16")
+    net.hybridize()
+    sec = time_chained(lambda a: net(a), (x,), reps=reps, chain=10)
+    return batch / sec
+
+
+def _run_level(server, name, img, np_dtype, conc, seconds):
+    """Closed loop: `conc` clients, one in-flight request each."""
+    stop_at = time.perf_counter() + seconds
+    lat_ms = []
+    served = [0] * conc
+    lock = threading.Lock()
+    rng = onp.random.default_rng(42)
+    frames = [rng.random((3, img, img), dtype="float32").astype(np_dtype)
+              for _ in range(8)]
+
+    def client(ci):
+        i = 0
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            server.predict(name, frames[(ci + i) % len(frames)], timeout=120)
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lat_ms.append(dt)
+            served[ci] += 1
+            i += 1
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lat_ms.sort()
+    n = len(lat_ms)
+    return {
+        "img_s": round(sum(served) / wall, 1),
+        "p50_ms": round(lat_ms[n // 2], 2) if n else None,
+        "p99_ms": round(lat_ms[min(n - 1, int(n * 0.99))], 2) if n else None,
+        "requests": n,
+    }
+
+
+def main():
+    model = os.environ.get("SLG_MODEL", "resnet50_v1")
+    img = int(os.environ.get("SLG_IMG", 224))
+    classes = int(os.environ.get("SLG_CLASSES", 1000))
+    dtypes = os.environ.get("SLG_DTYPES", "bf16,int8").split(",")
+    conc_levels = [int(c) for c in
+                   os.environ.get("SLG_CONC", "1,2,4,8,16").split(",")]
+    seconds = float(os.environ.get("SLG_SECONDS", 5))
+    max_batch = int(os.environ.get("SLG_MAX_BATCH", 32))
+    timeout_ms = float(os.environ.get("SLG_TIMEOUT_MS", 5))
+
+    import mxnet_tpu as mx  # noqa: F401  (context/init side effects)
+    from mxnet_tpu import serving
+
+    for dtype in dtypes:
+        dtype = dtype.strip()
+        net = _build_net(model, classes, img, dtype)
+        in_dtype = "bfloat16" if dtype == "bf16" else "float32"
+        name = f"{model}_{dtype}"
+        ep = serving.ModelEndpoint(name, net, input_shapes=(3, img, img),
+                                   dtype=in_dtype, max_batch_size=max_batch)
+        server = serving.InferenceServer(batch_timeout_ms=timeout_ms,
+                                         max_queue=max_batch * 8)
+        server.register(ep)          # warms every bucket: no serve-time compile
+        compiles_after_warmup = ep.stats.counters["compiles"]
+        server.start()
+        np_dtype = ep.np_dtypes[0]
+        try:
+            for conc in conc_levels:
+                row = _run_level(server, name, img, np_dtype, conc, seconds)
+                snap = serving.stats()[name]
+                row.update({
+                    "dtype": dtype, "conc": conc,
+                    "occupancy": round(snap["batch_occupancy"], 3),
+                    "compiles": snap["counters"]["compiles"],
+                    "batches": snap["counters"]["batches"],
+                })
+                print(json.dumps(row), flush=True)
+        finally:
+            server.stop(drain=True)
+        snap = serving.stats()[name]
+        assert snap["counters"]["compiles"] == compiles_after_warmup, \
+            "serving traffic recompiled beyond warmup buckets"
+        direct = _direct_rate(net, img, in_dtype, max_batch)
+        print(json.dumps({
+            "dtype": dtype, "summary": True,
+            "direct_b{}_img_s".format(max_batch): round(direct, 1),
+            "buckets": list(ep.buckets),
+            "compiles": snap["counters"]["compiles"],
+        }), flush=True)
+        serving.unregister(name)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,7 @@ if config.get("MXNET_PROFILER_AUTOSTART"):
     profiler.set_config(profile_all=True)
     profiler.start()
 from . import parallel
+from . import serving
 from . import sparse
 from . import symbol
 from . import symbol as sym

@@ -107,13 +107,19 @@ register("MXNET_P3_SLICE_SIZE", 1 << 20, int,
 register("MXNET_TRAIN_REMAT", "none", str,
          "ParallelTrainStep rematerialization policy: none | conv (save only "
          "conv outputs, recompute BN/ReLU chains in backward) | full.")
-register("MXNET_BN_ONEPASS", True, bool,
+register("MXNET_BN_ONEPASS", "auto", str,
          "BatchNorm: compute batch moments in ONE pass over the input "
          "(f32-accumulated E[x^2]-mu^2, clamped) instead of the two-pass "
          "mean-then-variance form — saves a full activation read per BN "
-         "layer in forward. The bf16 fast path (MXNET_BN_BF16_REDUCE) is "
-         "inherently one-pass and ignores this flag; to get the two-pass "
-         "f32 reference formulation on bf16 inputs, set BOTH flags to 0.")
+         "layer in forward. Default 'auto': one-pass only for sub-f32 "
+         "inputs (bf16/f16, which cannot represent the |mean|/std ratios "
+         "where E[x^2]-mu^2 catastrophically cancels); f32/f64 inputs use "
+         "the two-pass reference form (ADVICE r5: one-pass at f32 with "
+         "mean~300/std~0.01 clamps var to 0 and silently mis-scales). Set "
+         "1/0 to force one-pass/two-pass for every dtype. The bf16 fast "
+         "path (MXNET_BN_BF16_REDUCE) is inherently one-pass and ignores "
+         "this flag; to get the two-pass f32 formulation on bf16 inputs, "
+         "set MXNET_BN_BF16_REDUCE=0 AND this flag to 0.")
 register("MXNET_BN_BF16_REDUCE", True, bool,
          "BatchNorm: when the input is bfloat16, keep every materialized "
          "tensor bf16 and apply the normalize with f32 scale/shift "
@@ -142,6 +148,13 @@ register("MXNET_OPT_BF16_MOMENTS", False, bool,
          "bf16-stored v once v is ~2^9 times larger, biasing v low on long "
          "horizons. Short-horizon convergence gate: tests/test_optimizer_ops"
          ".py::test_adam_bf16_moments_close_and_converges.")
+register("MXNET_JIT_CACHE_SIZE", 4096, int,
+         "Capacity (entries) of the eager per-(op, static-attrs) jit "
+         "executable LRU cache (ops/registry.py). Each entry retains a "
+         "jax.jit wrapper plus its compiled executables; bounding it keeps "
+         "long-running eager workloads with per-iteration-varying attrs "
+         "(slice bounds, pad widths, reshape targets) from growing host "
+         "memory without bound. Eviction recompiles on next use.")
 register("MXNET_KVSTORE_ASYNC_MAX_STALENESS", -1, int,
          "dist_async: max whole-model push rounds a worker may run ahead of "
          "the slowest (SSP bound); -1 = unbounded, the reference's pure "

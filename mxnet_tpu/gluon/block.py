@@ -516,7 +516,7 @@ class HybridBlock(Block):
         raise NotImplementedError
 
     # -- export (block.py:1241) ---------------------------------------------
-    def export(self, path, epoch=0, remove_amp_cast=True):
+    def export(self, path, epoch=0, remove_amp_cast=True, dynamic_batch=False):
         """Serialize the compiled model so it can be reloaded and executed
         WITHOUT the defining Python class (the reference's symbol-json export,
         block.py:1241): the traced inference computation is exported as a
@@ -525,7 +525,14 @@ class HybridBlock(Block):
 
         Requires the block to have been called at least once (to know the
         input signature) — same contract as the reference's export-after-
-        hybridize. Returns (model_file, params_file)."""
+        hybridize. Returns (model_file, params_file).
+
+        ``dynamic_batch=True`` exports the leading axis of every input as a
+        shape-polymorphic dimension (jax.export symbolic shapes), so the
+        reloaded SymbolBlock runs at ANY batch size — required when the
+        checkpoint will be served behind the shape-bucketed batcher
+        (serving.ModelEndpoint.from_checkpoint) instead of replayed at the
+        traced batch size."""
         import base64
         import jax
         from jax import export as jax_export
@@ -552,6 +559,13 @@ class HybridBlock(Block):
         param_avals = tuple(jax.ShapeDtypeStruct(tuple(p.shape),
                                                  p.data().data.dtype)
                             for p in params)
+        if dynamic_batch:
+            # one shared symbolic batch dim across all inputs (they batch
+            # together), body dims stay concrete from the recorded signature
+            (b,) = jax_export.symbolic_shape("b")
+            in_avals = tuple(jax.ShapeDtypeStruct((b,) + tuple(a.shape[1:]),
+                                                  a.dtype)
+                             for a in in_avals)
         exported = jax_export.export(jax.jit(infer_fn),
                                      platforms=("cpu", "tpu"))(
             param_avals, *in_avals)
@@ -559,7 +573,9 @@ class HybridBlock(Block):
             "class": f"{self.__class__.__module__}.{self.__class__.__name__}",
             "format": "mxnet_tpu/stablehlo-v1",
             "params": [p.name for p in params],
-            "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+            "dynamic_batch": bool(dynamic_batch),
+            "inputs": [{"shape": [d if isinstance(d, int) else str(d)
+                                  for d in a.shape], "dtype": str(a.dtype)}
                        for a in in_avals],
             "stablehlo_b64": base64.b64encode(
                 bytes(exported.serialize())).decode("ascii"),

@@ -52,7 +52,9 @@ class PositionwiseFFN(HybridBlock):
     modeling.py gelu) as default: numerically ~1e-3 of the erf-exact form
     and measured 17% faster end-to-end on v5e (PERF.md round 5 — the erf
     VJP forces an extra saved pre-activation tensor through the MLP matmul
-    fusions). Pass activation="gelu" for the erf-exact variant."""
+    fusions). Pass activation="gelu" for the erf-exact variant — e.g. when
+    fine-tuning checkpoints trained against the reference framework's
+    erf-GELU op (default changed in round 5, see CHANGELOG.md)."""
 
     def __init__(self, units, hidden_size, dropout=0.0, activation="gelu_tanh",
                  **kwargs):

@@ -305,6 +305,22 @@ def softmax_cross_entropy(data, label):
 # normalisation (nn/batch_norm.cc, layer_norm.cc, group_norm.cc, instance_norm.cc,
 # l2_normalization.cc, lrn.cc)
 # ---------------------------------------------------------------------------
+def _bn_onepass_enabled(dtype) -> bool:
+    """Resolve MXNET_BN_ONEPASS for this input dtype. 'auto' (default) keeps
+    the one-pass E[x^2]-mu^2 moments for sub-f32 inputs only: a bf16/f16
+    activation cannot carry the |mean|/std ratio that makes the subtraction
+    cancel at f32 accumulation, while f32/f64 inputs can (mean~300/std~0.01
+    clamps var to 0) and therefore get the two-pass reference form."""
+    from .. import config as _config
+    v = _config.get("MXNET_BN_ONEPASS")
+    if isinstance(v, bool):               # config.set(..., True/False)
+        return v
+    s = str(v).strip().lower()
+    if s in ("auto", ""):
+        return dtype in (jnp.bfloat16, jnp.float16)
+    return s in ("1", "true", "yes", "on")
+
+
 @register("BatchNorm", jit=True)
 def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
@@ -337,7 +353,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.
     xa32 = x.astype(acc)
     if training and not use_global_stats:
         mean = jnp.mean(xa32, axis=red)
-        onepass = bf16_fast or _config.get("MXNET_BN_ONEPASS")
+        onepass = bf16_fast or _bn_onepass_enabled(x.dtype)
         if axis_name is not None:
             # cross-device moments via E[x^2] - E[x]^2 (one pmean pair) —
             # the SyncBatchNorm hook
@@ -679,7 +695,17 @@ def multi_head_attention(q, k, v, mask=None, *, heads=1, dropout=0.0, causal=Fal
                          use_flash=None):
     """Batched SDPA: q/k/v (N, L, H*D). On TPU the unmasked/causal path runs the
     flash-attention Pallas kernel (ops/pallas/flash_attention.py); padding-mask
-    and non-TPU paths use the XLA composite."""
+    and non-TPU paths use the XLA composite.
+
+    Causal masking convention (``causal=True``): when Lq != Lk the mask is
+    **bottom-right aligned** — query row i attends keys ``j <= i + (Lk - Lq)``,
+    so the LAST query row always sees every key. This is the standard
+    KV-cache / flash-attention convention (query rows are the trailing
+    positions of the key sequence) and a no-op for Lq == Lk, but it differs
+    from a top-left ``tril``: with a top-left mask the FIRST query row sees
+    only key 0. Changed in round 5 (see CHANGELOG.md); cross-length causal
+    callers that want the old top-left behaviour should pass an explicit
+    ``mask=jnp.tril(jnp.ones((Lq, Lk), bool))`` instead of ``causal=True``."""
     N, Lq, HD = q.shape
     D = HD // heads
     qh = q.reshape(N, Lq, heads, D).transpose(0, 2, 1, 3)

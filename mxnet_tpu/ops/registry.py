@@ -18,6 +18,7 @@ from __future__ import annotations
 import functools
 import inspect
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 from ..base import MXNetError
@@ -25,8 +26,18 @@ from ..base import MXNetError
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]
 
 _OPS: Dict[str, "Op"] = {}
-_JIT_CACHE: Dict[Tuple, Callable] = {}
+# LRU of per-(op, frozen-attrs) jit wrappers. Bounded (MXNET_JIT_CACHE_SIZE):
+# eager workloads with per-iteration-varying static attrs (slice begin/end,
+# pad widths, reshape targets) would otherwise retain a jax.jit wrapper —
+# and its compile cache — per distinct combination, growing host memory
+# without bound over long runs (ADVICE r5).
+_JIT_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
 _JIT_LOCK = threading.Lock()
+
+
+def _jit_cache_capacity() -> int:
+    from .. import config
+    return config.get("MXNET_JIT_CACHE_SIZE")
 
 
 class Op:
@@ -101,15 +112,23 @@ def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
     if not op.jit:
         return functools.partial(op.fn, **attrs) if attrs else op.fn
     key = (op.name, _freeze(attrs))
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        import jax
-        with _JIT_LOCK:
-            fn = _JIT_CACHE.get(key)
-            if fn is None:
-                base = functools.partial(op.fn, **attrs) if attrs else op.fn
-                fn = jax.jit(base)
-                _JIT_CACHE[key] = fn
+    with _JIT_LOCK:
+        fn = _JIT_CACHE.get(key)
+        if fn is not None:
+            _JIT_CACHE.move_to_end(key)
+            return fn
+    import jax
+    with _JIT_LOCK:
+        fn = _JIT_CACHE.get(key)
+        if fn is None:
+            base = functools.partial(op.fn, **attrs) if attrs else op.fn
+            fn = jax.jit(base)
+            _JIT_CACHE[key] = fn
+            cap = _jit_cache_capacity()
+            while len(_JIT_CACHE) > cap:
+                _JIT_CACHE.popitem(last=False)
+        else:
+            _JIT_CACHE.move_to_end(key)
     return fn
 
 

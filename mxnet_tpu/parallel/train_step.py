@@ -331,20 +331,27 @@ class ParallelTrainStep:
                 comp = jfn.lower(*abstract, *args[3:]).compile()
                 cache[key] = comp
             if cache.get("owner") is not comp:
-                # move the carried state into THIS executable's formats and
-                # persist it so later dispatches skip the transfer
+                # move the carried state into THIS executable's formats; keep
+                # the re-placed arrays in locals until the donating call has
+                # RETURNED — if it raises mid-step (e.g. device OOM), the
+                # trainer still holds the original un-donated state and can
+                # retry (ADVICE r5: persisting before the call left
+                # self._params pointing at deleted donated buffers)
                 informats = comp.input_formats[0]
                 placed = tuple(
                     jax.tree_util.tree_map(jax.device_put, args[i],
                                            informats[i])
                     for i in range(3))
+                out = comp(*(placed + args[3:]))
+                # persist only after success so later dispatches skip the
+                # transfer (the caller immediately overwrites with outputs)
                 for j, i in enumerate(self._trainable_idx):
                     self._params[i] = placed[0][j]
                 for j, i in enumerate(self._aux_idx):
                     self._params[i] = placed[1][j]
                 self._opt_states = list(placed[2])
                 cache["owner"] = comp
-                args = placed + args[3:]
+                return out
             return comp(*args)
 
         return wrapper

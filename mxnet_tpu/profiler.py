@@ -117,6 +117,15 @@ def _dispatch_profiled(name, thunk, cat="operator"):
     return out
 
 
+def record_duration(name, t0_us, dur_us, cat="operator"):
+    """Record an externally-timed duration event (e.g. a serving batch step
+    or request latency measured by its own clock) into the chrome trace and
+    aggregate table. No-op unless the profiler is running; timestamps must be
+    perf_counter-based microseconds to land coherently in the trace."""
+    if _STATE["running"]:
+        _record(name, cat, t0_us, dur_us)
+
+
 @contextmanager
 def scope(name: str, cat: str = "operator"):
     """Profile a code region; also emits a jax named-scope annotation so the region

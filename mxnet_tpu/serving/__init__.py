@@ -1,0 +1,60 @@
+"""mxnet_tpu.serving — dynamic-batching inference serving.
+
+The production request->response path over this framework (the serving-system
+component TensorFlow treats as first-class, PAPERS.md): concurrent client
+requests are accumulated by a dynamic batcher into device-sized batches under
+a configurable deadline, padded to shape buckets so every bucket hits one
+cached compiled executable (never recompiling in steady state), executed as
+one device step, and sliced back into per-request responses.
+
+    from mxnet_tpu import serving
+
+    ep = serving.ModelEndpoint("resnet50", net, input_shapes=(3, 224, 224),
+                               dtype="bfloat16", max_batch_size=32)
+    server = serving.InferenceServer(batch_timeout_ms=2.0, max_queue=256)
+    server.register(ep)          # warms every shape bucket (compile-free serving)
+    server.start()
+
+    out = server.predict("resnet50", img)           # blocking
+    fut = server.submit("resnet50", img, deadline_ms=50.0)  # async w/ deadline
+
+    serving.stats()["resnet50"]  # p50/p95/p99, occupancy, compile counters
+    server.stop(drain=True)      # graceful: flushes admitted work first
+
+Numerics contract: a served output is BITWISE equal to the hybridized direct
+forward of the same rows — the endpoint executable is the same
+single-XLA-computation trace CachedOp builds, padding rows never mix into
+real rows, and bucket size does not change per-row results. (Eager op-by-op
+dispatch of the same net may differ by float rounding, because XLA fuses the
+whole traced graph differently than per-op programs.)
+
+Robustness contract: the queue is bounded (ServerOverloadError at admission —
+explicit backpressure instead of unbounded latency), per-request deadlines
+drop expired work before it occupies device rows (RequestTimeoutError), and
+shutdown drains by default. Observability rides the profiler layer: when the
+profiler runs, every serving step is a recorded dispatch event, and
+``stats()`` snapshots per-endpoint latency histograms, queue depth, batch
+occupancy (real vs padded rows) and executable-cache hit/compile counters.
+"""
+from __future__ import annotations
+
+from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
+from .errors import (RequestTimeoutError, ServerClosedError,
+                     ServerOverloadError, ServingError)
+from .server import InferenceServer
+from . import bucketing
+
+__all__ = ["ModelEndpoint", "InferenceServer", "stats", "get_endpoint",
+           "list_endpoints", "unregister", "ServingError",
+           "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
+           "bucketing"]
+
+
+def stats():
+    """Snapshot of every registered endpoint's serving metrics:
+    ``{endpoint: {counters, queue_depth, batch_occupancy, latency, step}}``.
+    Latency blocks carry count/mean/p50/p95/p99/min/max in microseconds."""
+    from .endpoint import _ENDPOINTS, _REG_LOCK
+    with _REG_LOCK:
+        eps = list(_ENDPOINTS.values())
+    return {ep.name: ep.stats.snapshot() for ep in eps}

@@ -1,0 +1,155 @@
+"""Dynamic batcher: per-endpoint bounded request queue + batch assembly.
+
+Concurrent requests accumulate into device-sized batches under a deadline:
+a queue becomes *ready* when it holds a full ``max_batch_size`` worth of rows
+or its oldest request has waited ``batch_timeout_ms`` (or the server is
+draining, which flushes immediately). Assembly is where per-request deadlines
+are enforced — expired requests are failed and dropped BEFORE they occupy
+device rows, so a timed-out client never wastes a step.
+
+Admission control is row-based: ``offer`` rejects (without enqueueing) once
+``max_queue_rows`` rows are waiting. The caller-facing contract is explicit
+backpressure — callers see ServerOverloadError and back off — instead of an
+unbounded queue whose latency grows until everything times out.
+
+All mutation happens under the server's shared condition lock; the batcher
+itself never blocks and never touches the device.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from .errors import RequestTimeoutError
+
+__all__ = ["Request", "EndpointQueue", "resolve", "fail"]
+
+
+def resolve(fut: Future, value):
+    """set_result that tolerates a client having cancelled the future."""
+    try:
+        fut.set_result(value)
+    except Exception:
+        pass
+
+
+def fail(fut: Future, exc: Exception):
+    try:
+        fut.set_exception(exc)
+    except Exception:
+        pass
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class Request:
+    """One admitted inference request: host-side input rows plus a Future the
+    dispatch loop resolves with sliced outputs (or an error)."""
+
+    __slots__ = ("inputs", "rows", "squeeze", "enqueue_us", "deadline_us",
+                 "future")
+
+    def __init__(self, inputs: Tuple[onp.ndarray, ...], rows: int,
+                 squeeze: bool, deadline_ms: Optional[float] = None):
+        self.inputs = inputs
+        self.rows = rows
+        self.squeeze = squeeze            # single example: drop the batch axis
+        self.enqueue_us = _now_us()
+        self.deadline_us = (self.enqueue_us + int(deadline_ms * 1000)
+                            if deadline_ms is not None else None)
+        self.future: Future = Future()
+
+    def expired(self, now_us: int) -> bool:
+        return self.deadline_us is not None and now_us > self.deadline_us
+
+
+class EndpointQueue:
+    """FIFO of admitted requests for one endpoint, with row accounting."""
+
+    def __init__(self, endpoint, max_queue_rows: int, batch_timeout_us: int):
+        self.endpoint = endpoint
+        self.max_queue_rows = max_queue_rows
+        self.batch_timeout_us = batch_timeout_us
+        self._pending: "deque[Request]" = deque()
+        self.pending_rows = 0
+
+    def __len__(self):
+        return len(self._pending)
+
+    # -- admission (caller holds the server lock) ---------------------------
+    def offer(self, req: Request) -> bool:
+        """Admit ``req`` unless the bounded queue is full. Returns False on
+        overload (request NOT enqueued; caller raises)."""
+        if self.pending_rows + req.rows > self.max_queue_rows:
+            self.endpoint.stats.bump("rejected")
+            return False
+        self._pending.append(req)
+        self.pending_rows += req.rows
+        self.endpoint.stats.bump("submitted")
+        self.endpoint.stats.set_queue_depth(self.pending_rows)
+        return True
+
+    # -- readiness (caller holds the server lock) ---------------------------
+    def ready(self, now_us: int, flush: bool = False) -> bool:
+        if not self._pending:
+            return False
+        if flush or self.pending_rows >= self.endpoint.max_batch_size:
+            return True
+        return now_us - self._pending[0].enqueue_us >= self.batch_timeout_us
+
+    def next_wakeup_us(self) -> Optional[int]:
+        """Absolute time at which the head request hits the batch deadline."""
+        if not self._pending:
+            return None
+        return self._pending[0].enqueue_us + self.batch_timeout_us
+
+    # -- assembly (caller holds the server lock) ----------------------------
+    def take_batch(self, now_us: int) -> List[Request]:
+        """Pop a FIFO prefix of requests that fits max_batch_size rows,
+        failing-and-dropping any whose deadline already passed. May return []
+        when every pending request had expired."""
+        ep = self.endpoint
+        batch: List[Request] = []
+        rows = 0
+        while self._pending:
+            head = self._pending[0]
+            if head.expired(now_us):
+                self._pending.popleft()
+                self.pending_rows -= head.rows
+                ep.stats.bump("deadline_drops")
+                fail(head.future, RequestTimeoutError(
+                    f"deadline expired after "
+                    f"{(now_us - head.enqueue_us) / 1e3:.1f} ms in queue"))
+                continue
+            if rows + head.rows > ep.max_batch_size:
+                break
+            self._pending.popleft()
+            self.pending_rows -= head.rows
+            batch.append(head)
+            rows += head.rows
+        ep.stats.set_queue_depth(self.pending_rows)
+        return batch
+
+    def fail_all(self, exc: Exception, counter: str = "cancelled"):
+        """Drain the queue, failing every pending future (non-drain stop)."""
+        while self._pending:
+            req = self._pending.popleft()
+            self.pending_rows -= req.rows
+            self.endpoint.stats.bump(counter)
+            fail(req.future, exc)
+        self.endpoint.stats.set_queue_depth(0)
+
+
+def concat_inputs(reqs: Sequence[Request], num_inputs: int
+                  ) -> Tuple[onp.ndarray, ...]:
+    """Concatenate per-request host inputs into one batch per model input."""
+    return tuple(
+        onp.concatenate([r.inputs[i] for r in reqs], axis=0)
+        if len(reqs) > 1 else reqs[0].inputs[i]
+        for i in range(num_inputs))

@@ -1,0 +1,53 @@
+"""Shape-bucket policy for the dynamic batcher.
+
+On XLA-compiled hardware every distinct input shape is a distinct executable,
+and served batch sizes are whatever concurrency happens to produce — so an
+unbucketed server compiles continuously and a fully-padded server wastes MXU
+rows (the padding/bucketing trade-off the learned-TPU-cost-model line of work
+measures, PAPERS.md). The policy here is the standard compromise: batch sizes
+round UP to a small fixed ladder (powers of two by default), so the executable
+cache is bounded by ``len(buckets)`` while padding waste per step is < 2x in
+the worst case and ~0 at the full-batch steady state.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["pow2_buckets", "bucket_for", "pad_rows"]
+
+
+def pow2_buckets(max_batch_size: int) -> Tuple[int, ...]:
+    """Power-of-two ladder 1, 2, 4, ... capped at and including max_batch_size."""
+    if max_batch_size < 1:
+        raise MXNetError(f"max_batch_size must be >= 1, got {max_batch_size}")
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return tuple(out)
+
+
+def bucket_for(rows: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``rows`` real rows."""
+    for b in buckets:
+        if b >= rows:
+            return b
+    raise MXNetError(f"{rows} rows exceed the largest bucket {buckets[-1]}")
+
+
+def pad_rows(batch: onp.ndarray, bucket: int) -> onp.ndarray:
+    """Zero-pad ``batch`` along axis 0 up to ``bucket`` rows (no copy when
+    already exact)."""
+    rows = batch.shape[0]
+    if rows == bucket:
+        return batch
+    if rows > bucket:
+        raise MXNetError(f"batch of {rows} rows does not fit bucket {bucket}")
+    pad = onp.zeros((bucket - rows,) + batch.shape[1:], dtype=batch.dtype)
+    return onp.concatenate([batch, pad], axis=0)

@@ -1,0 +1,258 @@
+"""ModelEndpoint: a loaded model plus its shape-bucketed executable cache.
+
+One endpoint owns one inference program — a HybridBlock (including
+``quantize_net``-converted int8 graphs and bf16-cast nets) or a SymbolBlock
+reloaded from an exported checkpoint — traced once through the same
+``pure_apply`` primitive CachedOp uses (gluon/block.py), then AOT-compiled per
+shape bucket with ``jax.jit(...).lower(avals).compile()``. Compiling through
+the AOT path (instead of letting ``jax.jit`` cache internally) makes the
+executable cache explicit: the endpoint counts every compile, so the
+"recompiles only once per bucket" property is assertable, and ``warmup()``
+can pre-build every bucket at load time so no request ever pays a compile.
+
+Params ride as executable *arguments*, not closure constants (PERF.md round-4
+lesson: constants bloat the compile payload), so a checkpoint reload swaps
+weights without invalidating the compiled buckets.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import Context, DTypes, MXNetError, current_context
+from ..ndarray.ndarray import NDArray
+from . import bucketing
+from .stats import EndpointStats
+
+__all__ = ["ModelEndpoint"]
+
+# name -> endpoint; the registry behind mxnet_tpu.serving.stats()
+_ENDPOINTS: Dict[str, "ModelEndpoint"] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class ModelEndpoint:
+    """A named, servable model with bucketed compiled executables.
+
+    Parameters
+    ----------
+    name : str
+        Registry key; ``serving.stats()`` reports under this name.
+    block : HybridBlock
+        The model. Must be runnable in inference mode. bf16 nets (via
+        ``block.cast('bfloat16')``) and ``quantize_net``-converted int8 nets
+        are first-class — they trace like any other HybridBlock.
+    input_shapes : shape | sequence of shapes
+        Per-example shape (without the batch axis) of each model input.
+        A single shape tuple means a single-input model.
+    dtype : str | sequence of str
+        Input dtype(s); requests are cast on the host before device transfer.
+    max_batch_size : int
+        Largest served batch; also the largest bucket.
+    buckets : sequence of int, optional
+        Ascending batch-size buckets. Default: powers of two up to
+        ``max_batch_size``.
+    ctx : Context, optional
+        Device the endpoint serves from (default: current context).
+    """
+
+    def __init__(self, name: str, block, input_shapes, dtype="float32",
+                 max_batch_size: int = 32,
+                 buckets: Optional[Sequence[int]] = None,
+                 ctx: Optional[Context] = None):
+        self.name = name
+        self.block = block
+        self.ctx = ctx if ctx is not None else current_context()
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise MXNetError("max_batch_size must be >= 1")
+        if buckets is None:
+            buckets = bucketing.pow2_buckets(self.max_batch_size)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[-1] != self.max_batch_size:
+            raise MXNetError("largest bucket must equal max_batch_size "
+                             f"(got buckets={self.buckets}, "
+                             f"max_batch_size={self.max_batch_size})")
+
+        if input_shapes and isinstance(input_shapes[0], int):
+            input_shapes = (input_shapes,)
+        self.input_shapes: Tuple[Tuple[int, ...], ...] = tuple(
+            tuple(int(d) for d in s) for s in input_shapes)
+        if isinstance(dtype, (list, tuple)):
+            dts = tuple(dtype)
+        else:
+            dts = (dtype,) * len(self.input_shapes)
+        if len(dts) != len(self.input_shapes):
+            raise MXNetError("one dtype per input required")
+        self._jnp_dtypes = tuple(DTypes.jnp(d) for d in dts)
+        self.np_dtypes = tuple(onp.dtype(d) for d in self._jnp_dtypes)
+
+        self.stats = EndpointStats(name)
+        self._lock = threading.Lock()
+        self._execs: Dict[int, object] = {}   # bucket -> compiled executable
+        self._jfn = None
+        self._params = None                   # ordered Parameter list
+        self._probe()
+
+        with _REG_LOCK:
+            _ENDPOINTS[name] = self
+
+    # ------------------------------------------------------------------
+    # checkpoint loading
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, name: str, symbol_file: str, param_file: str,
+                        input_shapes, **kwargs) -> "ModelEndpoint":
+        """Load an endpoint from an exported checkpoint (HybridBlock.export's
+        ``-symbol.json`` + ``.params``) — no defining Python class needed.
+        The export must have been made with ``dynamic_batch=True`` so the
+        embedded program accepts every bucket's batch size (a fixed-batch
+        export can only ever run at its traced batch)."""
+        import json as _json
+        from ..gluon.block import SymbolBlock
+        with open(symbol_file) as f:
+            meta = _json.load(f)
+        if not meta.get("dynamic_batch", False):
+            raise MXNetError(
+                f"{symbol_file} was exported with a fixed batch size; "
+                "re-export with HybridBlock.export(..., dynamic_batch=True) "
+                "to serve it across shape buckets")
+        blk = SymbolBlock.imports(symbol_file, input_names=None,
+                                  param_file=param_file)
+        return cls(name, blk, input_shapes, **kwargs)
+
+    # ------------------------------------------------------------------
+    # model preparation
+    # ------------------------------------------------------------------
+    def _zeros_batch(self, rows: int):
+        return tuple(
+            NDArray(onp.zeros((rows,) + s, dt), ctx=self.ctx)
+            for s, dt in zip(self.input_shapes, self.np_dtypes))
+
+    def _probe(self):
+        """One eager forward with a bucket-1 zero batch: triggers deferred
+        parameter init, validates the declared input signature, and records
+        the output arity for per-request slicing."""
+        from .. import autograd
+        dummy = self._zeros_batch(1)
+        with autograd._RecordingStateScope(False, False):
+            out = self.block(*dummy)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        self.num_outputs = len(outs)
+        for o in outs:
+            if not (hasattr(o, "shape") and o.shape and o.shape[0] == 1):
+                raise MXNetError(
+                    f"endpoint {self.name!r}: every model output must be "
+                    "batch-major (leading axis = batch) so per-request rows "
+                    f"can be sliced back out; got output shape {getattr(o, 'shape', None)}")
+        self._params = list(self.block.collect_params().values())
+
+    def _infer_fn(self):
+        if self._jfn is None:
+            import jax
+            from ..gluon.block import pure_apply
+            block, plist = self.block, self._params
+
+            def infer(param_datas, *input_datas):
+                outs, _, _ = pure_apply(block, plist, param_datas, input_datas,
+                                        None, training=False)
+                return outs
+
+            self._jfn = jax.jit(infer)
+        return self._jfn
+
+    def _param_datas(self):
+        return tuple(p.data(self.ctx).data for p in self._params)
+
+    # ------------------------------------------------------------------
+    # the shape-bucketed executable cache
+    # ------------------------------------------------------------------
+    def _get_executable(self, bucket: int):
+        comp = self._execs.get(bucket)
+        if comp is not None:
+            self.stats.bump("cache_hits")
+            return comp
+        with self._lock:
+            comp = self._execs.get(bucket)
+            if comp is not None:
+                self.stats.bump("cache_hits")
+                return comp
+            import jax
+            t0 = _now_us()
+            param_sds = tuple(
+                jax.ShapeDtypeStruct(tuple(p.shape), p.data(self.ctx).data.dtype)
+                for p in self._params)
+            in_sds = tuple(
+                jax.ShapeDtypeStruct((bucket,) + s, dt)
+                for s, dt in zip(self.input_shapes, self._jnp_dtypes))
+            comp = self._infer_fn().lower(param_sds, *in_sds).compile()
+            self._execs[bucket] = comp
+            self.stats.record_compile(_now_us() - t0)
+            return comp
+
+    def warmup(self, execute: bool = True):
+        """Compile (and by default execute once) every bucket, so serving
+        traffic never hits a compile — first-request latency is steady-state
+        latency. Returns the number of buckets compiled."""
+        import jax
+        n = 0
+        for b in self.buckets:
+            fresh = b not in self._execs
+            comp = self._get_executable(b)
+            if fresh:
+                n += 1
+                if execute:
+                    ins = tuple(a.data for a in self._zeros_batch(b))
+                    jax.block_until_ready(comp(self._param_datas(), *ins))
+        return n
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def run_batch(self, host_inputs: Sequence[onp.ndarray], rows: int):
+        """Run one padded device step over pre-concatenated host inputs.
+
+        host_inputs: one ndarray per model input, each with ``rows`` real rows.
+        Returns (outputs, bucket): outputs is a tuple of device arrays with
+        ``bucket`` rows each; callers slice [0:rows] back out per request."""
+        import jax
+        bucket = bucketing.bucket_for(rows, self.buckets)
+        padded = tuple(bucketing.pad_rows(a, bucket) for a in host_inputs)
+        dev = self.ctx.jax_device()
+        ins = tuple(jax.device_put(a, dev) for a in padded)
+        comp = self._get_executable(bucket)
+        outs = comp(self._param_datas(), *ins)
+        jax.block_until_ready(outs)
+        self.stats.bump("batches")
+        self.stats.bump("real_rows", rows)
+        self.stats.bump("padded_rows", bucket - rows)
+        return outs, bucket
+
+    def __repr__(self):
+        return (f"ModelEndpoint({self.name!r}, inputs={self.input_shapes}, "
+                f"buckets={self.buckets})")
+
+
+def get_endpoint(name: str) -> ModelEndpoint:
+    with _REG_LOCK:
+        if name not in _ENDPOINTS:
+            raise MXNetError(f"unknown endpoint {name!r}; registered: "
+                             f"{sorted(_ENDPOINTS)}")
+        return _ENDPOINTS[name]
+
+
+def list_endpoints():
+    with _REG_LOCK:
+        return sorted(_ENDPOINTS)
+
+
+def unregister(name: str):
+    with _REG_LOCK:
+        _ENDPOINTS.pop(name, None)

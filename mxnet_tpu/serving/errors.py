@@ -1,0 +1,31 @@
+"""Serving-layer error taxonomy.
+
+Admission control needs errors a client can branch on: overload is retryable
+with backoff, a missed deadline is not (the work was dropped on purpose), and
+a closed server means the process is going away. All derive from MXNetError
+so existing blanket handlers keep working.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "ServerOverloadError", "RequestTimeoutError",
+           "ServerClosedError"]
+
+
+class ServingError(MXNetError):
+    """Base class for serving-layer failures."""
+
+
+class ServerOverloadError(ServingError):
+    """The bounded request queue is full; the request was rejected at
+    admission (never enqueued). Retryable: back off and resubmit."""
+
+
+class RequestTimeoutError(ServingError):
+    """The request's deadline expired while it waited in the queue; it was
+    dropped before reaching the device (no compute was wasted on it)."""
+
+
+class ServerClosedError(ServingError):
+    """The server is stopped or draining and no longer admits new work."""

@@ -1,0 +1,269 @@
+"""InferenceServer: the request -> batch -> device -> response loop.
+
+One worker thread owns all device work (the single-dispatcher discipline the
+reference gets from its engine thread): client threads only validate, cast to
+host numpy, and enqueue under the shared condition — so arbitrary client
+concurrency never races JAX dispatch. The worker waits until some endpoint
+queue is ready (full batch, batch timeout, or drain), assembles a batch with
+expired requests dropped, runs the padded bucket step, slices per-request
+rows back out, and resolves futures AFTER the device result is ready — so the
+recorded request latency is honest end-to-end time.
+
+Shutdown is graceful by default: ``stop(drain=True)`` flushes every admitted
+request through the device before the thread exits, while new submissions are
+already being refused; ``drain=False`` fails pending futures immediately.
+
+When the profiler is running, every device step is recorded through the same
+``_dispatch_profiled`` sink ops and CachedOp use, so serving steps land in the
+chrome trace / aggregate table alongside per-op events.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .batcher import (EndpointQueue, Request, concat_inputs, fail,
+                      resolve)
+from .endpoint import ModelEndpoint
+from .errors import ServerClosedError, ServerOverloadError
+
+__all__ = ["InferenceServer"]
+
+_RUNNING, _DRAINING, _STOPPED = "running", "draining", "stopped"
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class InferenceServer:
+    """Dynamic-batching inference front-end over registered ModelEndpoints.
+
+    Parameters
+    ----------
+    batch_timeout_ms : float
+        Max time the oldest queued request waits before a partial batch is
+        dispatched anyway (the latency half of the batching trade-off).
+    max_queue : int
+        Admission-control bound, in rows, per endpoint. Submissions beyond it
+        raise ServerOverloadError instead of growing the queue.
+    """
+
+    def __init__(self, batch_timeout_ms: float = 2.0, max_queue: int = 256):
+        self._batch_timeout_us = int(batch_timeout_ms * 1000)
+        self._max_queue_rows = int(max_queue)
+        self._queues: Dict[str, EndpointQueue] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._state = _STOPPED
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # endpoint management
+    # ------------------------------------------------------------------
+    def register(self, endpoint: ModelEndpoint, warmup: bool = True
+                 ) -> ModelEndpoint:
+        """Attach an endpoint; by default compiles every shape bucket now so
+        no request ever pays first-compile latency."""
+        with self._cond:
+            if endpoint.name in self._queues:
+                raise MXNetError(f"endpoint {endpoint.name!r} already registered")
+            self._queues[endpoint.name] = EndpointQueue(
+                endpoint, self._max_queue_rows, self._batch_timeout_us)
+        if warmup:
+            endpoint.warmup()
+        return endpoint
+
+    def endpoints(self):
+        with self._cond:
+            return sorted(self._queues)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        with self._cond:
+            if self._state != _STOPPED:
+                raise MXNetError(f"server is {self._state}")
+            self._state = _RUNNING
+            self._thread = threading.Thread(
+                target=self._loop, name="mxtpu-serving-worker", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop serving. ``drain=True`` (default) processes every admitted
+        request before returning; ``drain=False`` fails them immediately."""
+        with self._cond:
+            if self._state == _STOPPED:
+                return
+            if drain:
+                self._state = _DRAINING
+            else:
+                self._state = _STOPPED
+                exc = ServerClosedError("server stopped without drain")
+                for q in self._queues.values():
+                    q.fail_all(exc)
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False
+
+    # ------------------------------------------------------------------
+    # client surface
+    # ------------------------------------------------------------------
+    def submit(self, name: str, inputs, deadline_ms: Optional[float] = None
+               ) -> Future:
+        """Enqueue a request; returns a Future resolving to the endpoint's
+        output (an NDArray, or a tuple for multi-output models). A single
+        example (no batch axis) resolves without a batch axis; a batch of n
+        rows resolves to n-row outputs.
+
+        Raises ServerOverloadError when the bounded queue is full and
+        ServerClosedError when the server is not accepting work."""
+        with self._cond:
+            if name not in self._queues:
+                raise MXNetError(f"unknown endpoint {name!r}; registered: "
+                                 f"{sorted(self._queues)}")
+            q = self._queues[name]
+        req = self._make_request(q.endpoint, inputs, deadline_ms)
+        with self._cond:
+            if self._state != _RUNNING:
+                raise ServerClosedError(f"server is {self._state}")
+            if not q.offer(req):
+                raise ServerOverloadError(
+                    f"endpoint {name!r} queue full "
+                    f"({q.pending_rows} rows >= {q.max_queue_rows}); retry with backoff")
+            self._cond.notify()
+        return req.future
+
+    def predict(self, name: str, inputs, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Blocking convenience wrapper over submit()."""
+        return self.submit(name, inputs, deadline_ms).result(timeout=timeout)
+
+    def _make_request(self, ep: ModelEndpoint, inputs,
+                      deadline_ms: Optional[float]) -> Request:
+        """Validate + host-normalize one request OUTSIDE the lock: every
+        input becomes a contiguous numpy batch in the endpoint dtype."""
+        if not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        if len(inputs) != len(ep.input_shapes):
+            raise MXNetError(f"endpoint {ep.name!r} takes "
+                             f"{len(ep.input_shapes)} inputs, got {len(inputs)}")
+        host = []
+        rows = None
+        squeeze = None
+        for i, (x, shape, npdt) in enumerate(
+                zip(inputs, ep.input_shapes, ep.np_dtypes)):
+            a = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+            if a.shape == shape:
+                a = a[None]
+                sq = True
+            elif a.shape[1:] == shape:
+                sq = False
+            else:
+                raise MXNetError(
+                    f"endpoint {ep.name!r} input {i}: expected per-example "
+                    f"shape {shape} (optionally batched), got {a.shape}")
+            if rows is None:
+                rows, squeeze = a.shape[0], sq
+            elif a.shape[0] != rows:
+                raise MXNetError(f"endpoint {ep.name!r}: inputs disagree on "
+                                 f"batch rows ({rows} vs {a.shape[0]})")
+            if a.dtype != npdt:
+                a = a.astype(npdt)
+            host.append(onp.ascontiguousarray(a))
+        if rows > ep.max_batch_size:
+            raise MXNetError(
+                f"request of {rows} rows exceeds endpoint {ep.name!r} "
+                f"max_batch_size={ep.max_batch_size}; split the request")
+        return Request(tuple(host), rows, squeeze, deadline_ms)
+
+    # ------------------------------------------------------------------
+    # worker loop
+    # ------------------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cond:
+                batch, q = self._wait_for_batch()
+                if batch is None:
+                    self._state = _STOPPED
+                    return
+            if batch:
+                self._dispatch(q, batch)
+
+    def _wait_for_batch(self):
+        """Block (holding the lock) until some queue is ready, a drain can
+        finish, or the server stops. Returns (requests, queue); requests may
+        be [] when all ready work had expired, and (None, None) on exit."""
+        while True:
+            if self._state == _STOPPED:
+                return None, None
+            now = _now_us()
+            flush = self._state == _DRAINING
+            ready = [q for q in self._queues.values() if q.ready(now, flush)]
+            if ready:
+                # oldest head request first: closest to its latency budget
+                q = min(ready, key=lambda q: q._pending[0].enqueue_us)
+                return q.take_batch(now), q
+            if flush:                      # draining and nothing pending
+                return None, None
+            wakeups = [t for q in self._queues.values()
+                       for t in (q.next_wakeup_us(),) if t is not None]
+            timeout = (max(min(wakeups) - now, 0) / 1e6) if wakeups else None
+            self._cond.wait(timeout=timeout)
+
+    def _dispatch(self, q: EndpointQueue, batch):
+        ep = q.endpoint
+        rows = sum(r.rows for r in batch)
+        host_inputs = concat_inputs(batch, len(ep.input_shapes))
+        from ..ops.registry import _profiler_running
+        profiling = _profiler_running()
+        t0 = _now_us()
+        try:
+            if profiling:
+                from .. import profiler
+                outs, bucket = profiler._dispatch_profiled(
+                    f"serving[{ep.name}]b{rows}",
+                    lambda: ep.run_batch(host_inputs, rows), cat="serving")
+            else:
+                outs, bucket = ep.run_batch(host_inputs, rows)
+        except Exception as e:  # compile/runtime failure fails the whole batch
+            for r in batch:
+                fail(r.future, e)
+            return
+        step_us = _now_us() - t0
+        ep.stats.record_step(step_us)
+        off = 0
+        done = _now_us()
+        for r in batch:
+            sliced = tuple(
+                NDArray(o[off] if r.squeeze else o[off:off + r.rows], ctx=ep.ctx)
+                for o in outs)
+            resolve(r.future, sliced[0] if ep.num_outputs == 1 else sliced)
+            ep.stats.record_latency(done - r.enqueue_us)
+            ep.stats.bump("completed")
+            if profiling:
+                from .. import profiler
+                profiler.record_duration(f"serving[{ep.name}].request",
+                                         r.enqueue_us, done - r.enqueue_us,
+                                         cat="serving")
+            off += r.rows

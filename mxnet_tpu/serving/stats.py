@@ -1,0 +1,151 @@
+"""Per-endpoint serving observability.
+
+Same sink discipline as profiler/monitor (the instrumentation never blocks the
+dispatch path): counters and histogram bumps are O(1) under a short lock, and
+nothing synchronises a device value. Latency lands in log-spaced histograms
+(~9% bin resolution, 1 us .. ~17 min) so p50/p95/p99 are readable without
+retaining per-request samples; ``snapshot()`` renders the whole endpoint state
+as one plain dict — the ``serving.stats()`` surface.
+"""
+from __future__ import annotations
+
+import math
+import sys
+import threading
+from typing import Dict
+
+__all__ = ["LatencyHistogram", "EndpointStats"]
+
+# 24 bins per decade-of-e... concretely: geometric bins with ratio 2**(1/8)
+# (~9% wide), starting at 1 us. 240 bins tops out around 1e9 us (~17 min).
+_RATIO = 2.0 ** 0.125
+_NBINS = 240
+
+
+class LatencyHistogram:
+    """Log-spaced duration histogram with quantile estimation."""
+
+    __slots__ = ("counts", "n", "total_us", "min_us", "max_us")
+
+    def __init__(self):
+        self.counts = [0] * _NBINS
+        self.n = 0
+        self.total_us = 0.0
+        self.min_us = float("inf")
+        self.max_us = 0.0
+
+    def record(self, dur_us: float):
+        d = max(float(dur_us), 0.0)
+        self.n += 1
+        self.total_us += d
+        self.min_us = min(self.min_us, d)
+        self.max_us = max(self.max_us, d)
+        idx = 0 if d < 1.0 else min(int(math.log(d) / math.log(_RATIO)),
+                                    _NBINS - 1)
+        self.counts[idx] += 1
+
+    def percentile(self, p: float) -> float:
+        """p in [0, 100] -> approximate duration in us (geometric bin midpoint),
+        0.0 when empty."""
+        if self.n == 0:
+            return 0.0
+        rank = max(1, int(round(p / 100.0 * self.n)))
+        seen = 0
+        for idx, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                lo = _RATIO ** idx
+                return lo * (_RATIO ** 0.5)
+        return self.max_us
+
+    def snapshot(self) -> Dict[str, float]:
+        if self.n == 0:
+            return {"count": 0, "mean_us": 0.0, "p50_us": 0.0, "p95_us": 0.0,
+                    "p99_us": 0.0, "min_us": 0.0, "max_us": 0.0}
+        return {
+            "count": self.n,
+            "mean_us": self.total_us / self.n,
+            "p50_us": self.percentile(50),
+            "p95_us": self.percentile(95),
+            "p99_us": self.percentile(99),
+            "min_us": self.min_us,
+            "max_us": self.max_us,
+        }
+
+
+class EndpointStats:
+    """All counters/gauges/histograms for one ModelEndpoint.
+
+    Counters
+    --------
+    submitted / completed / rejected / deadline_drops / cancelled — request
+    lifecycle; ``rejected`` counts admission-control overload rejections,
+    ``deadline_drops`` requests dropped at batch assembly because their
+    deadline had already expired (no device step spent on them).
+    batches / real_rows / padded_rows — device-step accounting; occupancy is
+    real/(real+padded).
+    compiles / cache_hits — shape-bucket executable cache behaviour: compiles
+    should equal the number of warmed buckets and stay flat under traffic.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "rejected": 0,
+            "deadline_drops": 0, "cancelled": 0, "batches": 0,
+            "real_rows": 0, "padded_rows": 0, "compiles": 0, "cache_hits": 0,
+        }
+        self.queue_depth = 0          # rows currently admitted and waiting
+        self.queue_peak = 0
+        self.latency = LatencyHistogram()     # submit -> result ready
+        self.step = LatencyHistogram()        # device step (pad+run+slice)
+        self.compile_us = 0.0                 # total time in bucket compiles
+        self._qd_counter = None               # lazy profiler.Counter
+
+    # -- O(1) bumps on the dispatch path ------------------------------------
+    def bump(self, counter: str, delta: int = 1):
+        with self._lock:
+            self.counters[counter] += delta
+
+    def set_queue_depth(self, rows: int):
+        with self._lock:
+            self.queue_depth = rows
+            self.queue_peak = max(self.queue_peak, rows)
+        # mirror the gauge into the profiler's chrome trace as a counter
+        # track (only when a session is running; lazy so the profiler module
+        # never loads on the serving path otherwise)
+        prof = sys.modules.get("mxnet_tpu.profiler")
+        if prof is not None and prof._STATE["running"]:
+            if self._qd_counter is None:
+                self._qd_counter = prof.Counter(
+                    f"serving[{self.name}].queue_depth")
+            self._qd_counter.set_value(rows)
+
+    def record_latency(self, dur_us: float):
+        with self._lock:
+            self.latency.record(dur_us)
+
+    def record_step(self, dur_us: float):
+        with self._lock:
+            self.step.record(dur_us)
+
+    def record_compile(self, dur_us: float):
+        with self._lock:
+            self.counters["compiles"] += 1
+            self.compile_us += dur_us
+
+    # -----------------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            c = dict(self.counters)
+            occ_den = c["real_rows"] + c["padded_rows"]
+            return {
+                "counters": c,
+                "queue_depth": self.queue_depth,
+                "queue_peak": self.queue_peak,
+                "batch_occupancy": (c["real_rows"] / occ_den) if occ_den else 0.0,
+                "latency": self.latency.snapshot(),
+                "step": self.step.snapshot(),
+                "compile_ms_total": self.compile_us / 1e3,
+            }

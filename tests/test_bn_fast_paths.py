@@ -95,6 +95,55 @@ def test_bf16_fast_matches_f32_reference(training):
             f"max|delta|/scale={onp.max(onp.abs(g - r)) / scale:.4f}")
 
 
+def test_default_f32_survives_onepass_cancellation_case():
+    """ADVICE r5 medium regression: mean~300/std~0.01 f32 input makes the
+    one-pass E[x^2]-mu^2 form cancel catastrophically (var clamps to 0, output
+    mis-scaled by ~10x with no warning). The DEFAULT config ('auto') must use
+    the two-pass form for f32 and stay accurate; forcing one-pass must still
+    reproduce the failure (i.e. the test discriminates the two forms)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import _bn_onepass_enabled
+    from mxnet_tpu.ops.registry import get_op
+
+    rng = onp.random.RandomState(3)
+    x = (300.0 + 0.01 * rng.randn(4, 8, 16, 16)).astype("float32")
+    g = onp.ones(8, "float32")
+    b = onp.zeros(8, "float32")
+    mm = onp.zeros(8, "float32")
+    mv = onp.ones(8, "float32")
+    x64 = x.astype("float64")
+    mu = x64.mean(axis=(0, 2, 3), keepdims=True)
+    var = ((x64 - mu) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+    ref = (x64 - mu) / onp.sqrt(var + 1e-5)
+
+    fn = get_op("BatchNorm").fn
+
+    def run():
+        out, _, _ = fn(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+                       jnp.asarray(mm), jnp.asarray(mv),
+                       fix_gamma=False, training=True)
+        return onp.abs(onp.asarray(out, "float64") - ref).max()
+
+    # defaults: 'auto' resolves to two-pass for f32, one-pass only sub-f32
+    assert not _bn_onepass_enabled(jnp.float32)
+    assert not _bn_onepass_enabled(jnp.float64)
+    assert _bn_onepass_enabled(jnp.bfloat16)
+    assert _bn_onepass_enabled(jnp.float16)
+    err_default = run()
+    # residual ~0.02 is f32 input-representation noise (ulp(300)/0.01), far
+    # from the ~10x mis-scaling of the clamped one-pass form
+    assert err_default < 0.5, err_default
+
+    prev = mx.config.get("MXNET_BN_ONEPASS")
+    try:
+        mx.config.set("MXNET_BN_ONEPASS", True)
+        err_onepass = run()
+    finally:
+        mx.config.set("MXNET_BN_ONEPASS", prev)
+    assert err_onepass > 1.0, \
+        f"cancellation case no longer discriminates ({err_onepass})"
+
+
 def test_bf16_fast_training_converges():
     """End-to-end guard: a small conv+BN net in bf16 compute with the fast
     path ON must fit a separable problem (loss must fall by >5x), so the

@@ -203,3 +203,43 @@ def test_eager_tail_ops_match_raw_jax():
             f"{name}: nd op {t_ours:.0f} us vs raw jax.jit {t_raw:.0f} us — "
             "framework dispatch is adding real overhead beyond the runtime's "
             "own synchronous execution")
+
+
+def test_jit_cache_is_bounded_lru():
+    """ADVICE r5: per-iteration-varying static attrs (slice bounds etc.) must
+    not grow the per-(op, attrs) jit cache without bound — the cache is an
+    LRU bounded by MXNET_JIT_CACHE_SIZE, and eviction keeps ops correct
+    (recompile on next use)."""
+    import numpy as onp
+
+    prev_cap = mx.config.get("MXNET_JIT_CACHE_SIZE")
+    saved = dict(reg._JIT_CACHE)
+    try:
+        mx.config.set("MXNET_JIT_CACHE_SIZE", 4)
+        reg._JIT_CACHE.clear()
+        a = mx.nd.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+        # 8 distinct (begin, end) attr combinations through one jitted op
+        for begin in range(4):
+            for end in (begin + 1, min(begin + 2, 4)):
+                out = mx.nd.slice_axis(a, axis=2, begin=begin, end=end)
+                assert out.shape == (2, 3, end - begin)
+        assert len(reg._JIT_CACHE) <= 4, len(reg._JIT_CACHE)
+        # an evicted combination still computes correctly (recompiles)
+        out = mx.nd.slice_axis(a, axis=2, begin=0, end=1)
+        onp.testing.assert_array_equal(
+            out.asnumpy(), onp.arange(24, dtype="float32").reshape(2, 3, 4)[:, :, :1])
+        assert len(reg._JIT_CACHE) <= 4
+        # LRU, not FIFO: re-touching an entry protects it from eviction
+        reg._JIT_CACHE.clear()
+        mx.nd.slice_axis(a, axis=2, begin=0, end=1)          # entry A
+        for begin in range(1, 4):                             # fill to cap
+            mx.nd.slice_axis(a, axis=2, begin=begin, end=4)
+        mx.nd.slice_axis(a, axis=2, begin=0, end=1)          # touch A (hit)
+        key_a = ("slice_axis", reg._freeze({"axis": 2, "begin": 0, "end": 1}))
+        assert key_a in reg._JIT_CACHE
+        mx.nd.slice_axis(a, axis=2, begin=1, end=2)          # forces eviction
+        assert key_a in reg._JIT_CACHE, "recently-used entry was evicted"
+    finally:
+        mx.config.set("MXNET_JIT_CACHE_SIZE", prev_cap)
+        reg._JIT_CACHE.clear()
+        reg._JIT_CACHE.update(saved)
