@@ -24,6 +24,10 @@ synchronize, so the loadgen measures wall-clock over whole windows):
   SLG_MAX_BATCH=32        endpoint max batch / largest bucket
   SLG_TIMEOUT_MS=5        batcher deadline
   SLG_CALIB=4             int8 calibration batches
+  SLG_TELEMETRY=          when set, write the final telemetry snapshot JSON
+                          here (readable live/after via tools/metrics_dump.py;
+                          combine with MXNET_TELEMETRY_DUMP_PATH for
+                          periodic in-run dumps)
 
 Prints one JSON line per (dtype, concurrency):
   {"dtype":..., "conc":..., "img_s":..., "p50_ms":..., "p99_ms":...,
@@ -168,6 +172,18 @@ def main():
             "compiles": snap["counters"]["compiles"],
         }), flush=True)
         serving.unregister(name)
+
+    # one whole-process telemetry snapshot: serving latency histograms,
+    # executable-cache hit/miss/compile-seconds, queue depth / occupancy,
+    # train-step + dataloader families (zero here), device memory gauges
+    from mxnet_tpu import telemetry
+    tsnap = telemetry.snapshot()
+    print(json.dumps({"telemetry_summary": telemetry.summary_line(),
+                      "metric_families": len(tsnap["metrics"])}), flush=True)
+    dump_path = os.environ.get("SLG_TELEMETRY", "")
+    if dump_path:
+        telemetry.dump(dump_path)
+        print(json.dumps({"telemetry_snapshot": dump_path}), flush=True)
     return 0
 
 

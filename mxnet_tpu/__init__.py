@@ -50,6 +50,7 @@ from . import operator
 from . import monitor
 from .monitor import Monitor
 from . import config
+from . import telemetry
 from . import tensor_inspector
 from .tensor_inspector import TensorInspector
 
@@ -61,6 +62,8 @@ library.initialize()  # atfork discipline + SIGSEGV logger (initialize.cc)
 if config.get("MXNET_PROFILER_AUTOSTART"):
     profiler.set_config(profile_all=True)
     profiler.start()
+# MXNET_TELEMETRY_DUMP_PATH: start the background metrics reporter
+telemetry.reporter._autostart()
 from . import parallel
 from . import serving
 from . import sparse

@@ -164,3 +164,11 @@ register("MXNET_KVSTORE_HEARTBEAT_DIR", "", str,
          "empty disables failure detection.")
 register("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 5, int,
          "Seconds between heartbeat file touches.")
+register("MXNET_TELEMETRY_DUMP_PATH", "", str,
+         "When set, start a background telemetry reporter at import that "
+         "writes the full metrics snapshot to this path every "
+         "MXNET_TELEMETRY_DUMP_INTERVAL seconds (JSON; Prometheus text "
+         "exposition if the path ends in .prom). tools/metrics_dump.py "
+         "reads/watches the file while the run is live.")
+register("MXNET_TELEMETRY_DUMP_INTERVAL", 10.0, float,
+         "Seconds between background telemetry snapshot dumps/log lines.")

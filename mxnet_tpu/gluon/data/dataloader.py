@@ -13,15 +13,41 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as onp
 
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
+from ... import telemetry as _telemetry
 from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+# the "is the chip starving?" series: time the CONSUMER spends blocked in
+# next() waiting for the input pipeline. A healthy prefetched loader keeps
+# p95 near zero; wait times rivaling the train-step latency mean the input
+# pipeline — not the chip — is the bottleneck.
+_WAIT = _telemetry.histogram(
+    "mxtpu_dataloader_wait_us",
+    "Time the training loop blocks waiting for the next batch "
+    "(microseconds).")
+_BATCHES = _telemetry.counter(
+    "mxtpu_dataloader_batches_total", "Batches yielded by DataLoader.")
+
+
+def _timed_iter(it):
+    """Yield from ``it``, recording the consumer-visible wait per batch."""
+    while True:
+        t0 = time.perf_counter_ns()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        _WAIT.observe((time.perf_counter_ns() - t0) // 1000)
+        _BATCHES.inc()
+        yield item
 
 
 def default_batchify_fn(data):
@@ -127,8 +153,9 @@ class DataLoader:
 
     def __iter__(self):
         if self._num_workers > 0:
-            return iter(_Prefetcher(self._make_iter, self._prefetch))
-        return self._make_iter()
+            return _timed_iter(iter(_Prefetcher(self._make_iter,
+                                                self._prefetch)))
+        return _timed_iter(self._make_iter())
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -31,11 +31,43 @@ from typing import Dict, List, Optional
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from .. import telemetry as _telemetry
 from .base import KVStoreBase
 from .gradient_compression import GradientCompression
 from . import collective as _collective  # registers the 'collective' backend
 
 __all__ = ["create", "KVStore", "KVStoreBase"]
+
+# fleet counters for the parameter-sync plane: ops, payload bytes, and what
+# actually crossed hosts (wire) — compression ratio = wire / payload
+_KV_OPS = _telemetry.counter(
+    "mxtpu_kvstore_ops_total",
+    "KVStore operations by kind (push/pull/pushpull/broadcast), per key.",
+    labelnames=("op",))
+_KV_PUSH_BYTES = _telemetry.counter(
+    "mxtpu_kvstore_push_bytes_total",
+    "Aggregated gradient payload bytes entering push/pushpull (pre-wire).")
+_KV_WIRE_BYTES = _telemetry.counter(
+    "mxtpu_kvstore_wire_bytes_total",
+    "Bytes that crossed hosts (packed bytes when gradient compression is "
+    "on, dense bytes otherwise); 0 in single-host runs.")
+_KV_COMP_IN = _telemetry.counter(
+    "mxtpu_kvstore_compress_in_bytes_total",
+    "Uncompressed f32 bytes entering gradient-compression quantize.")
+_KV_COMP_OUT = _telemetry.counter(
+    "mxtpu_kvstore_compress_out_bytes_total",
+    "Packed wire bytes leaving gradient-compression quantize.")
+_KV_COMP_RATIO = _telemetry.gauge(
+    "mxtpu_kvstore_compression_ratio",
+    "Cumulative compress_out/compress_in byte ratio (e.g. 2bit -> 0.0625).")
+
+
+def _count_compression(in_bytes: int, out_bytes: int):
+    _KV_COMP_IN.inc(in_bytes)
+    _KV_COMP_OUT.inc(out_bytes)
+    total_in = _KV_COMP_IN.value
+    if total_in:
+        _KV_COMP_RATIO.set(_KV_COMP_OUT.value / total_in)
 
 
 def _listify(v):
@@ -131,6 +163,7 @@ class KVStore(KVStoreBase):
         Mesh and jitted reducer are built once per store — this runs per key
         per push on the hot path, and a fresh lambda would defeat jit's
         executable cache (retrace every call)."""
+        _KV_WIRE_BYTES.inc(int(getattr(x, "nbytes", 0)))
         import jax
         from jax.experimental import multihost_utils
         from jax.sharding import PartitionSpec as P
@@ -224,6 +257,7 @@ class KVStore(KVStoreBase):
                 # only the packed wire tensor (+1-bit scale) crosses hosts:
                 # 1/16 (2-bit) or 1/32 (1-bit) of the fp32 bytes
                 packed, scale = comp.quantize((key, "wire"), out)
+                _KV_WIRE_BYTES.inc(int(getattr(packed, "nbytes", 0)))
                 packed_all = multihost_utils.process_allgather(packed)
                 scale_all = multihost_utils.process_allgather(scale)
                 out = sum(comp.dequantize(packed_all[w], scale_all[w],
@@ -291,6 +325,9 @@ class KVStore(KVStoreBase):
             local_only = self._async and self._updater is not None
             agg = self._reduce(vlist, key=k, cross_host=not local_only)
             sparse_agg = isinstance(agg, BaseSparseNDArray)
+            _KV_OPS.labels("push").inc()
+            if not sparse_agg:
+                _KV_PUSH_BYTES.inc(int(getattr(agg.data, "nbytes", 0)))
             if self._async_ps_active and self._updater is not None:
                 if sparse_agg:
                     agg = agg.todense()
@@ -317,6 +354,7 @@ class KVStore(KVStoreBase):
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized")
+            _KV_OPS.labels("pull").inc()
             if self._async_ps_active and self._updater is not None:
                 src = NDArray(self._ps_client.pull(k),
                               ctx=self._store[k].context)
@@ -339,6 +377,9 @@ class KVStore(KVStoreBase):
         from ..sparse import BaseSparseNDArray
         for k, vlist, olist in zip(keys, values, outs):
             agg = self._reduce(_listify(vlist), key=k)
+            _KV_OPS.labels("pushpull").inc()
+            if not isinstance(agg, BaseSparseNDArray):
+                _KV_PUSH_BYTES.inc(int(getattr(agg.data, "nbytes", 0)))
             if self._updater is not None and k in self._store:
                 self._updater(_key_int(k), agg, self._store[k])
                 agg = self._store[k]
@@ -346,6 +387,7 @@ class KVStore(KVStoreBase):
                 agg.copyto(o)
 
     def broadcast(self, key, value, out, priority=0):
+        _KV_OPS.labels("broadcast").inc()
         self.init(key, value)
         self.pull(key, out=out, priority=priority)
 

@@ -70,6 +70,11 @@ class GradientCompression:
                       | (b[:, 4] << 4) | (b[:, 5] << 5) | (b[:, 6] << 6)
                       | (b[:, 7] << 7)).astype(jnp.uint8)
         self._residuals[key] = (flat - deq).reshape(g.shape)
+        # byte accounting for the fleet compression-ratio gauge (lazy import:
+        # this module loads before the package's metric families exist)
+        from . import _count_compression
+        _count_compression(int(flat.size) * 4, int(getattr(packed, "nbytes",
+                                                           packed.size)))
         return packed, scale
 
     def dequantize(self, packed, scale, shape, dtype):

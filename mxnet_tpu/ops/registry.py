@@ -34,6 +34,24 @@ _OPS: Dict[str, "Op"] = {}
 _JIT_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
 _JIT_LOCK = threading.Lock()
 
+# jit-cache telemetry (the recompile-storm detector: a healthy steady state
+# is ~all hits; a climbing miss/eviction rate under constant traffic means
+# attr churn is thrashing executables). Children are pre-bound at import so
+# the eager hot path pays one counter bump, no registry lookup.
+from .. import telemetry as _telemetry
+_JIT_HITS = _telemetry.counter(
+    "mxtpu_jit_cache_hits_total",
+    "Eager per-(op, static-attrs) jit cache hits (ops/registry.py).")
+_JIT_MISSES = _telemetry.counter(
+    "mxtpu_jit_cache_misses_total",
+    "Eager jit cache misses (a new jax.jit wrapper was built).")
+_JIT_EVICTIONS = _telemetry.counter(
+    "mxtpu_jit_cache_evictions_total",
+    "Eager jit cache LRU evictions (MXNET_JIT_CACHE_SIZE exceeded).")
+_JIT_SIZE = _telemetry.gauge(
+    "mxtpu_jit_cache_size",
+    "Current entry count of the eager jit LRU cache.")
+
 
 def _jit_cache_capacity() -> int:
     from .. import config
@@ -116,8 +134,10 @@ def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
         fn = _JIT_CACHE.get(key)
         if fn is not None:
             _JIT_CACHE.move_to_end(key)
+            _JIT_HITS.inc()
             return fn
     import jax
+    evicted = 0
     with _JIT_LOCK:
         fn = _JIT_CACHE.get(key)
         if fn is None:
@@ -127,8 +147,14 @@ def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
             cap = _jit_cache_capacity()
             while len(_JIT_CACHE) > cap:
                 _JIT_CACHE.popitem(last=False)
+                evicted += 1
+            _JIT_MISSES.inc()
+            _JIT_SIZE.set(len(_JIT_CACHE))
         else:
             _JIT_CACHE.move_to_end(key)
+            _JIT_HITS.inc()
+    if evicted:
+        _JIT_EVICTIONS.inc(evicted)
     return fn
 
 

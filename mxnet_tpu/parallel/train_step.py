@@ -20,12 +20,38 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..base import Context, MXNetError
 from ..ndarray.ndarray import NDArray
+from .. import telemetry as _telemetry
 from .mesh import DeviceMesh
 
 __all__ = ["ParallelTrainStep", "pure_apply"]
 
+# fleet training counters: is the chip stepping, how fast, and is the
+# autoformat/donation machinery churning state placements
+_STEPS = _telemetry.counter(
+    "mxtpu_train_steps_total",
+    "Optimizer steps executed (step_n counts its inner steps).")
+_EXAMPLES = _telemetry.counter(
+    "mxtpu_train_examples_total",
+    "Training examples consumed (leading batch dim); rate = examples/s.")
+_STEP_LATENCY = _telemetry.histogram(
+    "mxtpu_train_step_latency_us",
+    "Host-observed latency of one step()/step_n() dispatch (microseconds).")
+_DONATED_REPLACE = _telemetry.counter(
+    "mxtpu_train_donated_replace_total",
+    "Times the autoformat path re-placed carried (donated) state into a "
+    "different executable's layouts — the OOM-retryable transition; steady "
+    "growth means step()/step_n() shape churn is thrashing layouts.")
+
 
 from ..gluon.block import pure_apply, _trace_nd as _mk_nd  # shared primitive
+
+
+def _leading_dim(x, axis=0):
+    shape = getattr(x, "shape", None)
+    try:
+        return int(shape[axis]) if shape is not None and len(shape) > axis else 0
+    except TypeError:
+        return 0
 
 
 class ParallelTrainStep:
@@ -337,6 +363,7 @@ class ParallelTrainStep:
                 # trainer still holds the original un-donated state and can
                 # retry (ADVICE r5: persisting before the call left
                 # self._params pointing at deleted donated buffers)
+                _DONATED_REPLACE.inc()
                 informats = comp.input_formats[0]
                 placed = tuple(
                     jax.tree_util.tree_map(jax.device_put, args[i],
@@ -360,11 +387,18 @@ class ParallelTrainStep:
     def step(self, x, y, *extras):
         """Run one fused training step; returns the (scalar) loss NDArray."""
         from ..ops.registry import _profiler_running
-        if _profiler_running():
-            from .. import profiler
-            return profiler._dispatch_profiled(
-                "ParallelTrainStep", lambda: self._step_impl(x, y, *extras))
-        return self._step_impl(x, y, *extras)
+        examples = _leading_dim(x)
+        with _telemetry.span("train.step", examples=examples) as sp:
+            if _profiler_running():
+                from .. import profiler
+                out = profiler._dispatch_profiled(
+                    "ParallelTrainStep", lambda: self._step_impl(x, y, *extras))
+            else:
+                out = self._step_impl(x, y, *extras)
+        _STEPS.inc()
+        _EXAMPLES.inc(examples)
+        _STEP_LATENCY.observe(sp.dur_us)
+        return out
 
     def _step_impl(self, x, y, *extras):
         import jax
@@ -421,12 +455,21 @@ class ParallelTrainStep:
         (Dropout) consume split subkeys of one key instead of K session keys,
         so the random streams differ (both are valid dropout masks)."""
         from ..ops.registry import _profiler_running
-        if _profiler_running():
-            from .. import profiler
-            return profiler._dispatch_profiled(
-                "ParallelTrainStep.step_n",
-                lambda: self._step_n_impl(xs, ys, *extras_s))
-        return self._step_n_impl(xs, ys, *extras_s)
+        k = _leading_dim(xs)
+        examples = _leading_dim(xs, axis=1) * k if k else 0
+        with _telemetry.span("train.step_n", steps=k,
+                             examples=examples) as sp:
+            if _profiler_running():
+                from .. import profiler
+                out = profiler._dispatch_profiled(
+                    "ParallelTrainStep.step_n",
+                    lambda: self._step_n_impl(xs, ys, *extras_s))
+            else:
+                out = self._step_n_impl(xs, ys, *extras_s)
+        _STEPS.inc(k)
+        _EXAMPLES.inc(examples)
+        _STEP_LATENCY.observe(sp.dur_us)
+        return out
 
     def _step_n_impl(self, xs, ys, *extras_s):
         import jax

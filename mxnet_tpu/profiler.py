@@ -14,12 +14,14 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 _STATE = {
-    "config": {"profile_all": False, "filename": "profile.json", "aggregate_stats": False},
+    "config": {"profile_all": False, "filename": "profile.json",
+               "aggregate_stats": False, "continuous_dump": False},
     "running": False,
     "events": [],          # chrome trace events from framework scopes
     "agg": {},             # name -> [count, total_us, min_us, max_us]
     "jax_dir": None,
     "lock": threading.Lock(),
+    "continuous_path": None,   # open incremental-dump target (continuous_dump)
 }
 
 
@@ -27,7 +29,10 @@ def set_config(profile_all=False, filename="profile.json", aggregate_stats=False
                profile_symbolic=True, profile_imperative=True, profile_memory=True,
                profile_api=True, continuous_dump=False, **kwargs):
     _STATE["config"].update(profile_all=profile_all, filename=filename,
-                            aggregate_stats=aggregate_stats)
+                            aggregate_stats=aggregate_stats,
+                            continuous_dump=continuous_dump)
+    if not continuous_dump:
+        _STATE["continuous_path"] = None
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -69,22 +74,61 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write chrome://tracing JSON (profiler.cc:184 'traceEvents' format)."""
+    """Write chrome://tracing JSON (profiler.cc:184 'traceEvents' format).
+
+    With ``set_config(continuous_dump=True)`` the dump is *incremental*:
+    events accumulated since the previous dump are appended to the file (the
+    chrome JSON Array Format — a ``[``-opened event list that tracing UIs
+    accept without a closing bracket) and cleared from memory, so long runs
+    can dump periodically without unbounded event growth. ``finished=True``
+    closes the array, making the file strict JSON; the next dump then starts
+    the file over."""
+    cfg = _STATE["config"]
+    path = cfg["filename"]
+    if not cfg.get("continuous_dump"):
+        with _STATE["lock"]:
+            trace = {"traceEvents": list(_STATE["events"]),
+                     "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return
     with _STATE["lock"]:
-        trace = {"traceEvents": list(_STATE["events"]),
-                 "displayTimeUnit": "ms"}
-    with open(_STATE["config"]["filename"], "w") as f:
-        json.dump(trace, f)
+        events = list(_STATE["events"])
+        _STATE["events"].clear()
+        fresh = _STATE["continuous_path"] != path
+        if fresh:
+            _STATE["continuous_path"] = path
+        if finished:
+            _STATE["continuous_path"] = None
+    mode = "w" if fresh else "a"
+    with open(path, mode) as f:
+        if fresh:
+            f.write("[\n")
+        for ev in events:
+            f.write(json.dumps(ev) + ",\n")
+        if finished:
+            f.write("{}]\n")   # sentinel closes the trailing comma -> strict JSON
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False) -> str:
-    """Aggregate per-scope stats table (aggregate_stats.cc analog)."""
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate per-scope stats (aggregate_stats.cc analog).
+
+    ``format="table"`` (default) returns the fixed-width text table;
+    ``format="json"`` returns the same aggregate as a JSON object string:
+    ``{name: {count, total_us, min_us, max_us, avg_us}}`` (the
+    machine-readable face tools/parse_log.py-style consumers want)."""
+    if format not in ("table", "json"):
+        raise ValueError(f"format must be 'table' or 'json', got {format!r}")
     with _STATE["lock"]:
         rows = [(name, c, tot, mn, mx, tot / max(c, 1))
                 for name, (c, tot, mn, mx) in _STATE["agg"].items()]
         if reset:
             _STATE["agg"].clear()
     rows.sort(key=lambda r: r[2], reverse=not ascending)
+    if format == "json":
+        return json.dumps({name: {"count": c, "total_us": tot, "min_us": mn,
+                                  "max_us": mx, "avg_us": avg}
+                           for name, c, tot, mn, mx, avg in rows})
     lines = [f"{'Name':<48}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
              f"{'Max(us)':>12}{'Avg(us)':>12}"]
     for name, c, tot, mn, mx, avg in rows:
@@ -92,11 +136,13 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False) -> str:
     return "\n".join(lines)
 
 
-def _record(name, cat, t0_us, dur_us):
+def _record(name, cat, t0_us, dur_us, args=None):
+    ev = {"name": name, "cat": cat, "ph": "X", "ts": t0_us, "dur": dur_us,
+          "pid": 0, "tid": threading.get_ident() % 100000}
+    if args:
+        ev["args"] = args
     with _STATE["lock"]:
-        _STATE["events"].append({"name": name, "cat": cat, "ph": "X",
-                                 "ts": t0_us, "dur": dur_us, "pid": 0, "tid":
-                                 threading.get_ident() % 100000})
+        _STATE["events"].append(ev)
         agg = _STATE["agg"].setdefault(name, [0, 0.0, float("inf"), 0.0])
         agg[0] += 1
         agg[1] += dur_us
@@ -159,23 +205,35 @@ Event = Task
 
 
 class Counter:
+    """Chrome-trace counter track. increment/decrement are atomic: the
+    read-modify-write of ``value`` AND its event emission happen under one
+    ``_STATE["lock"]`` acquisition, so concurrent bumps can neither lose
+    updates nor emit out-of-order counter samples (pre-r7 the RMW ran
+    outside the lock and concurrent increments dropped counts)."""
+
     def __init__(self, name, domain=None, value=0):
         self.name = name
         self.value = value
 
-    def set_value(self, value):
+    def _set_and_emit_locked(self, value):
+        # caller holds _STATE["lock"]
         self.value = value
         if _STATE["running"]:
-            with _STATE["lock"]:
-                _STATE["events"].append({"name": self.name, "ph": "C",
-                                         "ts": time.perf_counter_ns() // 1000,
-                                         "pid": 0, "args": {"value": value}})
+            _STATE["events"].append({"name": self.name, "ph": "C",
+                                     "ts": time.perf_counter_ns() // 1000,
+                                     "pid": 0, "args": {"value": value}})
+
+    def set_value(self, value):
+        with _STATE["lock"]:
+            self._set_and_emit_locked(value)
 
     def increment(self, delta=1):
-        self.set_value(self.value + delta)
+        with _STATE["lock"]:
+            self._set_and_emit_locked(self.value + delta)
 
     def decrement(self, delta=1):
-        self.set_value(self.value - delta)
+        with _STATE["lock"]:
+            self._set_and_emit_locked(self.value - delta)
 
 
 class Marker:

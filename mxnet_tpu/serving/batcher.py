@@ -50,13 +50,17 @@ def _now_us() -> int:
 
 class Request:
     """One admitted inference request: host-side input rows plus a Future the
-    dispatch loop resolves with sliced outputs (or an error)."""
+    dispatch loop resolves with sliced outputs (or an error). ``trace_id``
+    is stamped at submit (inheriting the submitter's telemetry span, if any)
+    and adopted by the worker thread around batch assembly and the device
+    step — one trace id follows the request across the queue hop."""
 
     __slots__ = ("inputs", "rows", "squeeze", "enqueue_us", "deadline_us",
-                 "future")
+                 "future", "trace_id")
 
     def __init__(self, inputs: Tuple[onp.ndarray, ...], rows: int,
                  squeeze: bool, deadline_ms: Optional[float] = None):
+        from .. import telemetry
         self.inputs = inputs
         self.rows = rows
         self.squeeze = squeeze            # single example: drop the batch axis
@@ -64,6 +68,8 @@ class Request:
         self.deadline_us = (self.enqueue_us + int(deadline_ms * 1000)
                             if deadline_ms is not None else None)
         self.future: Future = Future()
+        self.trace_id = (telemetry.current_trace_id()
+                         or telemetry.new_trace_id())
 
     def expired(self, now_us: int) -> bool:
         return self.deadline_us is not None and now_us > self.deadline_us

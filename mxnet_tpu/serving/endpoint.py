@@ -185,14 +185,18 @@ class ModelEndpoint:
                 self.stats.bump("cache_hits")
                 return comp
             import jax
+            from .. import telemetry
             t0 = _now_us()
-            param_sds = tuple(
-                jax.ShapeDtypeStruct(tuple(p.shape), p.data(self.ctx).data.dtype)
-                for p in self._params)
-            in_sds = tuple(
-                jax.ShapeDtypeStruct((bucket,) + s, dt)
-                for s, dt in zip(self.input_shapes, self._jnp_dtypes))
-            comp = self._infer_fn().lower(param_sds, *in_sds).compile()
+            with telemetry.span("serving.compile", endpoint=self.name,
+                                bucket=bucket):
+                param_sds = tuple(
+                    jax.ShapeDtypeStruct(tuple(p.shape),
+                                         p.data(self.ctx).data.dtype)
+                    for p in self._params)
+                in_sds = tuple(
+                    jax.ShapeDtypeStruct((bucket,) + s, dt)
+                    for s, dt in zip(self.input_shapes, self._jnp_dtypes))
+                comp = self._infer_fn().lower(param_sds, *in_sds).compile()
             self._execs[bucket] = comp
             self.stats.record_compile(_now_us() - t0)
             return comp
@@ -223,13 +227,18 @@ class ModelEndpoint:
         Returns (outputs, bucket): outputs is a tuple of device arrays with
         ``bucket`` rows each; callers slice [0:rows] back out per request."""
         import jax
+        from .. import telemetry
         bucket = bucketing.bucket_for(rows, self.buckets)
         padded = tuple(bucketing.pad_rows(a, bucket) for a in host_inputs)
         dev = self.ctx.jax_device()
         ins = tuple(jax.device_put(a, dev) for a in padded)
         comp = self._get_executable(bucket)
-        outs = comp(self._param_datas(), *ins)
-        jax.block_until_ready(outs)
+        # child of the caller's serving.batch span (same thread): the trace
+        # id stamped at submit reaches the compiled device step
+        with telemetry.span("serving.device_step", endpoint=self.name,
+                            bucket=bucket, rows=rows):
+            outs = comp(self._param_datas(), *ins)
+            jax.block_until_ready(outs)
         self.stats.bump("batches")
         self.stats.bump("real_rows", rows)
         self.stats.bump("padded_rows", bucket - rows)

@@ -232,6 +232,7 @@ class InferenceServer:
             self._cond.wait(timeout=timeout)
 
     def _dispatch(self, q: EndpointQueue, batch):
+        from .. import telemetry
         ep = q.endpoint
         rows = sum(r.rows for r in batch)
         host_inputs = concat_inputs(batch, len(ep.input_shapes))
@@ -239,13 +240,20 @@ class InferenceServer:
         profiling = _profiler_running()
         t0 = _now_us()
         try:
-            if profiling:
-                from .. import profiler
-                outs, bucket = profiler._dispatch_profiled(
-                    f"serving[{ep.name}]b{rows}",
-                    lambda: ep.run_batch(host_inputs, rows), cat="serving")
-            else:
-                outs, bucket = ep.run_batch(host_inputs, rows)
+            # adopt the oldest request's trace id for the whole batch step:
+            # its end-to-end trace (submit -> batch -> device) is the one
+            # closest to the latency budget, and the span records how many
+            # requests/rows rode along
+            with telemetry.span("serving.batch", trace_id=batch[0].trace_id,
+                                endpoint=ep.name, rows=rows,
+                                requests=len(batch)):
+                if profiling:
+                    from .. import profiler
+                    outs, bucket = profiler._dispatch_profiled(
+                        f"serving[{ep.name}]b{rows}",
+                        lambda: ep.run_batch(host_inputs, rows), cat="serving")
+                else:
+                    outs, bucket = ep.run_batch(host_inputs, rows)
         except Exception as e:  # compile/runtime failure fails the whole batch
             for r in batch:
                 fail(r.future, e)

@@ -6,6 +6,14 @@ nothing synchronises a device value. Latency lands in log-spaced histograms
 (~9% bin resolution, 1 us .. ~17 min) so p50/p95/p99 are readable without
 retaining per-request samples; ``snapshot()`` renders the whole endpoint state
 as one plain dict — the ``serving.stats()`` surface.
+
+r7: re-based onto the process-wide ``mxnet_tpu.telemetry`` registry. Every
+bump lands in the shared ``mxtpu_serving_*`` families (labeled by endpoint)
+— the Prometheus/JSON export surface — while the fine-resolution local
+histograms keep serving ``serving.stats()`` its exact legacy shape. The
+executable-cache counters double as the recompile-storm detector:
+``mxtpu_serving_compile_seconds_total`` climbing after warmup means traffic
+is recompiling.
 """
 from __future__ import annotations
 
@@ -14,7 +22,59 @@ import sys
 import threading
 from typing import Dict
 
+from .. import telemetry as _telemetry
+
 __all__ = ["LatencyHistogram", "EndpointStats"]
+
+# shared-registry families (one per metric, children per endpoint label)
+_REQUESTS = _telemetry.counter(
+    "mxtpu_serving_requests_total",
+    "Serving request lifecycle events by endpoint and event "
+    "(submitted/completed/rejected/deadline_dropped/cancelled).",
+    labelnames=("endpoint", "event"))
+_BATCHES = _telemetry.counter(
+    "mxtpu_serving_batches_total", "Device batch steps executed.",
+    labelnames=("endpoint",))
+_ROWS = _telemetry.counter(
+    "mxtpu_serving_batch_rows_total",
+    "Batch rows by kind: real (request rows) vs padded (bucket fill); "
+    "occupancy = real / (real + padded).",
+    labelnames=("endpoint", "kind"))
+_QUEUE_DEPTH = _telemetry.gauge(
+    "mxtpu_serving_queue_depth",
+    "Rows currently admitted and waiting, per endpoint.",
+    labelnames=("endpoint",))
+_QUEUE_PEAK = _telemetry.gauge(
+    "mxtpu_serving_queue_peak", "High-water mark of the admitted-row queue.",
+    labelnames=("endpoint",))
+_OCCUPANCY = _telemetry.gauge(
+    "mxtpu_serving_batch_occupancy",
+    "Cumulative real/(real+padded) row ratio per endpoint (0..1).",
+    labelnames=("endpoint",))
+_LATENCY = _telemetry.histogram(
+    "mxtpu_serving_request_latency_us",
+    "End-to-end request latency: submit -> result ready (microseconds).",
+    labelnames=("endpoint",))
+_STEP = _telemetry.histogram(
+    "mxtpu_serving_step_latency_us",
+    "Device step latency: pad + run + slice (microseconds).",
+    labelnames=("endpoint",))
+_CACHE_HITS = _telemetry.counter(
+    "mxtpu_serving_cache_hits_total",
+    "Shape-bucket executable cache hits.", labelnames=("endpoint",))
+_CACHE_MISSES = _telemetry.counter(
+    "mxtpu_serving_cache_misses_total",
+    "Shape-bucket executable cache misses (each one is a compile).",
+    labelnames=("endpoint",))
+_COMPILE_SECONDS = _telemetry.counter(
+    "mxtpu_serving_compile_seconds_total",
+    "Cumulative wall seconds spent compiling bucket executables; growth "
+    "after warmup is a recompile storm.", labelnames=("endpoint",))
+
+# EndpointStats counter key -> (family, extra label values before/after)
+_EVENT_NAMES = {"submitted": "submitted", "completed": "completed",
+                "rejected": "rejected", "deadline_drops": "deadline_dropped",
+                "cancelled": "cancelled"}
 
 # 24 bins per decade-of-e... concretely: geometric bins with ratio 2**(1/8)
 # (~9% wide), starting at 1 us. 240 bins tops out around 1e9 us (~17 min).
@@ -102,16 +162,47 @@ class EndpointStats:
         self.step = LatencyHistogram()        # device step (pad+run+slice)
         self.compile_us = 0.0                 # total time in bucket compiles
         self._qd_counter = None               # lazy profiler.Counter
+        # pre-bound shared-registry children (one bump, no lookup, hot path)
+        self._m_events = {k: _REQUESTS.labels(name, v)
+                          for k, v in _EVENT_NAMES.items()}
+        self._m_batches = _BATCHES.labels(name)
+        self._m_rows = {"real_rows": _ROWS.labels(name, "real"),
+                        "padded_rows": _ROWS.labels(name, "padded")}
+        self._m_qdepth = _QUEUE_DEPTH.labels(name)
+        self._m_qpeak = _QUEUE_PEAK.labels(name)
+        self._m_occupancy = _OCCUPANCY.labels(name)
+        self._m_latency = _LATENCY.labels(name)
+        self._m_step = _STEP.labels(name)
+        self._m_hits = _CACHE_HITS.labels(name)
+        self._m_misses = _CACHE_MISSES.labels(name)
+        self._m_compile_s = _COMPILE_SECONDS.labels(name)
 
     # -- O(1) bumps on the dispatch path ------------------------------------
     def bump(self, counter: str, delta: int = 1):
         with self._lock:
             self.counters[counter] += delta
+            if counter in ("real_rows", "padded_rows"):
+                den = self.counters["real_rows"] + self.counters["padded_rows"]
+                occ = self.counters["real_rows"] / den if den else 0.0
+        ev = self._m_events.get(counter)
+        if ev is not None:
+            ev.inc(delta)
+        elif counter == "batches":
+            self._m_batches.inc(delta)
+        elif counter in ("real_rows", "padded_rows"):
+            if delta:
+                self._m_rows[counter].inc(delta)
+            self._m_occupancy.set(occ)
+        elif counter == "cache_hits":
+            self._m_hits.inc(delta)
 
     def set_queue_depth(self, rows: int):
         with self._lock:
             self.queue_depth = rows
             self.queue_peak = max(self.queue_peak, rows)
+            peak = self.queue_peak
+        self._m_qdepth.set(rows)
+        self._m_qpeak.set(peak)
         # mirror the gauge into the profiler's chrome trace as a counter
         # track (only when a session is running; lazy so the profiler module
         # never loads on the serving path otherwise)
@@ -125,15 +216,19 @@ class EndpointStats:
     def record_latency(self, dur_us: float):
         with self._lock:
             self.latency.record(dur_us)
+        self._m_latency.observe(dur_us)
 
     def record_step(self, dur_us: float):
         with self._lock:
             self.step.record(dur_us)
+        self._m_step.observe(dur_us)
 
     def record_compile(self, dur_us: float):
         with self._lock:
             self.counters["compiles"] += 1
             self.compile_us += dur_us
+        self._m_misses.inc()
+        self._m_compile_s.inc(dur_us / 1e6)
 
     # -----------------------------------------------------------------------
     def snapshot(self) -> Dict:

@@ -1,0 +1,121 @@
+"""Structured span tracing with cross-layer trace-id propagation.
+
+``telemetry.span(name, **attrs)`` opens a nested, timed span:
+
+  - spans nest via a contextvar; a child inherits its parent's ``trace_id``
+    so one logical operation (a serving request, a training step) is one
+    trace across layers, even when the layers are different subsystems.
+  - a span can be *adopted* across threads by passing an explicit
+    ``trace_id=...`` — the serving path stamps each admitted request with
+    the submitter's trace id, and the worker thread re-opens the trace
+    around batch assembly and the compiled device step, so a request's
+    trace id survives the queue hop.
+  - on exit every span feeds BOTH sinks: the profiler's chrome-trace event
+    stream (when a profiler session is running — the span lands in the same
+    ``traceEvents`` timeline as per-op events, with the trace id in
+    ``args`` so XPlane/Perfetto rows correlate with fleet metrics), and the
+    registry's ``mxtpu_span_duration_us{name=...}`` histogram (always on —
+    spans are the latency series dashboards scrape).
+
+Span names are dot-scoped ``layer.operation`` (``serving.batch``,
+``train.step``, ``dataloader.wait`` — see OBSERVABILITY.md for the
+convention); attrs are small JSON-able values, never tensors.
+"""
+from __future__ import annotations
+
+import contextvars
+import random
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .metrics import REGISTRY
+
+__all__ = ["Span", "span", "current_span", "current_trace_id", "new_trace_id"]
+
+_CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "mxtpu_current_span", default=None)
+
+# per-process random source; seeded from urandom, independent of user PRNGs
+_RNG = random.Random()
+_SPAN_DURATION = REGISTRY.histogram(
+    "mxtpu_span_duration_us",
+    "Duration of telemetry spans by span name (microseconds).",
+    labelnames=("name",))
+
+
+def new_trace_id() -> str:
+    return f"{_RNG.getrandbits(64):016x}"
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class Span:
+    """One timed region. Created by :func:`span`; read-only for users."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "t0_us", "dur_us")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 attrs: Dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = f"{_RNG.getrandbits(64):016x}"
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.t0_us = _now_us()
+        self.dur_us = None
+
+    def __repr__(self):
+        return (f"<Span {self.name} trace={self.trace_id} "
+                f"dur={self.dur_us}us attrs={self.attrs}>")
+
+
+@contextmanager
+def span(name: str, trace_id: Optional[str] = None, **attrs):
+    """Open a nested span. ``trace_id`` adopts an existing trace (cross-thread
+    propagation); otherwise the parent's trace is inherited, or a fresh trace
+    is started at the root. Yields the Span (``.trace_id`` is the handle to
+    stamp onto queue items / requests for later adoption)."""
+    parent = _CURRENT.get()
+    if trace_id is None:
+        trace_id = parent.trace_id if parent is not None else new_trace_id()
+    s = Span(name, trace_id, parent.span_id if parent is not None else None,
+             attrs)
+    token = _CURRENT.set(s)
+    try:
+        yield s
+    finally:
+        _CURRENT.reset(token)
+        s.dur_us = _now_us() - s.t0_us
+        _SPAN_DURATION.labels(name).observe(s.dur_us)
+        _emit_profiler(s)
+
+
+def current_span() -> Optional[Span]:
+    return _CURRENT.get()
+
+
+def current_trace_id() -> Optional[str]:
+    s = _CURRENT.get()
+    return s.trace_id if s is not None else None
+
+
+def _emit_profiler(s: Span):
+    """Mirror a finished span into the profiler's chrome trace (only when a
+    session is running; module looked up lazily so telemetry never forces the
+    profiler onto the import path of lightweight processes)."""
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    if prof is None or not prof._STATE["running"]:
+        return
+    args = {"trace_id": s.trace_id, "span_id": s.span_id}
+    if s.parent_id:
+        args["parent_id"] = s.parent_id
+    for k, v in s.attrs.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            args[k] = v
+    prof._record(s.name, "span", s.t0_us, s.dur_us, args=args)

@@ -82,3 +82,125 @@ def test_chrome_trace_dump(tmp_path):
     assert len(trace["traceEvents"]) >= 1
     ev = trace["traceEvents"][0]
     assert {"name", "ph", "ts", "dur"} <= set(ev)
+
+
+# ---------------------------------------------------------------------------
+# r7 satellites: Counter atomicity, dumps(format=), continuous_dump, schema
+# ---------------------------------------------------------------------------
+def test_counter_increment_is_atomic_under_threads():
+    """Regression: the read-modify-write of Counter.value used to run outside
+    _STATE['lock'], so concurrent increments lost counts."""
+    import threading
+    c = profiler.Counter("race_counter")
+    n_threads, n_iter = 8, 5000
+
+    def bump():
+        for _ in range(n_iter):
+            c.increment()
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_iter
+    for t in [threading.Thread(target=lambda: [c.decrement()
+                                               for _ in range(n_iter)])
+              for _ in range(n_threads)]:
+        t.start()
+        t.join()
+    assert c.value == 0
+
+
+def test_counter_events_emitted_under_lock_while_running():
+    profiler.start()
+    c = profiler.Counter("tracked", value=10)
+    c.increment(5)
+    c.decrement(3)
+    profiler.stop()
+    evs = [e for e in profiler._STATE["events"] if e["name"] == "tracked"]
+    assert [e["args"]["value"] for e in evs] == [15, 12]
+    assert all(e["ph"] == "C" for e in evs)
+
+
+def test_dumps_json_format():
+    a = mx.nd.ones((4, 4))
+    profiler.start()
+    mx.nd.dot(a, a).wait_to_read()
+    profiler.stop()
+    out = profiler.dumps(format="json")
+    table = json.loads(out)
+    assert "dot" in table
+    row = table["dot"]
+    assert row["count"] >= 1
+    assert row["total_us"] >= row["min_us"] >= 0
+    assert row["max_us"] >= row["avg_us"] > 0 or row["total_us"] == 0
+    # default stays the text table; bad formats are rejected loudly
+    assert "Name" in profiler.dumps()
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        profiler.dumps(format="csv")
+
+
+def test_continuous_dump_appends_and_clears(tmp_path):
+    fname = str(tmp_path / "cont.json")
+    profiler.set_config(filename=fname, continuous_dump=True)
+    a = mx.nd.ones((8,))
+    profiler.start()
+    (a + a).wait_to_read()
+    profiler.dump(finished=False)
+    assert profiler._STATE["events"] == []     # incremental dump drains memory
+    n_first = len(open(fname).read().strip().splitlines())
+    (a * 2).wait_to_read()
+    (a * 3).wait_to_read()
+    profiler.dump(finished=False)
+    assert profiler._STATE["events"] == []
+    content = open(fname).read()
+    assert len(content.strip().splitlines()) > n_first  # appended, not rewrote
+    profiler.stop()
+    profiler.dump(finished=True)               # closes the array: strict JSON
+    events = json.loads(open(fname).read())
+    assert isinstance(events, list) and len(events) >= 3
+    assert all("name" in e for e in events[:-1])
+    # reset config for other tests (module-global state)
+    profiler.set_config()
+
+
+def test_chrome_trace_schema(tmp_path):
+    """Every emitted event carries the chrome-trace required keys, the file
+    JSON round-trips, and ph:'C' counter samples carry args.value."""
+    fname = str(tmp_path / "schema.json")
+    profiler.set_config(filename=fname)
+    from mxnet_tpu import telemetry
+    a = mx.nd.ones((4, 4))
+    profiler.start()
+    mx.nd.dot(a, a).wait_to_read()
+    with profiler.scope("user_scope"):
+        (a + 1).wait_to_read()
+    with telemetry.span("test.schema_span"):
+        pass
+    c = profiler.Counter("schema_counter")
+    c.increment(7)
+    profiler.Marker("schema_marker").mark()
+    t = profiler.Task("schema_task")
+    t.start()
+    t.stop()
+    profiler.stop()
+    profiler.dump()
+    trace = json.loads(open(fname).read())    # JSON round-trips
+    events = trace["traceEvents"]
+    assert len(events) >= 5
+    phases = set()
+    for ev in events:
+        assert {"name", "ph", "ts", "pid"} <= set(ev), f"bad event {ev}"
+        assert isinstance(ev["ts"], int)
+        phases.add(ev["ph"])
+        if ev["ph"] == "X":
+            assert "dur" in ev and ev["dur"] >= 0
+        if ev["ph"] == "C":
+            assert "args" in ev and "value" in ev["args"], \
+                f"counter event without args.value: {ev}"
+    assert {"X", "C", "i"} <= phases
+    # the telemetry span landed in the same timeline with its trace id
+    span_evs = [e for e in events if e["name"] == "test.schema_span"]
+    assert span_evs and "trace_id" in span_evs[0]["args"]

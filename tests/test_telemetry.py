@@ -1,0 +1,487 @@
+"""mxnet_tpu.telemetry: unified metrics registry + cross-layer tracing.
+
+Covers (ISSUE r7): registry semantics (types, labels, get-or-create, name
+lint), Prometheus text exposition parsing line-by-line, JSON snapshot
+round-trip, span nesting + trace-id propagation (including the serving
+request -> batch assembly -> compiled device step queue hop), instrumentation
+of the jit cache / serving / kvstore / dataloader hot paths, the background
+reporter, tools/metrics_dump.py rendering, and the telemetry-overhead gate on
+eager dispatch.
+"""
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry.metrics import (MetricsRegistry,
+                                         prometheus_from_snapshot)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_test_ops_total", "ops")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(MXNetError):
+        c.inc(-1)                      # counters only go up
+    g = r.gauge("mxtpu_test_depth", "depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5
+    h = r.histogram("mxtpu_test_lat_us", "lat")
+    for v in (1, 10, 100, 1000):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 4 and s["sum"] == 1111
+    assert s["min"] == 1 and s["max"] == 1000
+    assert 0 < s["p50"] <= s["p95"] <= s["p99"] <= 1000
+
+
+def test_labels_and_get_or_create():
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_test_req_total", "reqs", labelnames=("ep", "event"))
+    c.labels("a", "ok").inc()
+    c.labels(ep="a", event="ok").inc()          # kwargs resolve identically
+    c.labels("b", "err").inc(3)
+    assert c.labels("a", "ok").value == 2
+    assert c.labels("b", "err").value == 3
+    # unlabeled use of a labeled family is an error, not a silent series
+    with pytest.raises(MXNetError):
+        c.inc()
+    # get-or-create: same signature returns the same family
+    assert r.counter("mxtpu_test_req_total",
+                     labelnames=("ep", "event")) is c
+    # conflicting re-registration (kind or labels) is rejected
+    with pytest.raises(MXNetError):
+        r.gauge("mxtpu_test_req_total", labelnames=("ep", "event"))
+    with pytest.raises(MXNetError):
+        r.counter("mxtpu_test_req_total", labelnames=("other",))
+
+
+def test_metric_name_lint():
+    r = MetricsRegistry()
+    for bad in ("requests_total", "mxtpu_UPPER", "mxtpu-dash", "mxtpu_",
+                "mxtpu_a b"):
+        if bad == "mxtpu_":
+            continue  # prefix-only is technically invalid too, checked below
+        with pytest.raises(MXNetError):
+            r.counter(bad)
+    with pytest.raises(MXNetError):
+        r.counter("mxtpu_")
+    r.counter("mxtpu_fine_total")
+    assert r.lint_names() == []
+
+
+def test_process_registry_lint_clean_and_unique():
+    """CI gate: every metric registered by the instrumented subsystems obeys
+    ^mxtpu_[a-z0-9_]+$ and is unique (uniqueness is structural: the registry
+    is name-keyed and conflicting re-registration raises)."""
+    # touch every instrumented layer so its families exist
+    import mxnet_tpu.ops.registry           # noqa: F401
+    import mxnet_tpu.serving.stats          # noqa: F401
+    import mxnet_tpu.parallel.train_step    # noqa: F401
+    import mxnet_tpu.kvstore                # noqa: F401
+    import mxnet_tpu.gluon.data.dataloader  # noqa: F401
+    assert telemetry.lint_names() == []
+    names = telemetry.REGISTRY.names()
+    assert len(names) == len(set(names))
+    assert all(re.match(r"^mxtpu_[a-z0-9_]+$", n) for n in names)
+    # the catalog families the dashboards build on are all present
+    for required in ("mxtpu_jit_cache_hits_total",
+                     "mxtpu_serving_request_latency_us",
+                     "mxtpu_serving_compile_seconds_total",
+                     "mxtpu_serving_queue_depth",
+                     "mxtpu_serving_batch_occupancy",
+                     "mxtpu_train_step_latency_us",
+                     "mxtpu_train_examples_total",
+                     "mxtpu_kvstore_wire_bytes_total",
+                     "mxtpu_dataloader_wait_us",
+                     "mxtpu_device_memory_bytes",
+                     "mxtpu_span_duration_us"):
+        assert required in names, f"missing family {required}"
+
+
+def test_counter_bumps_are_thread_safe():
+    r = MetricsRegistry()
+    c = r.counter("mxtpu_test_race_total")
+    h = r.histogram("mxtpu_test_race_us")
+
+    def work():
+        for _ in range(2000):
+            c.inc()
+            h.observe(3.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 16000
+    assert h.summary()["count"] == 16000
+
+
+# ---------------------------------------------------------------------------
+# export formats
+# ---------------------------------------------------------------------------
+_PROM_LINE = re.compile(
+    r"^(?:"
+    r"# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram|untyped)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+(?: [0-9]+)?"
+    r")$")
+
+
+def _assert_prometheus_parses(text):
+    assert text.endswith("\n")
+    seen_types, samples = {}, 0
+    for line in text.splitlines():
+        if not line:
+            continue
+        assert _PROM_LINE.match(line), f"unparseable exposition line: {line!r}"
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split(" ")
+            assert name not in seen_types, f"duplicate TYPE for {name}"
+            seen_types[name] = kind
+        elif not line.startswith("#"):
+            samples += 1
+    assert seen_types and samples
+    return seen_types
+
+
+def test_prometheus_exposition_parses_line_by_line():
+    # acceptance criterion: the live exposition parses (# TYPE/# HELP +
+    # samples) with real serving/jit/span data in it
+    a = mx.nd.ones((4, 4))
+    mx.nd.slice_axis(a, axis=1, begin=0, end=2)
+    with telemetry.span("test.export"):
+        pass
+    text = telemetry.prometheus_text()
+    kinds = _assert_prometheus_parses(text)
+    assert kinds.get("mxtpu_jit_cache_hits_total") == "counter"
+    assert kinds.get("mxtpu_span_duration_us") == "histogram"
+    # histogram buckets are cumulative and end with +Inf == count
+    m = re.findall(r'mxtpu_span_duration_us_bucket\{name="test.export",'
+                   r'le="([^"]+)"\} (\d+)', text)
+    assert m and m[-1][0] == "+Inf"
+    counts = [int(v) for _, v in m]
+    assert counts == sorted(counts)
+    count = re.search(r'mxtpu_span_duration_us_count\{name="test.export"\} '
+                      r'(\d+)', text)
+    assert count and int(count.group(1)) == counts[-1]
+
+
+def test_snapshot_json_roundtrip_and_offline_prom():
+    with telemetry.span("test.snapshot"):
+        pass
+    snap = telemetry.snapshot()
+    rt = json.loads(json.dumps(snap))
+    assert rt["metrics"].keys() == snap["metrics"].keys()
+    fam = rt["metrics"]["mxtpu_span_duration_us"]
+    assert fam["type"] == "histogram" and fam["bucket_bounds"]
+    series = {tuple(sorted(s["labels"].items())): s for s in fam["series"]}
+    s = series[(("name", "test.snapshot"),)]
+    assert s["count"] >= 1 and len(s["bucket_counts"]) == \
+        len(fam["bucket_bounds"]) + 1
+    # a snapshot file round-trips to parseable Prometheus exposition
+    _assert_prometheus_parses(prometheus_from_snapshot(rt))
+
+
+# ---------------------------------------------------------------------------
+# spans + trace propagation
+# ---------------------------------------------------------------------------
+def test_span_nesting_and_trace_inheritance():
+    with telemetry.span("test.root", job="j1") as root:
+        assert telemetry.current_trace_id() == root.trace_id
+        with telemetry.span("test.child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    assert telemetry.current_span() is None
+    assert root.dur_us is not None and root.dur_us >= child.dur_us
+
+
+def test_span_adoption_across_threads():
+    with telemetry.span("test.submit") as s:
+        tid = s.trace_id
+    got = {}
+
+    def worker():
+        with telemetry.span("test.worker", trace_id=tid) as w:
+            got["trace"] = w.trace_id
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert got["trace"] == tid
+
+
+def test_spans_feed_profiler_chrome_trace():
+    from mxnet_tpu import profiler
+    profiler._STATE["events"].clear()
+    profiler._STATE["agg"].clear()
+    profiler._STATE["running"] = True
+    try:
+        with telemetry.span("test.profiled", shard=3) as s:
+            pass
+    finally:
+        profiler._STATE["running"] = False
+    evs = [e for e in profiler._STATE["events"]
+           if e["name"] == "test.profiled"]
+    profiler._STATE["events"].clear()
+    profiler._STATE["agg"].clear()
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["ph"] == "X" and ev["cat"] == "span"
+    assert ev["args"]["trace_id"] == s.trace_id
+    assert ev["args"]["span_id"] == s.span_id
+    assert ev["args"]["shard"] == 3
+
+
+def test_serving_trace_id_survives_queue_hop():
+    """request trace-id at submit == trace-id on the worker's serving.batch
+    and serving.device_step spans (the cross-thread adoption path)."""
+    from mxnet_tpu import profiler, serving
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    ep = serving.ModelEndpoint("t_trace", net, input_shapes=(8,),
+                               max_batch_size=2)
+    srv = serving.InferenceServer(batch_timeout_ms=1.0, max_queue=16)
+    srv.register(ep)
+    srv.start()
+    profiler._STATE["events"].clear()
+    profiler._STATE["running"] = True
+    try:
+        with telemetry.span("test.client") as s:
+            srv.predict("t_trace", onp.ones((8,), "float32"), timeout=60)
+    finally:
+        profiler._STATE["running"] = False
+        srv.stop()
+        serving.unregister("t_trace")
+    by_name = {}
+    for e in profiler._STATE["events"]:
+        by_name.setdefault(e["name"], []).append(e)
+    profiler._STATE["events"].clear()
+    profiler._STATE["agg"].clear()
+    batch = by_name.get("serving.batch", [])
+    step = by_name.get("serving.device_step", [])
+    assert batch and step
+    assert batch[0]["args"]["trace_id"] == s.trace_id
+    assert step[0]["args"]["trace_id"] == s.trace_id
+    assert batch[0]["args"]["endpoint"] == "t_trace"
+
+
+# ---------------------------------------------------------------------------
+# hot-subsystem instrumentation
+# ---------------------------------------------------------------------------
+def test_jit_cache_counters_hits_misses_evictions():
+    from mxnet_tpu.ops import registry as reg
+    hits = telemetry.REGISTRY.get("mxtpu_jit_cache_hits_total")
+    misses = telemetry.REGISTRY.get("mxtpu_jit_cache_misses_total")
+    evict = telemetry.REGISTRY.get("mxtpu_jit_cache_evictions_total")
+    size = telemetry.REGISTRY.get("mxtpu_jit_cache_size")
+    prev_cap = mx.config.get("MXNET_JIT_CACHE_SIZE")
+    saved = dict(reg._JIT_CACHE)
+    a = mx.nd.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    try:
+        mx.config.set("MXNET_JIT_CACHE_SIZE", 2)
+        reg._JIT_CACHE.clear()
+        h0, m0, e0 = hits.value, misses.value, evict.value
+        mx.nd.slice_axis(a, axis=2, begin=0, end=1)       # miss
+        mx.nd.slice_axis(a, axis=2, begin=0, end=1)       # hit
+        assert misses.value == m0 + 1 and hits.value == h0 + 1
+        mx.nd.slice_axis(a, axis=2, begin=1, end=2)       # miss (cache full)
+        mx.nd.slice_axis(a, axis=2, begin=2, end=3)       # miss -> eviction
+        assert evict.value == e0 + 1
+        assert size.value == len(reg._JIT_CACHE) == 2
+    finally:
+        mx.config.set("MXNET_JIT_CACHE_SIZE", prev_cap)
+        reg._JIT_CACHE.clear()
+        reg._JIT_CACHE.update(saved)
+
+
+def test_serving_metrics_reach_shared_registry():
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    ep = serving.ModelEndpoint("t_reg_metrics", net, input_shapes=(8,),
+                               max_batch_size=2)
+    srv = serving.InferenceServer(batch_timeout_ms=1.0, max_queue=16)
+    srv.register(ep)        # warms both buckets -> 2 cache misses/compiles
+    srv.start()
+    try:
+        for _ in range(3):
+            srv.predict("t_reg_metrics", onp.ones((8,), "float32"),
+                        timeout=60)
+    finally:
+        srv.stop()
+        serving.unregister("t_reg_metrics")
+    lab = ("t_reg_metrics",)
+    reqs = telemetry.REGISTRY.get("mxtpu_serving_requests_total")
+    assert reqs.labels("t_reg_metrics", "submitted").value == 3
+    assert reqs.labels("t_reg_metrics", "completed").value == 3
+    misses = telemetry.REGISTRY.get("mxtpu_serving_cache_misses_total")
+    assert misses.labels(*lab).value == len(ep.buckets)
+    compile_s = telemetry.REGISTRY.get("mxtpu_serving_compile_seconds_total")
+    assert compile_s.labels(*lab).value > 0
+    lat = telemetry.REGISTRY.get("mxtpu_serving_request_latency_us")
+    assert lat.labels(*lab).summary()["count"] == 3
+    occ = telemetry.REGISTRY.get("mxtpu_serving_batch_occupancy")
+    assert 0.0 < occ.labels(*lab).value <= 1.0
+    rows = telemetry.REGISTRY.get("mxtpu_serving_batch_rows_total")
+    assert rows.labels("t_reg_metrics", "real").value == 3
+    # registry series agree with the legacy serving-local counters
+    assert ep.stats.counters["compiles"] == misses.labels(*lab).value
+
+
+def test_train_step_metrics():
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import nn, loss as gloss
+    steps = telemetry.REGISTRY.get("mxtpu_train_steps_total")
+    examples = telemetry.REGISTRY.get("mxtpu_train_examples_total")
+    lat = telemetry.REGISTRY.get("mxtpu_train_step_latency_us")
+    s0, x0, n0 = steps.value, examples.value, lat.summary()["count"]
+    net = nn.Dense(1, in_units=8)
+    net.initialize(mx.init.Constant(0.05))
+    mesh = parallel.make_mesh({"dp": 8})
+    step = parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.SGD(learning_rate=0.1), mesh)
+    xs = onp.random.RandomState(0).randn(16, 8).astype("float32")
+    ys = onp.random.RandomState(1).randn(16, 1).astype("float32")
+    for _ in range(2):
+        step(mx.nd.array(xs), mx.nd.array(ys))
+    assert steps.value == s0 + 2
+    assert examples.value == x0 + 32
+    assert lat.summary()["count"] == n0 + 2
+
+
+def test_kvstore_metrics_and_compression_ratio():
+    ops = telemetry.REGISTRY.get("mxtpu_kvstore_ops_total")
+    push_b = telemetry.REGISTRY.get("mxtpu_kvstore_push_bytes_total")
+    ratio = telemetry.REGISTRY.get("mxtpu_kvstore_compression_ratio")
+    p0 = ops.labels("push").value
+    b0 = push_b.value
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.nd.zeros((64, 64)))
+    kv.push("w", mx.nd.ones((64, 64)))
+    out = mx.nd.zeros((64, 64))
+    kv.pull("w", out=out)
+    assert ops.labels("push").value == p0 + 1
+    assert ops.labels("pull").value >= 1
+    assert push_b.value - b0 == 64 * 64 * 4
+    # 2-bit codes: 4 values/byte of f32 input -> cumulative ratio ~1/16
+    assert 0 < ratio.value <= 0.5
+    comp_in = telemetry.REGISTRY.get("mxtpu_kvstore_compress_in_bytes_total")
+    comp_out = telemetry.REGISTRY.get("mxtpu_kvstore_compress_out_bytes_total")
+    assert comp_in.value > 0 and comp_out.value > 0
+    assert comp_out.value / comp_in.value <= 0.07   # ~0.0625 for 2bit
+
+
+def test_dataloader_wait_metrics():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    wait = telemetry.REGISTRY.get("mxtpu_dataloader_wait_us")
+    batches = telemetry.REGISTRY.get("mxtpu_dataloader_batches_total")
+    n0, b0 = wait.summary()["count"], batches.value
+    ds = ArrayDataset(onp.arange(64, dtype="float32").reshape(16, 4))
+    for _ in DataLoader(ds, batch_size=4):
+        pass
+    for _ in DataLoader(ds, batch_size=4, num_workers=2):
+        pass
+    assert batches.value == b0 + 8
+    assert wait.summary()["count"] == n0 + 8
+
+
+# ---------------------------------------------------------------------------
+# reporter + tools
+# ---------------------------------------------------------------------------
+def test_periodic_logger_writes_snapshot(tmp_path):
+    path = str(tmp_path / "telemetry.json")
+    rep = telemetry.periodic_logger(0.05, path=path)
+    try:
+        deadline = time.time() + 5
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        rep.stop()
+    assert os.path.exists(path)
+    snap = json.load(open(path))
+    assert "mxtpu_span_duration_us" in snap["metrics"]
+    # stop() is idempotent-safe for the thread and leaves a final snapshot
+    assert not rep._thread.is_alive()
+
+
+def test_metrics_dump_tool_renders(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_dump
+    finally:
+        sys.path.pop(0)
+    with telemetry.span("test.dumptool"):
+        pass
+    path = str(tmp_path / "snap.json")
+    telemetry.dump(path)
+    snap = metrics_dump.load_snapshot(path)
+    table = metrics_dump.render_table(snap)
+    assert "mxtpu_span_duration_us" in table
+    _assert_prometheus_parses(prometheus_from_snapshot(snap))
+    # the CLI path end-to-end
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert metrics_dump.main([path, "--prom"]) == 0
+    _assert_prometheus_parses(buf.getvalue())
+
+
+def test_telemetry_dump_prometheus_file(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    telemetry.dump(path, prometheus=True)
+    _assert_prometheus_parses(open(path).read())
+
+
+# ---------------------------------------------------------------------------
+# overhead gate (satellite: instrumented eager dispatch within 10% of the
+# test_eager_latency.py baseline gate)
+# ---------------------------------------------------------------------------
+def test_instrumented_eager_dispatch_overhead():
+    """test_eager_latency.py gates p95 eager dispatch at 100 us; with the
+    always-on jit-cache telemetry in the dispatch path the same ops must
+    stay within 10% of that baseline (110 us), measured the same way
+    (best-of-3 windows, warm caches)."""
+    x = mx.nd.array(onp.random.rand(64, 64).astype("float32"))
+    y = mx.nd.array(onp.random.rand(64, 64).astype("float32"))
+    ops = {
+        "exp": lambda: mx.nd.exp(x),
+        "broadcast_add": lambda: mx.nd.broadcast_add(x, y),
+        "slice_axis": lambda: mx.nd.slice_axis(x, axis=1, begin=0, end=32),
+    }
+    for name, f in ops.items():
+        for _ in range(30):
+            f()
+        best_p95 = None
+        for _ in range(3):
+            ts = []
+            for _ in range(400):
+                t0 = time.perf_counter_ns()
+                f()
+                ts.append(time.perf_counter_ns() - t0)
+            ts.sort()
+            p95 = ts[int(len(ts) * 0.95)] / 1e3
+            best_p95 = p95 if best_p95 is None else min(best_p95, p95)
+        assert best_p95 < 110.0, (
+            f"{name}: instrumented eager dispatch p95 {best_p95:.1f} us "
+            "exceeds the 100 us baseline + 10% telemetry budget")

@@ -1,0 +1,117 @@
+"""Print (or watch) a telemetry snapshot as a table, JSON, or Prometheus text.
+
+Pairs with ``mxnet_tpu.telemetry``: a long-running process (training job,
+serving loadgen) exports its registry either by setting
+``MXNET_TELEMETRY_DUMP_PATH=/tmp/mxtpu.json`` (background reporter rewrites
+the file every ``MXNET_TELEMETRY_DUMP_INTERVAL`` seconds) or by calling
+``telemetry.dump(path)`` itself. This tool reads that file from the outside
+— no in-process hook needed — and renders it:
+
+    # one-shot human table of every non-zero series
+    python tools/metrics_dump.py /tmp/mxtpu.json
+
+    # Prometheus text exposition (pipe into a pushgateway / file scrape)
+    python tools/metrics_dump.py /tmp/mxtpu.json --prom
+
+    # raw snapshot JSON (pretty-printed)
+    python tools/metrics_dump.py /tmp/mxtpu.json --json
+
+    # live view of a running loadgen: re-read every 2 s
+    python tools/metrics_dump.py /tmp/mxtpu.json --watch 2
+
+    # include zero-valued series (the full registered catalog)
+    python tools/metrics_dump.py /tmp/mxtpu.json --all
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def render_table(snap, include_zero=False):
+    """Human-readable series table from a snapshot dict."""
+    lines = [f"{'metric':<58}{'type':>10}{'value':>16}"]
+    for name, fam in sorted(snap.get("metrics", {}).items()):
+        for s in fam.get("series", []):
+            key = name + _fmt_labels(s.get("labels"))
+            if fam["type"] == "histogram":
+                n = s.get("count", 0)
+                if not n and not include_zero:
+                    continue
+                lines.append(f"{key:<58}{'histogram':>10}{n:>16}")
+                if n:
+                    lines.append(
+                        f"{'':<58}{'':>10}"
+                        f"  p50={s['p50']:.1f} p95={s['p95']:.1f} "
+                        f"p99={s['p99']:.1f} mean={s['mean']:.1f} "
+                        f"max={s['max']:.1f}")
+            else:
+                v = s.get("value", 0)
+                if not v and not include_zero:
+                    continue
+                vs = f"{v:.6g}"
+                lines.append(f"{key:<58}{fam['type']:>10}{vs:>16}")
+    return "\n".join(lines)
+
+
+def load_snapshot(path):
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        raise SystemExit(
+            f"{path} is not a telemetry JSON snapshot ({e}); was it written "
+            "with telemetry.dump(path) / MXNET_TELEMETRY_DUMP_PATH?") from e
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Render a mxnet_tpu.telemetry snapshot file.")
+    ap.add_argument("path", help="snapshot JSON written by telemetry.dump() "
+                                 "or the MXNET_TELEMETRY_DUMP_PATH reporter")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--prom", action="store_true",
+                      help="emit Prometheus text exposition")
+    mode.add_argument("--json", action="store_true",
+                      help="emit the raw snapshot JSON, pretty-printed")
+    ap.add_argument("--all", action="store_true",
+                    help="include zero-valued series in the table")
+    ap.add_argument("--watch", type=float, metavar="SEC", default=None,
+                    help="re-read and re-render every SEC seconds")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.telemetry.metrics import prometheus_from_snapshot
+
+    def render():
+        snap = load_snapshot(args.path)
+        if args.prom:
+            return prometheus_from_snapshot(snap)
+        if args.json:
+            return json.dumps(snap, indent=1, sort_keys=True)
+        ts = snap.get("ts")
+        age = f" (snapshot age {time.time() - ts:.1f}s)" if ts else ""
+        return f"# {args.path}{age}\n" + render_table(snap, args.all)
+
+    if args.watch is None:
+        print(render())
+        return 0
+    try:
+        while True:
+            print("\033[2J\033[H" + render(), flush=True)
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
