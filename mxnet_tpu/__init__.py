@@ -66,6 +66,7 @@ if config.get("MXNET_PROFILER_AUTOSTART"):
 telemetry.reporter._autostart()
 from . import parallel
 from . import serving
+from . import resilience
 from . import sparse
 from . import symbol
 from . import symbol as sym

@@ -172,3 +172,48 @@ register("MXNET_TELEMETRY_DUMP_PATH", "", str,
          "reads/watches the file while the run is live.")
 register("MXNET_TELEMETRY_DUMP_INTERVAL", 10.0, float,
          "Seconds between background telemetry snapshot dumps/log lines.")
+register("MXNET_CKPT_KEEP", 3, int,
+         "CheckpointManager: newest checkpoints retained after each save "
+         "(the corrupt-fallback chain depth); 0 disables rotation.")
+register("MXNET_CKPT_ASYNC", False, bool,
+         "CheckpointManager default: snapshot synchronously but write/fsync "
+         "in a background thread, overlapping checkpoint IO with compute "
+         "(wait() joins and surfaces write errors).")
+register("MXNET_CKPT_FSYNC", True, bool,
+         "CheckpointManager: fsync every checkpoint file and directory "
+         "rename (the crash-consistency barrier). Disable only for "
+         "throwaway test directories.")
+register("MXNET_RETRY_MAX_ATTEMPTS", 3, int,
+         "RetryPolicy: total attempts (1 = no retries) for retryable "
+         "failures (device OOM, UNAVAILABLE, transient compile errors); "
+         "fatal errors (shape/dtype mismatch) never retry.")
+register("MXNET_RETRY_BASE_MS", 50.0, float,
+         "RetryPolicy: backoff before the first retry, milliseconds.")
+register("MXNET_RETRY_MAX_MS", 2000.0, float,
+         "RetryPolicy: backoff cap, milliseconds.")
+register("MXNET_RETRY_MULTIPLIER", 2.0, float,
+         "RetryPolicy: exponential backoff multiplier per attempt.")
+register("MXNET_RETRY_JITTER", 0.1, float,
+         "RetryPolicy: relative jitter (+/- fraction) on each backoff, drawn "
+         "from a seeded generator so chaos runs replay exactly.")
+register("MXNET_WATCHDOG_STALL_S", 30.0, float,
+         "Watchdog: a watched region (device step, serving batch) alive "
+         "longer than this counts as a stall — mxtpu_watchdog_stalls_total "
+         "fires and the owner's stall callback runs (the serving server "
+         "degrades its circuit breaker).")
+register("MXNET_WATCHDOG_POLL_S", 0.0, float,
+         "Watchdog monitor poll interval; 0 = auto (stall_s/4, clamped to "
+         "[0.01, 0.25]s).")
+register("MXNET_CIRCUIT_DEGRADED_AFTER", 3, int,
+         "CircuitBreaker: consecutive failures before HEALTHY -> DEGRADED "
+         "(admission tightens to half the queue bound).")
+register("MXNET_CIRCUIT_OPEN_AFTER", 6, int,
+         "CircuitBreaker: consecutive failures before -> OPEN (all "
+         "admissions shed with ServerOverloadError until cooldown).")
+register("MXNET_CIRCUIT_COOLDOWN_S", 5.0, float,
+         "CircuitBreaker: seconds OPEN before HALF_OPEN probing begins.")
+register("MXNET_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
+         "InferenceServer.stop(drain=True): max seconds to wait for the "
+         "drain; past it pending requests are abandoned (failed with "
+         "ServerClosedError, counted in mxtpu_drain_abandoned_total) so a "
+         "wedged endpoint can never hang shutdown forever.")

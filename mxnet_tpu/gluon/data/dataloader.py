@@ -120,14 +120,29 @@ class DataLoader:
                              "not be specified if batch_sampler is specified")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
+        # resumable-iteration accounting (state_dict/load_state_dict): which
+        # epoch we are in, how many batches the CONSUMER has received this
+        # epoch (prefetch depth never leaks into it), and the global numpy
+        # RNG state captured at epoch start so a resumed epoch re-derives the
+        # exact same shuffle permutation
+        self._epoch = 0
+        self._pos = 0
+        self._resume_pos = 0
+        self._epoch_rng = None
 
     def _fetch_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
-    def _make_iter(self):
+    def _make_iter(self, skip: int = 0):
+        it = iter(self._batch_sampler)
+        # resume: burn already-consumed index batches WITHOUT touching the
+        # dataset — skipping costs sampler iteration only, no fetch/batchify
+        for _ in range(skip):
+            if next(it, None) is None:
+                return
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
+            for indices in it:
                 yield self._fetch_batch(indices)
             return
         from concurrent.futures import ThreadPoolExecutor
@@ -135,7 +150,6 @@ class DataLoader:
             # pipeline: keep up to prefetch batches in flight, in order
             import collections
             pending = collections.deque()
-            it = iter(self._batch_sampler)
             try:
                 while True:
                     while len(pending) < self._prefetch:
@@ -151,11 +165,69 @@ class DataLoader:
                 for f in pending:
                     f.cancel()
 
+    def _epoch_iter(self):
+        """Consumer-facing epoch generator with resume accounting."""
+        skip = self._resume_pos
+        self._resume_pos = 0
+        if skip and self._epoch_rng is not None:
+            # mid-epoch resume: rewind the global RNG to the epoch-start
+            # snapshot so the shuffle permutation replays, then skip what was
+            # already consumed — iteration yields the exact remaining batches
+            onp.random.set_state(self._epoch_rng)
+        elif not skip:
+            self._epoch_rng = onp.random.get_state()
+        self._pos = skip
+        inner = iter(_Prefetcher(lambda: self._make_iter(skip),
+                                 self._prefetch)) \
+            if self._num_workers > 0 else self._make_iter(skip)
+        for batch in inner:
+            # count BEFORE yield: once the consumer holds the batch it is
+            # consumed — a state_dict taken right after must not replay it
+            self._pos += 1
+            yield batch
+        self._epoch += 1
+        self._pos = 0
+        self._epoch_rng = None
+
     def __iter__(self):
-        if self._num_workers > 0:
-            return _timed_iter(iter(_Prefetcher(self._make_iter,
-                                                self._prefetch)))
-        return _timed_iter(self._make_iter())
+        return _timed_iter(self._epoch_iter())
 
     def __len__(self):
         return len(self._batch_sampler)
+
+    # ------------------------------------------------------------------
+    # checkpoint surface (resilience.CheckpointManager)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """Snapshot the iteration position: epoch, batches consumed this
+        epoch, and the epoch-start numpy RNG state (legacy MT19937 tuple,
+        flattened to npz-friendly fields). After ``load_state_dict`` the next
+        ``iter()`` yields exactly the batches the interrupted epoch had left."""
+        st = {"kind": "DataLoader", "version": 1,
+              "epoch": int(self._epoch), "pos": int(self._pos)}
+        if self._pos > 0 and self._epoch_rng is not None:
+            name, keys, pos, has_gauss, cached = self._epoch_rng
+            st.update(rng_name=str(name),
+                      rng_keys=onp.asarray(keys, dtype=onp.uint32),
+                      rng_pos=int(pos), rng_has_gauss=int(has_gauss),
+                      rng_cached=float(cached))
+        return st
+
+    def load_state_dict(self, state):
+        if state.get("kind") != "DataLoader":
+            raise MXNetError(f"not a DataLoader state: {state.get('kind')!r}")
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+        self._resume_pos = self._pos
+        if "rng_keys" in state:
+            self._epoch_rng = (str(state["rng_name"]),
+                               onp.asarray(state["rng_keys"], onp.uint32),
+                               int(state["rng_pos"]),
+                               int(state["rng_has_gauss"]),
+                               float(state["rng_cached"]))
+        else:
+            self._epoch_rng = None
+
+    @property
+    def epoch(self):
+        return self._epoch

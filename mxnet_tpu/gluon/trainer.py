@@ -10,12 +10,38 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as onp
+
 from ..base import MXNetError
 from .. import optimizer as opt_mod
 from .. import kvstore as kvstore_mod
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
+
+
+def _encode_slot(st):
+    """Updater slot (None | NDArray | nested tuples) -> checkpoint tree
+    (nested str-keyed dicts of numpy arrays / scalars, no pickle)."""
+    from ..ndarray.ndarray import NDArray
+    if st is None:
+        return {"none": 1}
+    if isinstance(st, NDArray):
+        return {"a": st.asnumpy()}
+    if isinstance(st, (tuple, list)):
+        return {"t": {str(i): _encode_slot(x) for i, x in enumerate(st)}}
+    raise MXNetError(f"cannot checkpoint optimizer slot of type "
+                     f"{type(st).__name__}")
+
+
+def _decode_slot(enc):
+    from ..ndarray.ndarray import NDArray
+    if "none" in enc:
+        return None
+    if "a" in enc:
+        return NDArray(onp.asarray(enc["a"]))
+    items = enc["t"]
+    return tuple(_decode_slot(items[str(i)]) for i in range(len(items)))
 
 
 class Trainer:
@@ -140,6 +166,50 @@ class Trainer:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
+
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """Checkpointable snapshot of the optimizer side of training: every
+        updater state slot (momentum / Adam m,v — as host numpy), the
+        optimizer's update counters, and per-index counts. Pairs with
+        parameter state (``block.collect_params()``) to make save → restore
+        → one step bitwise-equal to an uninterrupted run (the
+        resilience.CheckpointManager contract)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("state_dict() requires local updates "
+                             "(update_on_kvstore=False); use save_states() "
+                             "for kvstore-owned optimizer state")
+        opt = self._optimizer
+        state = {
+            "kind": "Trainer",
+            "version": 1,
+            "num_update": int(opt.num_update),
+            "index_counts": {str(k): int(v)
+                             for k, v in opt._index_update_count.items()},
+            "slots": {},
+        }
+        for idx, st in self._updaters.states.items():
+            state["slots"][str(idx)] = _encode_slot(st)
+        return state
+
+    def load_state_dict(self, state):
+        """Restore a :meth:`state_dict` snapshot (same optimizer family and
+        parameter set)."""
+        if state.get("kind") != "Trainer":
+            raise MXNetError(f"not a Trainer state: {state.get('kind')!r}")
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError("load_state_dict() requires local updates "
+                             "(update_on_kvstore=False)")
+        opt = self._optimizer
+        opt.num_update = int(state["num_update"])
+        opt._index_update_count = {int(k): int(v) for k, v
+                                   in state.get("index_counts", {}).items()}
+        self._updaters.states = {int(idx): _decode_slot(enc) for idx, enc
+                                 in state.get("slots", {}).items()}
 
     # ------------------------------------------------------------------
     def save_states(self, fname):

@@ -18,9 +18,13 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as onp
+
 from ..base import Context, MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telemetry
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
 from .mesh import DeviceMesh
 
 __all__ = ["ParallelTrainStep", "pure_apply"]
@@ -72,7 +76,8 @@ class ParallelTrainStep:
 
     def __init__(self, block, loss, optimizer, mesh: DeviceMesh, *,
                  data_spec=None, label_spec=None, extra_specs: Sequence = (),
-                 donate: bool = True, compute_dtype=None, param_format=None):
+                 donate: bool = True, compute_dtype=None, param_format=None,
+                 retry_policy=None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -82,6 +87,11 @@ class ParallelTrainStep:
         self._optimizer = optimizer
         self._mesh = mesh
         self._donate = donate
+        # transient device failures (OOM on a shape transition, preempted
+        # chip) retry with backoff; the on_retry hook refuses to retry once
+        # donated carried state is gone and re-places it otherwise
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy.from_config()
         self._step_fn = None
         self._step_n_fns: Dict[int, Callable] = {}
         self._t = 0
@@ -234,6 +244,7 @@ class ParallelTrainStep:
 
     def _build(self):
         import jax
+        _faults.check("compile")
         step = self._make_raw_step()
         t_sh, a_sh, rep = self._shardings()
         donate = (0, 1, 2) if self._donate else ()
@@ -267,6 +278,7 @@ class ParallelTrainStep:
         import jax.numpy as jnp
         from jax import lax
 
+        _faults.check("compile")
         step = self._make_raw_step()
 
         def step_n(train_params, aux_params, opt_states, xs, ys, extras_s,
@@ -403,8 +415,6 @@ class ParallelTrainStep:
     def _step_impl(self, x, y, *extras):
         import jax
         import jax.numpy as jnp
-        if self._step_fn is None:
-            self._build()
         if not isinstance(y, (tuple, list, NDArray)) and not hasattr(y, "shape"):
             raise MXNetError(
                 "labels must be an array or a flat tuple/list of arrays "
@@ -428,11 +438,24 @@ class ParallelTrainStep:
                           dtype=jnp.float32)
         from .. import random as _rng
         key = _rng.take_key()
-        train = [self._params[i] for i in self._trainable_idx]
-        aux = [self._params[i] for i in self._aux_idx]
-        loss, new_train, new_aux, new_states = self._step_fn(
-            train, aux, self._opt_states, x, y, extras, key, lrs, wds,
-            jnp.float32(self._t))
+
+        # retryable device call: the key/lr/wd inputs are fixed before the
+        # loop so a retried attempt is numerically identical; carried state
+        # is re-read from self._params per attempt (persisted only after
+        # success), so after _pre_retry re-places it the retry uses the
+        # re-placed buffers
+        def attempt():
+            _faults.check("train_step")
+            if self._step_fn is None:
+                self._build()
+            train = [self._params[i] for i in self._trainable_idx]
+            aux = [self._params[i] for i in self._aux_idx]
+            return self._step_fn(
+                train, aux, self._opt_states, x, y, extras, key, lrs, wds,
+                jnp.float32(self._t))
+
+        loss, new_train, new_aux, new_states = self._retry.run(
+            attempt, site="train_step", on_retry=self._pre_retry)
         for j, i in enumerate(self._trainable_idx):
             self._params[i] = new_train[j]
         for j, i in enumerate(self._aux_idx):
@@ -476,7 +499,6 @@ class ParallelTrainStep:
         import jax.numpy as jnp
         xs = xs.data if isinstance(xs, NDArray) else jnp.asarray(xs)
         n = int(xs.shape[0])
-        fn = self._step_n_fns.get(n) or self._build_n(n)
         ys = jax.tree_util.tree_map(
             lambda a: a.data if isinstance(a, NDArray) else jnp.asarray(a), ys,
             is_leaf=lambda a: isinstance(a, NDArray))
@@ -501,11 +523,17 @@ class ParallelTrainStep:
         wds_k = jnp.asarray(wds_rows, dtype=jnp.float32)
         from .. import random as _rng
         key = _rng.take_key()
-        train = [self._params[i] for i in self._trainable_idx]
-        aux = [self._params[i] for i in self._aux_idx]
-        losses, new_train, new_aux, new_states = fn(
-            train, aux, self._opt_states, xs, ys, extras_s, key, lrs_k, wds_k,
-            jnp.float32(t0 + 1))
+
+        def attempt():
+            _faults.check("train_step")
+            fn = self._step_n_fns.get(n) or self._build_n(n)
+            train = [self._params[i] for i in self._trainable_idx]
+            aux = [self._params[i] for i in self._aux_idx]
+            return fn(train, aux, self._opt_states, xs, ys, extras_s, key,
+                      lrs_k, wds_k, jnp.float32(t0 + 1))
+
+        losses, new_train, new_aux, new_states = self._retry.run(
+            attempt, site="train_step", on_retry=self._pre_retry)
         for j, i in enumerate(self._trainable_idx):
             self._params[i] = new_train[j]
         for j, i in enumerate(self._aux_idx):
@@ -548,6 +576,96 @@ class ParallelTrainStep:
             jax.device_put(jnp.asarray(e.data if isinstance(e, NDArray) else e), sh)
             for e, sh in zip(extras, self._extra_shardings))
         return (x, y) + extras
+
+    # ------------------------------------------------------------------
+    # resilience: retry guard + checkpoint surface
+    # ------------------------------------------------------------------
+    def _pre_retry(self, exc, attempt, delay_s):
+        """RetryPolicy hook: a retry is only sound while the carried state
+        still exists — a real OOM that fired AFTER donation consumed the
+        input buffers leaves nothing to re-run with (that state is only
+        persisted post-success, so the checkpoint is the recovery path).
+        Otherwise re-place the carried state onto its shardings (a no-op
+        device_put when placement survived)."""
+        import jax
+        leaves = list(self._params)
+        for st in self._opt_states:
+            leaves.extend(jax.tree_util.tree_leaves(st))
+        for a in leaves:
+            if getattr(a, "is_deleted", None) is not None and a.is_deleted():
+                raise MXNetError(
+                    "cannot retry train step: donated carried state was "
+                    "consumed by the failed call; restore from the latest "
+                    "checkpoint (resilience.CheckpointManager) instead"
+                ) from exc
+        self._params = [jax.device_put(a, sh) for a, sh in
+                        zip(self._params, self._param_shardings)]
+        self._opt_states = [
+            jax.tree_util.tree_map(jax.device_put, st, sh)
+            for st, sh in zip(self._opt_states, self._state_shardings)]
+        # the autoformat owner's layouts may no longer match the re-placed
+        # state; drop ownership so the next call re-places into the
+        # executable's formats
+        self._autoformat_cache.pop("owner", None)
+
+    def state_dict(self) -> Dict:
+        """Host snapshot of the carried training state: every parameter
+        (trainable + aux), the optimizer state trees, and the step counter
+        ``t`` — the fused-step third of a full training checkpoint
+        (CheckpointManager composes it with RNG/dataloader/meta state)."""
+        import jax
+        params = {f"p{i}": onp.asarray(jax.device_get(a))
+                  for i, a in enumerate(self._params)}
+        opt = {}
+        for j, st in enumerate(self._opt_states):
+            leaves = jax.tree_util.tree_leaves(st)
+            opt[f"s{j}"] = {f"l{k}": onp.asarray(jax.device_get(leaf))
+                            for k, leaf in enumerate(leaves)}
+        return {"kind": "ParallelTrainStep", "version": 1, "t": int(self._t),
+                "n_params": len(self._params),
+                "param_names": ",".join(p.name for p in self._plist),
+                "params": params, "opt": opt}
+
+    def load_state_dict(self, state: Dict):
+        """Restore a :meth:`state_dict` snapshot into this step (same model
+        topology/optimizer required). Carried state is re-placed onto the
+        mesh with this step's shardings; a subsequent step continues
+        bitwise-identically to the run that saved the snapshot."""
+        import jax
+        if state.get("kind") != "ParallelTrainStep":
+            raise MXNetError(f"not a ParallelTrainStep state: "
+                             f"{state.get('kind')!r}")
+        if int(state["n_params"]) != len(self._params):
+            raise MXNetError(
+                "checkpoint does not match this model: expected "
+                f"{len(self._params)} params, got {state['n_params']} "
+                f"({state.get('param_names')})")
+        loaded = []
+        for i, (p, sh) in enumerate(zip(self._plist, self._param_shardings)):
+            arr = onp.asarray(state["params"][f"p{i}"])
+            if tuple(arr.shape) != tuple(p.shape):
+                # param names carry per-process counters (dense0 vs dense1),
+                # so identity is checked structurally: position + shape
+                raise MXNetError(
+                    f"checkpoint param {i} ({p.name}) shape mismatch: "
+                    f"{arr.shape} vs {tuple(p.shape)}")
+            loaded.append(jax.device_put(arr, sh))
+        self._params = loaded
+        new_states = []
+        for j, (st, sh) in enumerate(zip(self._opt_states,
+                                         self._state_shardings)):
+            leaves, treedef = jax.tree_util.tree_flatten(st)
+            saved = state["opt"][f"s{j}"]
+            if len(saved) != len(leaves):
+                raise MXNetError(f"optimizer state {j} arity mismatch: "
+                                 f"{len(saved)} vs {len(leaves)}")
+            sh_leaves = jax.tree_util.tree_flatten(sh)[0]
+            placed = [jax.device_put(onp.asarray(saved[f"l{k}"]), s)
+                      for k, s in enumerate(sh_leaves)]
+            new_states.append(jax.tree_util.tree_unflatten(treedef, placed))
+        self._opt_states = new_states
+        self._t = int(state["t"])
+        self._autoformat_cache.pop("owner", None)
 
     # ------------------------------------------------------------------
     def sync_to_block(self):

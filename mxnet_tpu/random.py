@@ -10,7 +10,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
-__all__ = ["seed", "take_key", "push_key_source", "pop_key_source"]
+__all__ = ["seed", "take_key", "push_key_source", "pop_key_source",
+           "get_state", "set_state"]
 
 
 class _State(threading.local):
@@ -70,6 +71,38 @@ def take_key():
         seed(_DEFAULT_SEED)
     _STATE.key, sub = jax.random.split(_STATE.key)
     return sub
+
+
+def get_state():
+    """Serializable snapshot of this thread's key chain (the checkpoint
+    surface): ``{"impl": str, "typed": 0|1, "data": uint32 ndarray}``.
+    Restoring it with :func:`set_state` makes the subsequent ``take_key()``
+    stream identical — the property crash/restore bitwise-equality needs."""
+    import jax
+    import numpy as onp
+    if _STATE.key is None:
+        seed(_DEFAULT_SEED)
+    k = _STATE.key
+    try:
+        typed = jax.numpy.issubdtype(k.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        typed = False
+    if typed:
+        return {"impl": str(jax.random.key_impl(k)), "typed": 1,
+                "data": onp.asarray(jax.random.key_data(k))}
+    return {"impl": "threefry2x32", "typed": 0, "data": onp.asarray(k)}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot into this thread's key chain."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    data = jnp.asarray(onp.asarray(state["data"]), dtype=jnp.uint32)
+    if int(state.get("typed", 0)):
+        _STATE.key = jax.random.wrap_key_data(data, impl=str(state["impl"]))
+    else:
+        _STATE.key = data
 
 
 def push_key_source(fn: Callable):

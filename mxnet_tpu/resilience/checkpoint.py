@@ -1,0 +1,459 @@
+"""CheckpointManager: atomic, rotating, optionally-async training checkpoints.
+
+The recovery contract is the TensorFlow one (PAPERS.md, 1605.08695): periodic
+*consistent* checkpoints plus restart-from-latest, where "consistent" is a
+filesystem property, not a hope —
+
+  - every checkpoint is written to a temp directory first, each file fsynced,
+    a ``MANIFEST.json`` with per-file sha256 checksums written last, and the
+    directory atomically renamed into place (then the parent fsynced): a
+    crash at ANY point leaves either the previous complete checkpoint set or
+    a temp directory that restore never looks at;
+  - ``restore_latest()`` re-verifies the manifest checksums before trusting a
+    checkpoint, logs a warning and falls back to the next-newest intact one
+    when verification fails (torn write, bit rot, non-atomic remote FS), and
+    returns ``None`` only when no intact checkpoint exists — it never raises
+    on corrupt input;
+  - rotation keeps the newest ``keep`` checkpoints so the fallback chain has
+    depth without unbounded disk growth.
+
+What a checkpoint *captures* (the :func:`capture_state`/:func:`apply_state`
+glue): model parameters, optimizer state (Trainer slots or the fused
+ParallelTrainStep's on-mesh carried state), the global RNG key chain, the
+step counter, and the DataLoader position — everything needed for a restored
+run to continue *bitwise identical* to an uninterrupted one (the acceptance
+bar tests/test_resilience.py holds it to).
+
+``async_save=True`` snapshots to host numpy synchronously (cheap) and writes
+in a background thread, overlapping serialization/fsync with the next compute
+steps; ``wait()`` joins outstanding writes and surfaces their errors.
+
+State dicts are nested ``{str: ...}`` dicts whose leaves are numpy arrays or
+JSON scalars; arrays land in one ``state.npz`` (no pickle), scalars in
+``meta.json``.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import config as _config
+from .. import telemetry as _telemetry
+from . import faults as _faults
+
+__all__ = ["CheckpointManager", "capture_state", "apply_state"]
+
+log = logging.getLogger("mxnet_tpu.resilience.checkpoint")
+
+_SAVES = _telemetry.counter(
+    "mxtpu_checkpoint_saves_total", "Checkpoint save attempts by outcome.",
+    labelnames=("outcome",))
+_RESTORES = _telemetry.counter(
+    "mxtpu_checkpoint_restores_total",
+    "Checkpoint restore attempts by outcome "
+    "(restored/corrupt_skipped/none).", labelnames=("outcome",))
+_BYTES = _telemetry.counter(
+    "mxtpu_checkpoint_bytes_written_total",
+    "Bytes durably written by checkpoint saves.")
+_SAVE_DUR = _telemetry.histogram(
+    "mxtpu_checkpoint_save_duration_us",
+    "Wall time of one checkpoint save (serialize + fsync + rename), us.")
+_LAST_STEP = _telemetry.gauge(
+    "mxtpu_checkpoint_last_step", "Step of the newest durable checkpoint.")
+
+_DATA, _META, _MANIFEST = "state.npz", "meta.json", "MANIFEST.json"
+_PREFIX, _TMP_PREFIX = "ckpt-", ".tmp-"
+_FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# state-tree (de)serialization: nested str-keyed dicts, array or scalar leaves
+# ---------------------------------------------------------------------------
+def _flatten(tree: Dict, prefix: str = "", arrays=None, scalars=None):
+    if arrays is None:
+        arrays, scalars = {}, {}
+    for k, v in tree.items():
+        if not isinstance(k, str) or "/" in k:
+            raise MXNetError(f"state keys must be '/'-free strings, got {k!r}")
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            _flatten(v, key + "/", arrays, scalars)
+        elif isinstance(v, onp.ndarray):
+            arrays[key] = v
+        elif isinstance(v, (onp.generic,)):
+            scalars[key] = v.item()
+        elif isinstance(v, (int, float, str, bool)) or v is None:
+            scalars[key] = v
+        else:
+            raise MXNetError(
+                f"unsupported checkpoint leaf at {key!r}: {type(v).__name__} "
+                "(use numpy arrays, JSON scalars, or nested dicts)")
+    return arrays, scalars
+
+
+def _unflatten(arrays: Dict, scalars: Dict) -> Dict:
+    tree: Dict = {}
+    for src in (scalars, arrays):
+        for key, v in src.items():
+            parts = key.split("/")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = v
+    return tree
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class CheckpointManager:
+    """Atomic rotating checkpoints under one directory.
+
+    Parameters
+    ----------
+    directory : str
+        Root; checkpoints live in ``ckpt-<step>/`` subdirectories. One
+        writer per directory (single-trainer discipline).
+    keep : int, optional
+        Newest checkpoints retained (default ``MXNET_CKPT_KEEP``); older
+        ones are deleted after each successful save. ``0`` disables rotation.
+    async_save : bool, optional
+        Write in a background thread (default ``MXNET_CKPT_ASYNC``). The
+        state snapshot is taken synchronously, so the caller may keep
+        training while bytes hit disk; ``wait()`` joins and re-raises.
+    fsync : bool
+        Durability barrier per file + directory rename (default
+        ``MXNET_CKPT_FSYNC``; disable only for throwaway test dirs).
+    """
+
+    def __init__(self, directory: str, keep: Optional[int] = None,
+                 async_save: Optional[bool] = None,
+                 fsync: Optional[bool] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = int(keep if keep is not None
+                        else _config.get("MXNET_CKPT_KEEP"))
+        self.async_save = bool(async_save if async_save is not None
+                               else _config.get("MXNET_CKPT_ASYNC"))
+        self.fsync = bool(fsync if fsync is not None
+                          else _config.get("MXNET_CKPT_FSYNC"))
+        self._worker = None
+        self._pending: list = []
+        self._lock = threading.Lock()
+        self.last_save_bytes = 0
+
+    # ------------------------------------------------------------------
+    # paths
+    # ------------------------------------------------------------------
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_PREFIX}{int(step):08d}")
+
+    def steps(self):
+        """Steps that have a (renamed-into-place) checkpoint directory,
+        ascending. Intactness is verified at restore, not here."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_PREFIX):
+                try:
+                    out.append(int(name[len(_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # ------------------------------------------------------------------
+    # save
+    # ------------------------------------------------------------------
+    def save(self, step: int, state: Optional[Dict] = None, **objs) -> str:
+        """Write checkpoint ``step``. Either pass an explicit ``state`` tree
+        or capture keyword objects (``train_step=``, ``trainer=``,
+        ``block=``, ``dataloader=``, ``extra=``, ``include_rng=``) via
+        :func:`capture_state`. Returns the final checkpoint path (for async
+        saves: the path it *will* occupy; ``wait()`` to confirm)."""
+        if state is None:
+            state = capture_state(**objs)
+        elif objs:
+            raise MXNetError("pass either an explicit state or capture "
+                             "kwargs, not both")
+        final = self._path(step)
+        if self.async_save:
+            self.wait()           # one overlapped save in flight; keep order
+            t = threading.Thread(target=self._save_guarded,
+                                 args=(step, state),
+                                 name="mxtpu-ckpt-writer", daemon=True)
+            with self._lock:
+                self._pending.append([t, None])
+            t.start()
+            return final
+        self._save_sync(step, state)
+        return final
+
+    def _save_guarded(self, step: int, state: Dict):
+        try:
+            self._save_sync(step, state)
+        except BaseException as e:   # surfaced on wait()
+            with self._lock:
+                for rec in self._pending:
+                    if rec[0] is threading.current_thread():
+                        rec[1] = e
+
+    def wait(self):
+        """Join outstanding async saves; re-raise the first failure."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        err = None
+        for t, exc in pending:
+            t.join()
+        for rec in pending:
+            err = err or rec[1]
+        if err is not None:
+            raise err
+
+    def _write_file(self, path: str, data: bytes):
+        """Write+fsync one file. The ``checkpoint_write`` fault hook sits
+        between write and fsync: when the harness fires it truncates the file
+        to half (a torn write) and re-raises — the mid-crash a journaling FS
+        can hand back on power loss."""
+        with open(path, "wb") as f:
+            f.write(data)
+            try:
+                _faults.check("checkpoint_write")
+            except BaseException:
+                f.flush()
+                f.truncate(max(1, len(data) // 2))
+                raise
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        return len(data)
+
+    def _fsync_dir(self, path: str):
+        if not self.fsync:
+            return
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:      # platforms where dirs can't be fsynced
+            pass
+
+    def _save_sync(self, step: int, state: Dict):
+        t0 = time.perf_counter_ns()
+        final = self._path(step)
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{_PREFIX}{int(step):08d}-{os.getpid()}")
+        try:
+            with _telemetry.span("checkpoint.save", step=int(step)):
+                arrays, scalars = _flatten(state)
+                buf = io.BytesIO()
+                onp.savez(buf, **arrays)
+                meta = {"format": _FORMAT, "step": int(step),
+                        "scalars": scalars, "wall_time": time.time()}
+                shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp)
+                nbytes = self._write_file(os.path.join(tmp, _DATA),
+                                          buf.getvalue())
+                nbytes += self._write_file(
+                    os.path.join(tmp, _META),
+                    json.dumps(meta, sort_keys=True).encode())
+                manifest = {"format": _FORMAT, "step": int(step), "files": {}}
+                for name in (_DATA, _META):
+                    p = os.path.join(tmp, name)
+                    manifest["files"][name] = {
+                        "sha256": _sha256(p), "bytes": os.path.getsize(p)}
+                nbytes += self._write_file(
+                    os.path.join(tmp, _MANIFEST),
+                    json.dumps(manifest, sort_keys=True).encode())
+                self._fsync_dir(tmp)
+                if os.path.exists(final):     # re-save of the same step
+                    shutil.rmtree(final)
+                os.replace(tmp, final)
+                self._fsync_dir(self.directory)
+        except BaseException:
+            _SAVES.labels("failed").inc()
+            raise
+        self.last_save_bytes = nbytes
+        _SAVES.labels("ok").inc()
+        _BYTES.inc(nbytes)
+        _LAST_STEP.set(int(step))
+        _SAVE_DUR.observe((time.perf_counter_ns() - t0) // 1000)
+        self._rotate(exclude=int(step))
+        self._sweep_tmp()
+
+    def _rotate(self, exclude: int):
+        if self.keep <= 0:
+            return
+        steps = self.steps()
+        for s in steps[:-self.keep]:
+            if s == exclude:
+                continue
+            shutil.rmtree(self._path(s), ignore_errors=True)
+
+    def _sweep_tmp(self):
+        """Remove temp droppings from crashed earlier writers."""
+        for name in os.listdir(self.directory):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # restore
+    # ------------------------------------------------------------------
+    def _verify(self, path: str) -> Dict:
+        """Load + checksum-verify one checkpoint dir; raises on any defect."""
+        mpath = os.path.join(path, _MANIFEST)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != _FORMAT:
+            raise MXNetError(f"unknown checkpoint format "
+                             f"{manifest.get('format')!r}")
+        for name, rec in manifest["files"].items():
+            p = os.path.join(path, name)
+            if not os.path.exists(p):
+                raise MXNetError(f"missing checkpoint file {name}")
+            if os.path.getsize(p) != rec["bytes"]:
+                raise MXNetError(f"checkpoint file {name} truncated "
+                                 f"({os.path.getsize(p)} != {rec['bytes']} "
+                                 "bytes)")
+            if _sha256(p) != rec["sha256"]:
+                raise MXNetError(f"checkpoint file {name} checksum mismatch")
+        with open(os.path.join(path, _META)) as f:
+            meta = json.load(f)
+        with onp.load(os.path.join(path, _DATA), allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        state = _unflatten(arrays, meta.get("scalars", {}))
+        state.setdefault("meta", {})["step"] = int(manifest["step"])
+        return state
+
+    def restore(self, step: int, **objs):
+        """Verify + load checkpoint ``step`` and apply it to the given
+        objects (same kwargs as :func:`apply_state`). Raises on corruption —
+        use :meth:`restore_latest` for the fall-back policy."""
+        state = self._verify(self._path(step))
+        apply_state(state, **objs)
+        return state
+
+    def restore_latest(self, **objs) -> Optional[Tuple[int, Dict]]:
+        """Restore the newest *intact* checkpoint.
+
+        Walks checkpoints newest-first; a corrupt or partial one is logged
+        (warning) and skipped, never raised. Returns ``(step, state)`` after
+        applying the state to any passed objects, or ``None`` when no intact
+        checkpoint exists."""
+        with _telemetry.span("checkpoint.restore"):
+            for step in reversed(self.steps()):
+                path = self._path(step)
+                try:
+                    state = self._verify(path)
+                except Exception as e:
+                    _RESTORES.labels("corrupt_skipped").inc()
+                    log.warning(
+                        "checkpoint %s failed verification (%s); falling "
+                        "back to the previous checkpoint", path, e)
+                    continue
+                apply_state(state, **objs)
+                _RESTORES.labels("restored").inc()
+                return step, state
+        _RESTORES.labels("none").inc()
+        return None
+
+
+# ---------------------------------------------------------------------------
+# capture/apply glue: what a training checkpoint is made of
+# ---------------------------------------------------------------------------
+def capture_state(*, train_step=None, trainer=None, block=None,
+                  dataloader=None, include_rng: bool = True,
+                  extra: Optional[Dict] = None) -> Dict:
+    """Snapshot training state into a checkpointable tree (host numpy only —
+    safe to write from a background thread while the devices keep stepping).
+
+    Components (each optional): ``train_step`` — a ParallelTrainStep (on-mesh
+    params + optimizer state + step counter ``t``); ``trainer`` — a
+    gluon.Trainer (optimizer slots + update counts); ``block`` — a Block
+    whose parameters are saved by name; ``dataloader`` — a DataLoader
+    (epoch/position/shuffle RNG); ``include_rng`` — the global
+    ``mxnet_tpu.random`` key chain.
+    """
+    state: Dict = {"meta": {"format": _FORMAT}}
+    if train_step is not None:
+        state["train_step"] = train_step.state_dict()
+    if trainer is not None:
+        state["trainer"] = trainer.state_dict()
+    if block is not None:
+        # positional keys: gluon name counters are per-process (dense0 in
+        # one run is dense1 in the next), so identity is structural —
+        # collect_params() order + shape; names ride along for diagnostics
+        plist = list(block.collect_params().items())
+        state["model"] = {
+            "n_params": len(plist),
+            "param_names": ",".join(n for n, _ in plist),
+            "params": {f"p{i}": p.data().asnumpy()
+                       for i, (_, p) in enumerate(plist)},
+        }
+    if dataloader is not None:
+        state["dataloader"] = dataloader.state_dict()
+    if include_rng:
+        from .. import random as _random
+        state["rng"] = _random.get_state()
+    if extra:
+        state["extra"] = dict(extra)
+    return state
+
+
+def apply_state(state: Dict, *, train_step=None, trainer=None, block=None,
+                dataloader=None, restore_rng: bool = True, **_ignored):
+    """Inverse of :func:`capture_state`: push a restored tree back into live
+    objects. Missing components raise (a restore that silently skips what it
+    was asked to restore is a corrupt run, not a convenience)."""
+    def _want(key, obj):
+        if obj is None:
+            return None
+        if key not in state:
+            raise MXNetError(f"checkpoint has no {key!r} component; it holds "
+                             f"{sorted(state)}")
+        return state[key]
+
+    ts = _want("train_step", train_step)
+    if ts is not None:
+        train_step.load_state_dict(ts)
+    tr = _want("trainer", trainer)
+    if tr is not None:
+        trainer.load_state_dict(tr)
+    mod = _want("model", block)
+    if mod is not None:
+        from ..ndarray.ndarray import NDArray
+        plist = list(block.collect_params().items())
+        if int(mod["n_params"]) != len(plist):
+            raise MXNetError(
+                f"checkpoint holds {mod['n_params']} parameters, model has "
+                f"{len(plist)} ({mod.get('param_names')})")
+        for i, (name, p) in enumerate(plist):
+            arr = onp.asarray(mod["params"][f"p{i}"])
+            if tuple(arr.shape) != tuple(p.shape):
+                raise MXNetError(
+                    f"checkpoint param {i} ({name}) shape mismatch: "
+                    f"{arr.shape} vs {tuple(p.shape)}")
+            p.set_data(NDArray(arr))
+    dl = _want("dataloader", dataloader)
+    if dl is not None:
+        dataloader.load_state_dict(dl)
+    if restore_rng and "rng" in state:
+        from .. import random as _random
+        _random.set_state(state["rng"])
+    return state

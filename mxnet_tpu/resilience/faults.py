@@ -1,0 +1,222 @@
+"""Deterministic, seedable fault injection for resilience testing.
+
+Every recovery path in this stack (retry loops, checkpoint fallback, circuit
+breaking, bounded drain) is only trustworthy if it can be *driven* in a test
+without monkeypatching internals. This module is the one sanctioned way to
+make the stack fail on purpose: production code calls :func:`check` at a few
+named boundaries —
+
+    ``train_step``        ParallelTrainStep, immediately before the compiled call
+    ``compile``           executable builds (train-step jit, serving bucket AOT)
+    ``serving_dispatch``  InferenceServer worker, before the device batch step
+    ``checkpoint_write``  CheckpointManager, between file write and fsync
+
+— and tests scope injections with the :func:`inject` context manager::
+
+    with faults.inject("device_oom", every_n=3):
+        for _ in range(20):
+            step(x, y)          # every 3rd attempt raises a retryable OOM
+
+``check`` is a no-list check when nothing is injected, so the hooks cost one
+attribute load + truthiness test on the hot path. Injections are deterministic:
+``every_n``/``at`` count matching check calls exactly, and probabilistic
+injection (``p=``) draws from a private ``random.Random(seed)`` so a chaos run
+is reproducible from its logged seed.
+
+Injected exceptions carry honest markers: a ``device_oom`` message contains
+``RESOURCE_EXHAUSTED`` exactly like a real PJRT OOM, so both the structured
+classifier (``isinstance FaultInjected``) and message-marker classifiers see
+the same picture a real failure would paint.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional, Sequence, Tuple
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+
+__all__ = ["FaultInjected", "SimulatedCrash", "inject", "check",
+           "active_kinds", "SITES"]
+
+#: boundaries where production code calls :func:`check`
+SITES = ("train_step", "compile", "serving_dispatch", "checkpoint_write")
+
+_INJECTED = _telemetry.counter(
+    "mxtpu_faults_injected_total",
+    "Faults raised by the injection harness, by kind and site.",
+    labelnames=("kind", "site"))
+
+
+class FaultInjected(MXNetError):
+    """An error raised by the fault harness. ``retryable`` mirrors how the
+    retry classifier should treat the simulated failure."""
+
+    def __init__(self, kind: str, site: str, count: int, retryable: bool,
+                 message: str):
+        super().__init__(message)
+        self.kind = kind
+        self.site = site
+        self.count = count
+        self.retryable = retryable
+
+
+class SimulatedCrash(FaultInjected):
+    """A simulated process death (checkpoint writer killed mid-write)."""
+
+
+# kind -> (default sites, retryable, message template). The message carries
+# the marker a real failure of that kind would carry, so message-based
+# classification agrees with the structured FaultInjected flag.
+_KINDS = {
+    "device_oom": (("train_step", "serving_dispatch"), True,
+                   "RESOURCE_EXHAUSTED: Out of memory allocating device "
+                   "buffer (injected {kind} #{count} at {site})"),
+    "compile_error": (("compile",), True,
+                      "UNAVAILABLE: transient compilation failure "
+                      "(injected {kind} #{count} at {site})"),
+    "unavailable": (("serving_dispatch",), True,
+                    "UNAVAILABLE: device unreachable "
+                    "(injected {kind} #{count} at {site})"),
+    "shape_mismatch": (("train_step", "serving_dispatch"), False,
+                       "INVALID_ARGUMENT: shape mismatch "
+                       "(injected {kind} #{count} at {site})"),
+    "crash": (("checkpoint_write",), False,
+              "simulated crash: writer killed "
+              "(injected {kind} #{count} at {site})"),
+    "hang": (("train_step", "serving_dispatch"), True, ""),
+}
+
+_LOCK = threading.Lock()
+_ACTIVE: list = []          # the hot-path gate: empty list == harness off
+
+
+class _Injection:
+    """One scoped injection rule; counting is per-rule over matching sites."""
+
+    def __init__(self, kind: str, sites: Tuple[str, ...], retryable: bool,
+                 every_n: Optional[int], at: Tuple[int, ...],
+                 times: Optional[int], p: Optional[float], seed: int,
+                 seconds: float, exc_factory):
+        self.kind = kind
+        self.sites = sites
+        self.retryable = retryable
+        self.every_n = every_n
+        self.at = at
+        self.times = times
+        self.p = p
+        self.seconds = seconds
+        self._rng = _pyrandom.Random(seed)
+        self._exc_factory = exc_factory
+        self.calls = 0          # matching check() calls seen
+        self.fires = 0          # faults actually raised/slept
+
+    def _should_fire(self) -> bool:
+        if self.times is not None and self.fires >= self.times:
+            return False
+        if self.at:
+            return self.calls in self.at
+        if self.every_n is not None:
+            return self.calls % self.every_n == 0
+        if self.p is not None:
+            return self._rng.random() < self.p
+        return True             # bare inject(kind): fire on every call
+
+    def visit(self, site: str):
+        """Count a matching check call; returns an exception to raise (or
+        sleeps, for hangs) when the rule fires."""
+        if site not in self.sites:
+            return None
+        with _LOCK:
+            self.calls += 1
+            if not self._should_fire():
+                return None
+            self.fires += 1
+            count = self.fires
+        _INJECTED.labels(self.kind, site).inc()
+        if self.kind == "hang":
+            time.sleep(self.seconds)
+            return None
+        if self._exc_factory is not None:
+            return self._exc_factory(self.kind, site, count)
+        _, _, tmpl = _KINDS[self.kind]
+        msg = tmpl.format(kind=self.kind, count=count, site=site)
+        cls = SimulatedCrash if self.kind == "crash" else FaultInjected
+        return cls(self.kind, site, count, self.retryable, msg)
+
+
+@contextmanager
+def inject(kind: str, site=None, every_n: Optional[int] = None,
+           at: Sequence[int] = (), times: Optional[int] = None,
+           p: Optional[float] = None, seed: int = 0, seconds: float = 0.05,
+           retryable: Optional[bool] = None, exc=None):
+    """Scope a fault injection rule.
+
+    Parameters
+    ----------
+    kind : str
+        One of ``device_oom | compile_error | unavailable | shape_mismatch |
+        crash | hang``. Picks the default sites, retryability and message.
+    site : str | sequence of str, optional
+        Restrict to specific :func:`check` sites (default: the kind's sites).
+    every_n : int, optional
+        Fire on every n-th matching call (the 3rd, 6th, ... — deterministic).
+    at : sequence of int, optional
+        Fire exactly on these 1-based matching-call indices.
+    times : int, optional
+        Cap on total fires (e.g. ``every_n=1, times=2``: first two calls).
+    p : float, optional
+        Fire with this probability, drawn from ``random.Random(seed)`` —
+        randomized chaos that is replayable from the seed.
+    seconds : float
+        Sleep duration for ``kind="hang"`` (which stalls instead of raising).
+    retryable : bool, optional
+        Override the kind's default retry classification.
+    exc : callable, optional
+        ``exc(kind, site, count) -> Exception`` to raise a custom error.
+
+    Yields the injection record (``.calls`` / ``.fires`` for assertions).
+    """
+    if kind not in _KINDS:
+        raise MXNetError(f"unknown fault kind {kind!r}; known: "
+                         f"{sorted(_KINDS)}")
+    default_sites, default_retry, _ = _KINDS[kind]
+    if site is None:
+        sites = default_sites
+    elif isinstance(site, str):
+        sites = (site,)
+    else:
+        sites = tuple(site)
+    for s in sites:
+        if s not in SITES:
+            raise MXNetError(f"unknown fault site {s!r}; known: {SITES}")
+    inj = _Injection(kind, sites,
+                     default_retry if retryable is None else bool(retryable),
+                     every_n, tuple(at), times, p, seed, seconds, exc)
+    with _LOCK:
+        _ACTIVE.append(inj)
+    try:
+        yield inj
+    finally:
+        with _LOCK:
+            _ACTIVE.remove(inj)
+
+
+def check(site: str):
+    """Production hook: raise the active injected fault for ``site``, if any.
+    No-op (one truthiness test) when no injection is scoped."""
+    if not _ACTIVE:
+        return
+    for inj in list(_ACTIVE):
+        exc = inj.visit(site)
+        if exc is not None:
+            raise exc
+
+
+def active_kinds():
+    """Kinds currently scoped (diagnostic surface for chaos harnesses)."""
+    with _LOCK:
+        return sorted({inj.kind for inj in _ACTIVE})

@@ -1,0 +1,145 @@
+"""RetryPolicy: exponential backoff with jitter + error classification.
+
+The recovery rule this stack applies everywhere a device call can fail
+transiently (device OOM on a shape transition, a preempted/unreachable chip,
+a flaky compile): classify the error, and if it is *retryable*, back off
+exponentially (with deterministic seeded jitter so two replicas don't
+retry in lockstep — and so a test can predict the exact delays) and re-run.
+Fatal errors (shape/dtype mismatches — re-running cannot help) propagate
+immediately.
+
+Classification is two-layered: structured first (``FaultInjected.retryable``
+from the injection harness), then message markers that match what PJRT/XLA
+actually put in their error strings (``RESOURCE_EXHAUSTED``, ``UNAVAILABLE``,
+...). Sites wire in via :meth:`RetryPolicy.run`, which also respects an
+absolute deadline (the serving path passes the batch's earliest request
+deadline: a retry that cannot finish in time is not attempted).
+
+Every retry lands in ``mxtpu_retries_total{site,error}`` so a fleet quietly
+surviving on retries is visible before it stops surviving.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+import time
+from typing import Callable, Optional
+
+from ..base import MXNetError
+from .. import config as _config
+from .. import telemetry as _telemetry
+from .faults import FaultInjected
+
+__all__ = ["RetryPolicy", "classify_error", "RETRYABLE_MARKERS"]
+
+_RETRIES = _telemetry.counter(
+    "mxtpu_retries_total",
+    "Retry attempts by call site and exception type; a steadily climbing "
+    "rate means the stack is surviving on retries.",
+    labelnames=("site", "error"))
+
+#: substrings that mark a transient, retry-worthy failure in PJRT/XLA errors
+RETRYABLE_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                     "UNAVAILABLE", "ABORTED", "CANCELLED",
+                     "Failed to allocate", "transient")
+
+#: substrings that mark a deterministic failure retrying cannot fix; checked
+#: first so e.g. "INVALID_ARGUMENT ... while allocating" stays fatal
+_FATAL_MARKERS = ("INVALID_ARGUMENT", "shape mismatch", "Incompatible shapes",
+                  "dtype mismatch", "NOT_FOUND", "UNIMPLEMENTED")
+
+
+def classify_error(exc: BaseException) -> bool:
+    """True when ``exc`` is worth retrying (transient), False when fatal."""
+    if isinstance(exc, FaultInjected):
+        return exc.retryable
+    msg = str(exc)
+    if any(m in msg for m in _FATAL_MARKERS):
+        return False
+    return any(m in msg for m in RETRYABLE_MARKERS)
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class RetryPolicy:
+    """Configurable retry loop: ``run(fn)`` calls ``fn`` up to
+    ``max_attempts`` times, sleeping ``base_ms * multiplier**attempt``
+    (capped at ``max_ms``, jittered by ±``jitter``) between attempts.
+
+    ``seed`` makes the jitter sequence deterministic — chaos tests replay
+    byte-identical schedules; production uses per-instance seeds so replicas
+    decorrelate. A custom ``classify`` callable overrides the default
+    transient/fatal split; ``sleep`` is injectable for tests.
+    """
+
+    def __init__(self, max_attempts: Optional[int] = None,
+                 base_ms: Optional[float] = None,
+                 max_ms: Optional[float] = None,
+                 multiplier: Optional[float] = None,
+                 jitter: Optional[float] = None,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 seed: int = 0, sleep: Callable[[float], None] = time.sleep):
+        g = _config.get
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else g("MXNET_RETRY_MAX_ATTEMPTS"))
+        if self.max_attempts < 1:
+            raise MXNetError("max_attempts must be >= 1")
+        self.base_ms = float(base_ms if base_ms is not None
+                             else g("MXNET_RETRY_BASE_MS"))
+        self.max_ms = float(max_ms if max_ms is not None
+                            else g("MXNET_RETRY_MAX_MS"))
+        self.multiplier = float(multiplier if multiplier is not None
+                                else g("MXNET_RETRY_MULTIPLIER"))
+        self.jitter = float(jitter if jitter is not None
+                            else g("MXNET_RETRY_JITTER"))
+        self._classify = classify or classify_error
+        self._rng = _pyrandom.Random(seed)
+        self._sleep = sleep
+
+    @classmethod
+    def from_config(cls, seed: int = 0, **overrides) -> "RetryPolicy":
+        return cls(seed=seed, **overrides)
+
+    def delay_ms(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (0-based), jittered."""
+        raw = min(self.max_ms, self.base_ms * (self.multiplier ** attempt))
+        if self.jitter:
+            raw *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(raw, 0.0)
+
+    def run(self, fn: Callable, site: str = "generic",
+            deadline_us: Optional[int] = None,
+            on_retry: Optional[Callable] = None):
+        """Call ``fn()`` under this policy.
+
+        ``deadline_us`` (absolute, ``time.perf_counter_ns()//1000`` clock):
+        never sleep past it — when the backoff cannot fit, the last error
+        propagates instead (the serving path hands in the batch's earliest
+        request deadline, so retries respect what clients asked for).
+
+        ``on_retry(exc, attempt, delay_s)`` runs before each sleep; raising
+        from it aborts the retry (the train step uses this to refuse to
+        retry once donated buffers are gone).
+        """
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                if not self._classify(e) or attempt + 1 >= self.max_attempts:
+                    raise
+                delay_s = self.delay_ms(attempt) / 1e3
+                if deadline_us is not None and \
+                        _now_us() + delay_s * 1e6 > deadline_us:
+                    raise
+                if on_retry is not None:
+                    on_retry(e, attempt, delay_s)
+                _RETRIES.labels(site, type(e).__name__).inc()
+                self._sleep(delay_s)
+                attempt += 1
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"base_ms={self.base_ms}, max_ms={self.max_ms}, "
+                f"multiplier={self.multiplier}, jitter={self.jitter})")

@@ -31,7 +31,12 @@ whole traced graph differently than per-op programs.)
 Robustness contract: the queue is bounded (ServerOverloadError at admission —
 explicit backpressure instead of unbounded latency), per-request deadlines
 drop expired work before it occupies device rows (RequestTimeoutError), and
-shutdown drains by default. Observability rides the profiler layer: when the
+shutdown drains by default with a bounded timeout (abandoned requests are
+failed, never waited on forever). Each device batch step runs under a
+resilience.RetryPolicy (transient failures retried within the batch's
+earliest deadline), a Watchdog flags hung steps, and a CircuitBreaker sheds
+load (HEALTHY→DEGRADED→OPEN→HALF_OPEN) — see ``InferenceServer.health()``
+and RESILIENCE.md. Observability rides the profiler layer: when the
 profiler runs, every serving step is a recorded dispatch event, and
 ``stats()`` snapshots per-endpoint latency histograms, queue depth, batch
 occupancy (real vs padded rows) and executable-cache hit/compile counters.

@@ -186,7 +186,9 @@ class ModelEndpoint:
                 return comp
             import jax
             from .. import telemetry
+            from ..resilience import faults as _faults
             t0 = _now_us()
+            _faults.check("compile")
             with telemetry.span("serving.compile", endpoint=self.name,
                                 bucket=bucket):
                 param_sds = tuple(

@@ -11,7 +11,18 @@ recorded request latency is honest end-to-end time.
 
 Shutdown is graceful by default: ``stop(drain=True)`` flushes every admitted
 request through the device before the thread exits, while new submissions are
-already being refused; ``drain=False`` fails pending futures immediately.
+already being refused; the drain is *bounded* — past ``drain_timeout_s`` the
+remaining requests are abandoned (failed with ServerClosedError, counted in
+``mxtpu_drain_abandoned_total``) so a wedged endpoint can never hang shutdown
+forever. ``drain=False`` fails pending futures immediately.
+
+Fault tolerance (mxnet_tpu.resilience): each device batch step runs under a
+RetryPolicy — transient failures (device OOM, UNAVAILABLE) are retried with
+backoff as long as the batch's earliest request deadline allows; a Watchdog
+flags batch steps that hang past the stall threshold; and a CircuitBreaker
+aggregates dispatch outcomes into HEALTHY → DEGRADED (admission tightens to
+half the queue bound) → OPEN (every submit shed with ServerOverloadError) →
+HALF_OPEN (bounded probes) → HEALTHY, surfaced via :meth:`health`.
 
 When the profiler is running, every device step is recorded through the same
 ``_dispatch_profiled`` sink ops and CachedOp use, so serving steps land in the
@@ -27,7 +38,12 @@ from typing import Dict, Optional, Tuple
 import numpy as onp
 
 from ..base import MXNetError
+from .. import config as _config
+from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
+from ..resilience import faults as _faults
+from ..resilience.retry import RetryPolicy
+from ..resilience.watchdog import CircuitBreaker, Watchdog, DEGRADED
 from .batcher import (EndpointQueue, Request, concat_inputs, fail,
                       resolve)
 from .endpoint import ModelEndpoint
@@ -36,6 +52,11 @@ from .errors import ServerClosedError, ServerOverloadError
 __all__ = ["InferenceServer"]
 
 _RUNNING, _DRAINING, _STOPPED = "running", "draining", "stopped"
+
+_DRAIN_ABANDONED = _telemetry.counter(
+    "mxtpu_drain_abandoned_total",
+    "Requests abandoned because stop(drain=True) hit its timeout with the "
+    "worker wedged; each one was failed with ServerClosedError.")
 
 
 def _now_us() -> int:
@@ -53,9 +74,23 @@ class InferenceServer:
     max_queue : int
         Admission-control bound, in rows, per endpoint. Submissions beyond it
         raise ServerOverloadError instead of growing the queue.
+    retry_policy : resilience.RetryPolicy, optional
+        Per-batch device-step retry (default: MXNET_RETRY_* config).
+    breaker : resilience.CircuitBreaker, optional
+        Graceful-degradation state machine (default: MXNET_CIRCUIT_* config,
+        scope "serving").
+    watchdog_stall_s : float, optional
+        Hang threshold for one device batch step (default
+        MXNET_WATCHDOG_STALL_S). A stall degrades the circuit breaker.
+    drain_timeout_s : float, optional
+        Bound on stop(drain=True) (default MXNET_SERVING_DRAIN_TIMEOUT_S).
     """
 
-    def __init__(self, batch_timeout_ms: float = 2.0, max_queue: int = 256):
+    def __init__(self, batch_timeout_ms: float = 2.0, max_queue: int = 256,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 watchdog_stall_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None):
         self._batch_timeout_us = int(batch_timeout_ms * 1000)
         self._max_queue_rows = int(max_queue)
         self._queues: Dict[str, EndpointQueue] = {}
@@ -63,6 +98,17 @@ class InferenceServer:
         self._cond = threading.Condition(self._lock)
         self._state = _STOPPED
         self._thread: Optional[threading.Thread] = None
+        self._retry = retry_policy if retry_policy is not None \
+            else RetryPolicy.from_config()
+        self._breaker = breaker if breaker is not None \
+            else CircuitBreaker(scope="serving")
+        self._watchdog = Watchdog(
+            stall_s=watchdog_stall_s,
+            on_stall=lambda name, dt: self._breaker.force_degraded(
+                f"stall {name} {dt:.1f}s"))
+        self._drain_timeout_s = float(
+            drain_timeout_s if drain_timeout_s is not None
+            else _config.get("MXNET_SERVING_DRAIN_TIMEOUT_S"))
 
     # ------------------------------------------------------------------
     # endpoint management
@@ -91,17 +137,26 @@ class InferenceServer:
         with self._cond:
             if self._state != _STOPPED:
                 raise MXNetError(f"server is {self._state}")
+            if self._thread is not None and self._thread.is_alive():
+                raise MXNetError(
+                    "a previous worker is still wedged in a device call "
+                    "(abandoned drain); this server cannot be restarted")
             self._state = _RUNNING
             self._thread = threading.Thread(
                 target=self._loop, name="mxtpu-serving-worker", daemon=True)
             self._thread.start()
         return self
 
-    def stop(self, drain: bool = True):
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop serving. ``drain=True`` (default) processes every admitted
-        request before returning; ``drain=False`` fails them immediately."""
+        request before returning, but never waits longer than ``timeout``
+        seconds (default ``drain_timeout_s``): past it the remaining requests
+        are abandoned — failed with ServerClosedError and counted in
+        ``mxtpu_drain_abandoned_total`` — so a wedged endpoint queue cannot
+        hang shutdown forever. ``drain=False`` fails them immediately."""
+        timeout = self._drain_timeout_s if timeout is None else float(timeout)
         with self._cond:
-            if self._state == _STOPPED:
+            if self._state == _STOPPED and self._thread is None:
                 return
             if drain:
                 self._state = _DRAINING
@@ -112,12 +167,51 @@ class InferenceServer:
                     q.fail_all(exc)
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                # drain wedged (hung device step / endpoint queue): abandon.
+                # The daemon worker may eventually finish its in-flight call;
+                # it will find the state _STOPPED and exit, and resolve() on
+                # already-failed futures is a no-op.
+                abandoned = 0
+                with self._cond:
+                    self._state = _STOPPED
+                    exc = ServerClosedError(
+                        f"drain abandoned after {timeout:.1f}s "
+                        "(worker wedged)")
+                    for q in self._queues.values():
+                        abandoned += len(q)
+                        q.fail_all(exc)
+                    self._cond.notify_all()
+                if abandoned:
+                    _DRAIN_ABANDONED.inc(abandoned)
+                self._thread.join(1.0)
+                if self._thread.is_alive():
+                    # keep the handle: start() must refuse to run a second
+                    # worker beside a wedged one
+                    self._watchdog.stop()
+                    return
             self._thread = None
+        self._watchdog.stop()
 
     @property
     def state(self) -> str:
         return self._state
+
+    def health(self) -> dict:
+        """Operator health snapshot: server lifecycle state, circuit-breaker
+        state machine (HEALTHY/DEGRADED/OPEN/HALF_OPEN + recent transitions),
+        per-endpoint queue depth, and watchdog stall count."""
+        with self._cond:
+            state = self._state
+            endpoints = {name: {"pending_requests": len(q),
+                                "pending_rows": q.pending_rows}
+                         for name, q in self._queues.items()}
+        return {"state": state,
+                "circuit": self._breaker.state(),
+                "breaker": self._breaker.snapshot(),
+                "endpoints": endpoints,
+                "watchdog_stalls": self._watchdog.stalls}
 
     def __enter__(self):
         return self.start()
@@ -136,17 +230,32 @@ class InferenceServer:
         example (no batch axis) resolves without a batch axis; a batch of n
         rows resolves to n-row outputs.
 
-        Raises ServerOverloadError when the bounded queue is full and
+        Raises ServerOverloadError when the bounded queue is full or the
+        circuit breaker is shedding load (OPEN: everything; HALF_OPEN:
+        beyond the probe budget; DEGRADED: beyond half the queue bound) and
         ServerClosedError when the server is not accepting work."""
         with self._cond:
             if name not in self._queues:
                 raise MXNetError(f"unknown endpoint {name!r}; registered: "
                                  f"{sorted(self._queues)}")
             q = self._queues[name]
+        if not self._breaker.allow():
+            q.endpoint.stats.bump("rejected")
+            raise ServerOverloadError(
+                f"circuit {self._breaker.state()}: shedding load until the "
+                "device recovers; retry with backoff")
         req = self._make_request(q.endpoint, inputs, deadline_ms)
         with self._cond:
             if self._state != _RUNNING:
                 raise ServerClosedError(f"server is {self._state}")
+            # graceful degradation: while DEGRADED admit only up to half the
+            # queue bound, so a struggling device sees less queued latency
+            if self._breaker.state() == DEGRADED and \
+                    q.pending_rows + req.rows > q.max_queue_rows // 2:
+                q.endpoint.stats.bump("rejected")
+                raise ServerOverloadError(
+                    f"endpoint {name!r} degraded: admission tightened to "
+                    f"{q.max_queue_rows // 2} rows; retry with backoff")
             if not q.offer(req):
                 raise ServerOverloadError(
                     f"endpoint {name!r} queue full "
@@ -239,6 +348,20 @@ class InferenceServer:
         from ..ops.registry import _profiler_running
         profiling = _profiler_running()
         t0 = _now_us()
+        # retries must respect what clients asked for: never back off past
+        # the earliest request deadline in the batch
+        deadlines = [r.deadline_us for r in batch if r.deadline_us is not None]
+        deadline_us = min(deadlines) if deadlines else None
+
+        def run_step():
+            _faults.check("serving_dispatch")
+            if profiling:
+                from .. import profiler
+                return profiler._dispatch_profiled(
+                    f"serving[{ep.name}]b{rows}",
+                    lambda: ep.run_batch(host_inputs, rows), cat="serving")
+            return ep.run_batch(host_inputs, rows)
+
         try:
             # adopt the oldest request's trace id for the whole batch step:
             # its end-to-end trace (submit -> batch -> device) is the one
@@ -247,17 +370,16 @@ class InferenceServer:
             with telemetry.span("serving.batch", trace_id=batch[0].trace_id,
                                 endpoint=ep.name, rows=rows,
                                 requests=len(batch)):
-                if profiling:
-                    from .. import profiler
-                    outs, bucket = profiler._dispatch_profiled(
-                        f"serving[{ep.name}]b{rows}",
-                        lambda: ep.run_batch(host_inputs, rows), cat="serving")
-                else:
-                    outs, bucket = ep.run_batch(host_inputs, rows)
-        except Exception as e:  # compile/runtime failure fails the whole batch
+                with self._watchdog.watch(f"serving[{ep.name}]"):
+                    outs, bucket = self._retry.run(
+                        run_step, site="serving_dispatch",
+                        deadline_us=deadline_us)
+        except Exception as e:  # retries exhausted / fatal: fail the batch
+            self._breaker.record_failure()
             for r in batch:
                 fail(r.future, e)
             return
+        self._breaker.record_success()
         step_us = _now_us() - t0
         ep.stats.record_step(step_us)
         off = 0

@@ -2,7 +2,10 @@
 
 The process-wide observability layer (ISSUE r7; the operability counterpart
 to the serving layer): every hot subsystem — eager jit cache, serving
-endpoint/server, ParallelTrainStep, kvstore, DataLoader — reports into ONE
+endpoint/server, ParallelTrainStep, kvstore, DataLoader, and the resilience
+layer (retry/checkpoint/watchdog/circuit-breaker, ISSUE r8:
+``mxtpu_retries_total``, ``mxtpu_checkpoint_*``, ``mxtpu_circuit_state``,
+``checkpoint.save``/``checkpoint.restore`` spans) — reports into ONE
 thread-safe registry, exported two ways:
 
     from mxnet_tpu import telemetry

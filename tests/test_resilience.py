@@ -1,0 +1,706 @@
+"""mxnet_tpu.resilience tests: atomic checkpointing + corrupt fallback,
+retry/backoff classification, watchdog stalls, circuit-breaker degradation,
+deterministic fault injection, and the cross-layer acceptance criteria —
+
+  - a 20-step training run under injected device OOM (every 3rd attempt)
+    plus one simulated crash/restore ends BITWISE equal to the
+    uninterrupted run;
+  - serving under injected dispatch faults completes every non-expired
+    request with zero client-visible errors besides deadline/overload;
+  - the circuit breaker demonstrably walks OPEN -> HALF_OPEN -> HEALTHY.
+
+All on the 8-device CPU mesh (tier-1)."""
+import logging
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, serving
+from mxnet_tpu import resilience
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.resilience import (CheckpointManager, CircuitBreaker,
+                                  RetryPolicy, Watchdog, faults)
+from mxnet_tpu.resilience.faults import FaultInjected, SimulatedCrash
+from mxnet_tpu.serving import ServerClosedError, ServerOverloadError
+
+
+def _mlp(seed=0, in_dim=8, out_dim=4):
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, in_dim), "float32")))
+    return net
+
+
+def _train_step(net, seed=0, **kw):
+    import jax
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    kw.setdefault("retry_policy", RetryPolicy(max_attempts=4, base_ms=0.5,
+                                              seed=seed))
+    return parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=0.05), mesh,
+        **kw)
+
+
+# ---------------------------------------------------------------------------
+# fault injection harness
+# ---------------------------------------------------------------------------
+def test_fault_injection_every_n_deterministic():
+    with faults.inject("device_oom", site="train_step", every_n=3) as inj:
+        hits = []
+        for i in range(1, 10):
+            try:
+                faults.check("train_step")
+            except FaultInjected as e:
+                hits.append(i)
+                assert e.retryable
+                assert "RESOURCE_EXHAUSTED" in str(e)
+        assert hits == [3, 6, 9]
+        assert inj.calls == 9 and inj.fires == 3
+    faults.check("train_step")        # out of scope: no-op
+
+
+def test_fault_injection_at_times_and_seeded_p():
+    with faults.inject("unavailable", site="serving_dispatch",
+                       at=(2, 5), times=1) as inj:
+        fired = []
+        for i in range(1, 7):
+            try:
+                faults.check("serving_dispatch")
+            except FaultInjected:
+                fired.append(i)
+        assert fired == [2]           # times=1 caps the at-list
+        assert inj.fires == 1
+
+    def schedule(seed):
+        out = []
+        with faults.inject("device_oom", site="train_step", p=0.5,
+                           seed=seed):
+            for i in range(20):
+                try:
+                    faults.check("train_step")
+                    out.append(0)
+                except FaultInjected:
+                    out.append(1)
+        return out
+
+    assert schedule(3) == schedule(3)          # replayable
+    assert schedule(3) != schedule(4)          # and actually random
+
+
+def test_fault_injection_unknown_kind_and_site():
+    with pytest.raises(mx.base.MXNetError):
+        with faults.inject("nope"):
+            pass
+    with pytest.raises(mx.base.MXNetError):
+        with faults.inject("device_oom", site="not_a_site"):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+def test_retry_policy_retries_transient_then_succeeds():
+    sleeps = []
+    pol = RetryPolicy(max_attempts=4, base_ms=10, multiplier=2.0, jitter=0.0,
+                      sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return "ok"
+
+    assert pol.run(flaky, site="t_retry") == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [0.01, 0.02]      # deterministic exponential backoff
+
+
+def test_retry_policy_fatal_raises_immediately():
+    pol = RetryPolicy(max_attempts=5, base_ms=1, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("INVALID_ARGUMENT: shape mismatch (4,) vs (8,)")
+
+    with pytest.raises(ValueError):
+        pol.run(fatal, site="t_fatal")
+    assert calls["n"] == 1             # no retry on fatal
+
+
+def test_retry_policy_exhausts_attempts():
+    pol = RetryPolicy(max_attempts=3, base_ms=0.1, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise RuntimeError("UNAVAILABLE: device gone")
+
+    with pytest.raises(RuntimeError):
+        pol.run(always, site="t_exhaust")
+    assert calls["n"] == 3
+
+
+def test_retry_policy_respects_deadline():
+    pol = RetryPolicy(max_attempts=10, base_ms=500, jitter=0.0,
+                      sleep=lambda s: None)
+    deadline = time.perf_counter_ns() // 1000 + 100_000   # 100 ms away
+
+    def always():
+        raise RuntimeError("UNAVAILABLE")
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError):
+        pol.run(always, site="t_deadline", deadline_us=deadline)
+    # 500ms backoff cannot fit in a 100ms deadline: gave up on attempt 1
+    assert time.monotonic() - t0 < 0.4
+
+
+def test_retry_classification_table():
+    assert resilience.classify_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert resilience.classify_error(RuntimeError("UNAVAILABLE: preempted"))
+    assert not resilience.classify_error(
+        RuntimeError("INVALID_ARGUMENT: bad shapes"))
+    assert not resilience.classify_error(ValueError("anything else"))
+    # structured classification from the harness wins over messages
+    inj_fatal = FaultInjected("shape_mismatch", "train_step", 1, False,
+                              "whatever")
+    assert not resilience.classify_error(inj_fatal)
+
+
+# ---------------------------------------------------------------------------
+# train-step integration: OOM retries are numerically invisible
+# ---------------------------------------------------------------------------
+def test_train_step_retries_through_injected_oom_bitwise():
+    rng = onp.random.RandomState(0)
+    X = rng.randn(6, 16, 8).astype("float32")
+    Y = rng.randn(6, 16, 4).astype("float32")
+
+    def run(with_faults):
+        mx.random.seed(3)
+        net = _mlp(seed=3)
+        step = _train_step(net, seed=3)
+        if with_faults:
+            with faults.inject("device_oom", site="train_step",
+                               every_n=3) as inj:
+                losses = [float(step(X[i], Y[i]).asscalar())
+                          for i in range(6)]
+            assert inj.fires >= 2      # the harness actually fired
+        else:
+            losses = [float(step(X[i], Y[i]).asscalar()) for i in range(6)]
+        step.sync_to_block()
+        ws = [p.data().asnumpy() for p in net.collect_params().values()]
+        return losses, ws
+
+    ref_l, ref_w = run(False)
+    got_l, got_w = run(True)
+    assert got_l == ref_l              # bitwise: float equality, no tolerance
+    for a, b in zip(ref_w, got_w):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_train_step_fatal_fault_propagates():
+    net = _mlp(seed=4)
+    step = _train_step(net, seed=4)
+    x = onp.zeros((8, 8), "float32")
+    y = onp.zeros((8, 4), "float32")
+    step(x, y)
+    with faults.inject("shape_mismatch", site="train_step", every_n=1,
+                       times=1):
+        with pytest.raises(FaultInjected):
+            step(x, y)
+    # and the step still works afterwards (state not corrupted)
+    loss = float(step(x, y).asscalar())
+    assert onp.isfinite(loss)
+
+
+def test_transient_compile_failure_retried():
+    net = _mlp(seed=5)
+    step = _train_step(net, seed=5)
+    x = onp.zeros((8, 8), "float32")
+    y = onp.zeros((8, 4), "float32")
+    with faults.inject("compile_error", every_n=1, times=1) as inj:
+        loss = float(step(x, y).asscalar())   # first build fails, retry wins
+    assert inj.fires == 1 and onp.isfinite(loss)
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+# ---------------------------------------------------------------------------
+def test_checkpoint_roundtrip_and_rotation(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=2, fsync=False)
+    state = {"arrs": {"w": onp.arange(6, dtype="float32").reshape(2, 3)},
+             "scalars": {"step": 7, "name": "x", "flag": True,
+                         "none": None}}
+    for s in (1, 2, 3):
+        cm.save(s, dict(state))
+    assert cm.steps() == [2, 3]        # rotation kept the newest 2
+    step, got = cm.restore_latest()
+    assert step == 3
+    onp.testing.assert_array_equal(got["arrs"]["w"], state["arrs"]["w"])
+    assert got["scalars"]["step"] == 7
+    assert got["scalars"]["name"] == "x"
+    assert got["scalars"]["flag"] is True
+    assert got["scalars"]["none"] is None
+
+
+def test_checkpoint_async_overlaps_and_waits(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=4, async_save=True,
+                           fsync=False)
+    for s in range(3):
+        cm.save(s, {"a": {"x": onp.full((4,), s, "float32")}})
+    cm.wait()
+    assert cm.steps() == [0, 1, 2]
+    _, got = cm.restore_latest()
+    assert got["a"]["x"][0] == 2.0
+
+
+def test_checkpoint_crash_mid_write_falls_back(tmp_path, caplog):
+    """Satellite: kill the writer mid-checkpoint (harness truncates the temp
+    file); restore_latest() returns the previous intact checkpoint, logs a
+    warning for corrupt ones, and never raises."""
+    cm = CheckpointManager(str(tmp_path), keep=3, fsync=False)
+    cm.save(1, {"a": {"x": onp.ones((3,), "float32")}})
+
+    with faults.inject("crash", every_n=1, times=1):
+        with pytest.raises(SimulatedCrash):
+            cm.save(2, {"a": {"x": onp.full((3,), 2.0, "float32")}})
+    # the crashed save left only a temp dir -> not a checkpoint
+    assert cm.steps() == [1]
+    out = cm.restore_latest()
+    assert out is not None and out[0] == 1
+
+    # torn write that DID land under the final name (non-atomic remote FS):
+    # corrupt the newest checkpoint's payload; restore must warn + fall back
+    cm.save(3, {"a": {"x": onp.full((3,), 3.0, "float32")}})
+    data = os.path.join(str(tmp_path), "ckpt-00000003", "state.npz")
+    with open(data, "r+b") as f:
+        f.truncate(os.path.getsize(data) // 2)
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.resilience.checkpoint"):
+        step, got = cm.restore_latest()
+    assert step == 1
+    assert got["a"]["x"][0] == 1.0
+    assert any("failed verification" in r.message for r in caplog.records)
+
+
+def test_checkpoint_restore_empty_dir_returns_none(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "fresh"), fsync=False)
+    assert cm.restore_latest() is None
+
+
+def test_checkpoint_checksum_detects_bitrot(tmp_path):
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    cm.save(1, {"a": {"x": onp.zeros((8,), "float32")}})
+    data = os.path.join(str(tmp_path), "ckpt-00000001", "state.npz")
+    raw = bytearray(open(data, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF         # same size, flipped bit
+    open(data, "wb").write(bytes(raw))
+    assert cm.restore_latest() is None  # only ckpt is corrupt -> None
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: 20-step chaos training run, bitwise equal
+# ---------------------------------------------------------------------------
+def test_training_chaos_crash_restore_bitwise(tmp_path):
+    """Device OOM every 3rd attempt + simulated crash/restore at step 10:
+    final loss and weights bitwise-equal to the uninterrupted 20-step run."""
+    STEPS, CRASH_AT = 20, 10
+    rng = onp.random.RandomState(1)
+    X = rng.randn(STEPS, 16, 8).astype("float32")
+    Y = rng.randn(STEPS, 16, 4).astype("float32")
+
+    def build():
+        mx.random.seed(11)
+        net = _mlp(seed=11)
+        return net, _train_step(net, seed=11)
+
+    net_ref, step_ref = build()
+    ref_losses = [float(step_ref(X[i], Y[i]).asscalar())
+                  for i in range(STEPS)]
+    step_ref.sync_to_block()
+    ref_w = [p.data().asnumpy() for p in net_ref.collect_params().values()]
+
+    cm = CheckpointManager(str(tmp_path), keep=2, fsync=False)
+    net_c, step_c = build()
+    losses = []
+    with faults.inject("device_oom", site="train_step", every_n=3) as inj:
+        for i in range(CRASH_AT):
+            losses.append(float(step_c(X[i], Y[i]).asscalar()))
+        cm.save(CRASH_AT, train_step=step_c)
+        # crash: throw away the process state, rebuild differently-seeded,
+        # restore — everything observable must come from the checkpoint
+        del net_c, step_c
+        mx.random.seed(999)
+        net_c = _mlp(seed=999)
+        step_c = _train_step(net_c, seed=11)
+        restored = cm.restore_latest(train_step=step_c)
+        assert restored is not None and restored[0] == CRASH_AT
+        for i in range(CRASH_AT, STEPS):
+            losses.append(float(step_c(X[i], Y[i]).asscalar()))
+    assert inj.fires >= 5              # OOM fired throughout
+
+    assert losses[-1] == ref_losses[-1]          # bitwise
+    step_c.sync_to_block()
+    for a, p in zip(ref_w, net_c.collect_params().values()):
+        onp.testing.assert_array_equal(a, p.data().asnumpy())
+
+
+def test_parallel_train_step_state_dict_shape_guard():
+    net = _mlp(seed=6)
+    step = _train_step(net, seed=6)
+    step(onp.zeros((4, 8), "float32"), onp.zeros((4, 4), "float32"))
+    state = step.state_dict()
+    other = _mlp(seed=6, in_dim=8, out_dim=3)    # different topology
+    step2 = _train_step(other, seed=6)
+    with pytest.raises(mx.base.MXNetError):
+        step2.load_state_dict(state)
+
+
+# ---------------------------------------------------------------------------
+# satellites: trainer + dataloader checkpoint surfaces
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt,kw", [
+    ("adam", {"learning_rate": 0.05}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+])
+def test_trainer_state_roundtrip_one_step_bitwise(opt, kw):
+    """save -> restore -> one step must be bitwise-equal to an uninterrupted
+    run (momentum/Adam slots included)."""
+    X = onp.random.RandomState(2).randn(4, 8, 5).astype("float32")
+    Y = onp.random.RandomState(3).randn(4, 8, 3).astype("float32")
+
+    def build():
+        onp.random.seed(1)
+        net = nn.Dense(3, in_units=5)
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, 5), "float32")))
+        return net
+
+    def one_step(net, tr, x, y):
+        l2 = gloss.L2Loss()
+        with mx.autograd.record():
+            L = l2(net(nd.array(x)), nd.array(y)).mean()
+        L.backward()
+        tr.step(1, ignore_stale_grad=True)
+
+    net1 = build()
+    tr1 = mx.gluon.Trainer(net1.collect_params(), opt, dict(kw), kvstore=None)
+    for i in range(2):
+        one_step(net1, tr1, X[i], Y[i])
+    saved = tr1.state_dict()
+    psnap = [p.data().asnumpy().copy()
+             for p in net1.collect_params().values()]
+    one_step(net1, tr1, X[2], Y[2])
+    ref = [p.data().asnumpy() for p in net1.collect_params().values()]
+
+    net2 = build()
+    tr2 = mx.gluon.Trainer(net2.collect_params(), opt, dict(kw), kvstore=None)
+    for p, w in zip(net2.collect_params().values(), psnap):
+        p.set_data(nd.array(w))
+    tr2.load_state_dict(saved)
+    assert tr2.optimizer.num_update == tr1.optimizer.num_update - 1
+    one_step(net2, tr2, X[2], Y[2])
+    for a, p in zip(ref, net2.collect_params().values()):
+        onp.testing.assert_array_equal(a, p.data().asnumpy())
+
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_dataloader_resume_exact_remaining_sequence(num_workers):
+    """Regression: a resumed shuffled iteration yields the exact remaining
+    batch sequence of the interrupted epoch."""
+    ds = ArrayDataset(onp.arange(40, dtype="float32"))
+
+    def batches(loader):
+        return [b.asnumpy().tolist() for b in loader]
+
+    onp.random.seed(7)
+    full = batches(DataLoader(ds, batch_size=4, shuffle=True,
+                              num_workers=num_workers))
+    assert len(full) == 10
+
+    onp.random.seed(7)
+    l2 = DataLoader(ds, batch_size=4, shuffle=True, num_workers=num_workers)
+    it = iter(l2)
+    first3 = [next(it).asnumpy().tolist() for _ in range(3)]
+    assert first3 == full[:3]
+    saved = l2.state_dict()
+    assert saved["pos"] == 3 and saved["epoch"] == 0
+
+    l3 = DataLoader(ds, batch_size=4, shuffle=True, num_workers=num_workers)
+    l3.load_state_dict(saved)
+    assert batches(l3) == full[3:]     # exact remaining sequence
+    assert l3.epoch == 1               # epoch rolls over after the resume
+    # next epoch starts fresh (no stale resume state)
+    assert len(batches(l3)) == 10
+
+
+def test_dataloader_state_through_checkpoint_manager(tmp_path):
+    ds = ArrayDataset(onp.arange(24, dtype="float32"))
+    onp.random.seed(5)
+    loader = DataLoader(ds, batch_size=4, shuffle=True)
+    it = iter(loader)
+    consumed = [next(it).asnumpy().tolist() for _ in range(2)]
+
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    cm.save(1, dataloader=loader)      # snapshot taken mid-epoch
+    remaining_ref = [b.asnumpy().tolist() for b in it]
+    assert len(consumed) + len(remaining_ref) == 6
+    fresh = DataLoader(ds, batch_size=4, shuffle=True)
+    step, _ = cm.restore_latest(dataloader=fresh)
+    assert step == 1
+    assert [b.asnumpy().tolist() for b in fresh] == remaining_ref
+
+
+def test_rng_state_roundtrip():
+    import jax
+    mx.random.seed(13)
+    st = mx.random.get_state()
+    k1 = mx.random.take_key()
+    k1b = mx.random.take_key()
+    mx.random.set_state(st)
+    k2 = mx.random.take_key()
+    k2b = mx.random.take_key()
+
+    def data(k):
+        try:
+            return onp.asarray(jax.random.key_data(k))
+        except TypeError:
+            return onp.asarray(k)
+
+    onp.testing.assert_array_equal(data(k1), data(k2))
+    onp.testing.assert_array_equal(data(k1b), data(k2b))
+
+
+# ---------------------------------------------------------------------------
+# watchdog + circuit breaker
+# ---------------------------------------------------------------------------
+def test_watchdog_fires_on_stall_once():
+    fired = []
+    wd = Watchdog(stall_s=0.06, poll_s=0.01,
+                  on_stall=lambda name, dt: fired.append((name, dt)))
+    try:
+        with wd.watch("fast"):
+            pass                        # finishes well under the threshold
+        time.sleep(0.1)
+        assert fired == []
+        with wd.watch("slow"):
+            time.sleep(0.2)
+        assert len(fired) == 1          # exactly one fire per watch instance
+        assert fired[0][0] == "slow" and fired[0][1] >= 0.06
+        assert wd.stalls == 1
+    finally:
+        wd.stop()
+
+
+def test_circuit_breaker_full_cycle():
+    br = CircuitBreaker(scope="t_cycle", degraded_after=2, open_after=3,
+                        cooldown_s=0.15)
+    assert br.state() == resilience.HEALTHY and br.allow()
+    br.record_failure()
+    assert br.state() == resilience.HEALTHY
+    br.record_failure()
+    assert br.state() == resilience.DEGRADED and br.allow()
+    br.record_failure()
+    assert br.state() == resilience.OPEN
+    assert not br.allow()               # shedding
+    time.sleep(0.2)
+    assert br.state() == resilience.HALF_OPEN
+    assert br.allow()                   # one probe
+    assert not br.allow()               # ...only one
+    br.record_failure()                 # probe failed -> back to OPEN
+    assert br.state() == resilience.OPEN
+    time.sleep(0.2)
+    assert br.state() == resilience.HALF_OPEN
+    assert br.allow()
+    br.record_success()                 # probe succeeded -> recovered
+    assert br.state() == resilience.HEALTHY
+    tr = br.snapshot()["transitions"]
+    assert ("open", "half_open") in tr and ("half_open", "healthy") in tr
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+def test_serving_dispatch_retries_complete_all_requests():
+    """ACCEPTANCE (serving half): injected dispatch failures; every request
+    completes bitwise-correct with zero client-visible errors."""
+    net = _mlp(seed=20, in_dim=6)
+    ep = serving.ModelEndpoint("t_res_retry", net, input_shapes=(6,),
+                               max_batch_size=4)
+    srv = serving.InferenceServer(
+        batch_timeout_ms=1.0, max_queue=64,
+        retry_policy=RetryPolicy(max_attempts=6, base_ms=1.0))
+    srv.register(ep)
+    srv.start()
+    try:
+        xs = onp.random.RandomState(21).randn(10, 6).astype("float32")
+        with faults.inject("unavailable", site="serving_dispatch",
+                           every_n=2) as inj:
+            futs = [srv.submit("t_res_retry", xs[i]) for i in range(10)]
+            outs = [f.result(timeout=60).asnumpy() for f in futs]
+        assert inj.fires >= 1
+        direct = net(nd.array(xs)).asnumpy()
+        onp.testing.assert_array_equal(onp.stack(outs), direct)
+        assert srv.health()["circuit"] == resilience.HEALTHY
+    finally:
+        srv.stop()
+        serving.unregister("t_res_retry")
+
+
+def test_serving_circuit_opens_sheds_and_recovers():
+    """ACCEPTANCE: the server's breaker transitions OPEN -> HALF_OPEN ->
+    HEALTHY, shedding load with ServerOverloadError while OPEN."""
+    net = _mlp(seed=22, in_dim=6)
+    ep = serving.ModelEndpoint("t_res_cb", net, input_shapes=(6,),
+                               max_batch_size=4)
+    br = CircuitBreaker(scope="t_res_cb", degraded_after=1, open_after=2,
+                        cooldown_s=0.25)
+    srv = serving.InferenceServer(
+        batch_timeout_ms=1.0, max_queue=64, breaker=br,
+        retry_policy=RetryPolicy(max_attempts=2, base_ms=0.5))
+    srv.register(ep)
+    srv.start()
+    try:
+        x = onp.random.RandomState(23).randn(6).astype("float32")
+        # two consecutive fatally-failing batches -> breaker opens
+        with faults.inject("shape_mismatch", site="serving_dispatch",
+                           every_n=1, times=4):
+            for _ in range(2):
+                with pytest.raises(FaultInjected):
+                    srv.predict("t_res_cb", x, timeout=30)
+        assert br.state() == resilience.OPEN
+        with pytest.raises(ServerOverloadError):
+            srv.submit("t_res_cb", x)            # OPEN: load shed
+        time.sleep(0.3)
+        assert srv.health()["circuit"] == resilience.HALF_OPEN
+        out = srv.predict("t_res_cb", x, timeout=30)   # probe succeeds
+        assert out.shape == (4,)
+        assert srv.health()["circuit"] == resilience.HEALTHY
+        seen = br.snapshot()["transitions"]
+        assert ("open", "half_open") in seen
+        assert ("half_open", "healthy") in seen
+    finally:
+        srv.stop()
+        serving.unregister("t_res_cb")
+
+
+def test_serving_drain_timeout_abandons_wedged_queue():
+    """Satellite: stop(drain=True) is bounded — a wedged dispatch cannot
+    hang shutdown; abandoned requests fail with ServerClosedError and are
+    counted."""
+    from mxnet_tpu.serving.server import _DRAIN_ABANDONED
+    net = _mlp(seed=24, in_dim=6)
+    ep = serving.ModelEndpoint("t_res_drain", net, input_shapes=(6,),
+                               max_batch_size=2)
+    srv = serving.InferenceServer(batch_timeout_ms=1.0, max_queue=64)
+    srv.register(ep)
+    srv.start()
+    before = _DRAIN_ABANDONED.value
+    x = onp.random.RandomState(25).randn(6).astype("float32")
+    try:
+        with faults.inject("hang", site="serving_dispatch", seconds=3.0,
+                           every_n=1, times=1):
+            f1 = srv.submit("t_res_drain", x)
+            time.sleep(0.15)                 # worker picks it up and hangs
+            f2 = srv.submit("t_res_drain", x)    # stuck behind the hang
+            t0 = time.monotonic()
+            srv.stop(drain=True, timeout=0.3)
+            assert time.monotonic() - t0 < 2.5
+        with pytest.raises(ServerClosedError):
+            f2.result(timeout=0.1)
+        assert _DRAIN_ABANDONED.value >= before + 1
+    finally:
+        time.sleep(3.2)                      # let the wedged worker unwind
+        serving.unregister("t_res_drain")
+
+
+def test_serving_degraded_tightens_admission():
+    net = _mlp(seed=26, in_dim=6)
+    ep = serving.ModelEndpoint("t_res_degraded", net, input_shapes=(6,),
+                               max_batch_size=4)
+    br = CircuitBreaker(scope="t_res_degraded", degraded_after=1,
+                        open_after=10, cooldown_s=5.0)
+    srv = serving.InferenceServer(batch_timeout_ms=500.0, max_queue=8,
+                                  breaker=br)
+    srv.register(ep)
+    try:
+        br.record_failure()                  # -> DEGRADED
+        assert br.state() == resilience.DEGRADED
+        srv.start()
+        xs = onp.random.RandomState(27).randn(6, 6).astype("float32")
+        with faults.inject("hang", site="serving_dispatch", seconds=0.5):
+            admitted, shed = 0, 0
+            for i in range(6):
+                try:
+                    srv.submit("t_res_degraded", xs[i])
+                    admitted += 1
+                except ServerOverloadError:
+                    shed += 1
+            # degraded admission bound is max_queue//2 = 4
+            assert admitted <= 4 and shed >= 2
+    finally:
+        srv.stop(timeout=5.0)
+        serving.unregister("t_res_degraded")
+
+
+# ---------------------------------------------------------------------------
+# telemetry wiring
+# ---------------------------------------------------------------------------
+def test_resilience_metrics_registered_and_bumped():
+    from mxnet_tpu import telemetry
+    reg = telemetry.REGISTRY
+    for name in ("mxtpu_retries_total", "mxtpu_faults_injected_total",
+                 "mxtpu_watchdog_stalls_total", "mxtpu_circuit_state",
+                 "mxtpu_checkpoint_saves_total",
+                 "mxtpu_checkpoint_restores_total",
+                 "mxtpu_checkpoint_bytes_written_total",
+                 "mxtpu_checkpoint_save_duration_us",
+                 "mxtpu_checkpoint_last_step",
+                 "mxtpu_drain_abandoned_total"):
+        assert reg.get(name) is not None, name
+    assert telemetry.lint_names() == []
+
+    # a retried call bumps mxtpu_retries_total{site,error}
+    from mxnet_tpu.resilience.retry import _RETRIES
+    child = _RETRIES.labels("t_metrics", "RuntimeError")
+    before = child.value
+    pol = RetryPolicy(max_attempts=2, base_ms=0.1, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise RuntimeError("UNAVAILABLE")
+        return 1
+
+    pol.run(flaky, site="t_metrics")
+    assert child.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# chaos smoke (tools/chaos_check.py in-process, fixed seed)
+# ---------------------------------------------------------------------------
+def test_chaos_smoke(tmp_path):
+    import io
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import chaos_check
+    buf = io.StringIO()
+    result = chaos_check.run_chaos(seed=7, steps=8, requests=8, p=0.3,
+                                   ckpt_dir=str(tmp_path), out=buf)
+    assert result["ok"], buf.getvalue()
+    assert result["train"]["loss_bitwise_equal"]
+    assert result["train"]["weights_bitwise_equal"]
+    assert result["serving"]["client_errors"] == 0
+    # the harness actually exercised failure paths (seed 7 schedule)
+    assert result["train"]["faults_fired"] >= 1

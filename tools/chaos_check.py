@@ -1,0 +1,186 @@
+"""Chaos checker: training + serving under seeded random fault injection.
+
+The end-to-end resilience acceptance gate (ISSUE r8), runnable standalone or
+from tier-1 (tests/test_resilience.py::test_chaos_smoke):
+
+  1. TRAIN — run a short fused-step training loop twice: once fault-free,
+     once under randomized device-OOM injection (probability ``--p``, seeded
+     — the schedule replays exactly from the logged seed) PLUS one simulated
+     crash at the midpoint (checkpoint -> throw everything away -> rebuild ->
+     restore_latest -> continue). The chaos run's final loss and weights must
+     be BITWISE equal to the fault-free run: retries and crash/restore are
+     invisible to the numerics.
+
+  2. SERVE — run a closed budget of requests through InferenceServer while
+     dispatch faults (UNAVAILABLE) fire randomly under the same seeding.
+     Every request must complete with its output bitwise equal to the direct
+     forward — zero client-visible errors (no deadlines are set, so none are
+     permitted).
+
+Every run prints its seed; a failing seed is a deterministic repro::
+
+    python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
+
+Prints one JSON line per phase and a final summary; exit 0 iff both phases
+hold their invariant.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def _build_train(seed, in_dim, hidden, out_dim):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.resilience import RetryPolicy
+
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.array(onp.zeros((2, in_dim), "float32")))
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=0.05), mesh,
+        retry_policy=RetryPolicy(max_attempts=8, base_ms=1.0, seed=seed))
+    return net, step
+
+
+def check_train(seed, steps, p, in_dim=8, hidden=16, out_dim=4,
+                ckpt_dir=None):
+    """Fault-free run vs (random OOM + midpoint crash/restore) run."""
+    from mxnet_tpu.resilience import CheckpointManager, faults
+
+    rng = onp.random.RandomState(seed)
+    X = rng.randn(steps, 16, in_dim).astype("float32")
+    Y = rng.randn(steps, 16, out_dim).astype("float32")
+
+    # reference: uninterrupted
+    net_ref, step_ref = _build_train(seed, in_dim, hidden, out_dim)
+    ref_losses = [float(step_ref(X[i], Y[i]).asscalar()) for i in range(steps)]
+    step_ref.sync_to_block()
+    ref_w = [p_.data().asnumpy() for p_ in net_ref.collect_params().values()]
+
+    # chaos: random OOM every attempt with prob p + crash at the midpoint
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="chaos-ckpt-")
+    cm = CheckpointManager(ckpt_dir, keep=2)
+    crash_at = max(1, steps // 2)
+    net_c, step_c = _build_train(seed, in_dim, hidden, out_dim)
+    losses = []
+    with faults.inject("device_oom", site="train_step", p=p,
+                       seed=seed) as inj:
+        for i in range(crash_at):
+            losses.append(float(step_c(X[i], Y[i]).asscalar()))
+        cm.save(crash_at, train_step=step_c)
+        # simulated crash: lose the process state, rebuild, restore
+        del net_c, step_c
+        net_c, step_c = _build_train(seed + 999, in_dim, hidden, out_dim)
+        restored = cm.restore_latest(train_step=step_c)
+        assert restored is not None and restored[0] == crash_at
+        for i in range(crash_at, steps):
+            losses.append(float(step_c(X[i], Y[i]).asscalar()))
+    step_c.sync_to_block()
+    chaos_w = [p_.data().asnumpy() for p_ in net_c.collect_params().values()]
+
+    loss_ok = losses[-1] == ref_losses[-1]
+    w_ok = all(onp.array_equal(a, b) for a, b in zip(ref_w, chaos_w))
+    return {"phase": "train", "seed": seed, "steps": steps, "p": p,
+            "faults_fired": inj.fires, "fault_calls": inj.calls,
+            "crash_at": crash_at, "final_loss": losses[-1],
+            "final_loss_ref": ref_losses[-1],
+            "loss_bitwise_equal": loss_ok, "weights_bitwise_equal": w_ok,
+            "ok": loss_ok and w_ok}
+
+
+def check_serving(seed, requests, p, in_dim=8, hidden=16, out_dim=4):
+    """Every request completes, bitwise-equal to direct forward, despite
+    random dispatch faults."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import RetryPolicy, faults
+
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, in_dim), "float32")))
+
+    name = f"chaos_ep_{seed}_{requests}"
+    ep = serving.ModelEndpoint(name, net, input_shapes=(in_dim,),
+                               max_batch_size=8)
+    srv = serving.InferenceServer(
+        batch_timeout_ms=1.0, max_queue=max(64, requests * 2),
+        retry_policy=RetryPolicy(max_attempts=8, base_ms=1.0, seed=seed))
+    srv.register(ep)
+    srv.start()
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    errors = 0
+    outs = [None] * requests
+    try:
+        with faults.inject("unavailable", site="serving_dispatch", p=p,
+                           seed=seed + 1) as inj:
+            futs = [srv.submit(name, xs[i]) for i in range(requests)]
+            for i, f in enumerate(futs):
+                try:
+                    outs[i] = f.result(timeout=120).asnumpy()
+                except Exception:
+                    errors += 1
+        fires = inj.fires
+    finally:
+        srv.stop()
+        serving.unregister(name)
+    direct = net(nd.array(xs)).asnumpy()
+    bitwise = errors == 0 and all(
+        o is not None and onp.array_equal(o, direct[i])
+        for i, o in enumerate(outs))
+    health = srv.health()
+    return {"phase": "serving", "seed": seed, "requests": requests, "p": p,
+            "faults_fired": fires, "client_errors": errors,
+            "outputs_bitwise_equal": bitwise,
+            "circuit": health["circuit"], "ok": bitwise}
+
+
+def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
+              out=sys.stdout):
+    train = check_train(seed, steps, p, ckpt_dir=ckpt_dir)
+    print(json.dumps(train), file=out)
+    serve = check_serving(seed, requests, p)
+    print(json.dumps(serve), file=out)
+    summary = {"phase": "summary", "seed": seed,
+               "ok": bool(train["ok"] and serve["ok"])}
+    print(json.dumps(summary), file=out)
+    return {"train": train, "serving": serve, "ok": summary["ok"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int,
+                    default=int.from_bytes(os.urandom(2), "little"),
+                    help="fault-schedule seed (logged; failing seeds replay)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--p", type=float, default=0.3,
+                    help="per-boundary fault probability")
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args(argv)
+    result = run_chaos(seed=args.seed, steps=args.steps,
+                       requests=args.requests, p=args.p,
+                       ckpt_dir=args.ckpt_dir)
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
