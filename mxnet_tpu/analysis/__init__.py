@@ -1,0 +1,43 @@
+"""mxnet_tpu.analysis — TPU-pitfall linter & concurrency checker (mxlint).
+
+Static enforcement of the invariants the rest of the stack is built on
+(STATIC_ANALYSIS.md is the rule catalog):
+
+  TPU100  host sync reachable from traced code (hybrid_forward / @jit)
+  TPU101  python control flow on a traced value (recompile storms)
+  TPU102  use-after-donate (reads of buffers consumed by donate_argnums)
+  CONC200 instance attribute mutated with and without its owning lock
+  CONC201 lock-order cycles in the acquisition graph (potential deadlock)
+  MET300  telemetry metric names failing ^mxtpu_[a-z0-9_]+$ statically
+
+Deliberately dependency-free (stdlib ``ast`` only) and import-light: the
+package never imports jax or the rest of mxnet_tpu, so the linter runs in
+any python — CI images, pre-commit hooks — without the accelerator stack.
+
+CLI: ``python tools/mxlint.py [paths ...]`` (text/JSON output, per-line
+``# mxlint: disable=RULE`` suppressions, committed baseline in
+``tools/mxlint_baseline.json``).
+"""
+from __future__ import annotations
+
+from .core import (Checker, Finding, SourceFile, all_checkers, get_checker,
+                   iter_python_files, lint_file, lint_paths, register)
+from .baseline import apply_baseline, load_baseline, save_baseline
+
+# importing the rule modules populates the registry
+from . import tpu_rules    # noqa: F401  (TPU100/TPU101/TPU102)
+from . import conc_rules   # noqa: F401  (CONC200/CONC201)
+from . import met_rules    # noqa: F401  (MET300)
+
+__all__ = [
+    "Checker", "Finding", "SourceFile", "register",
+    "all_checkers", "get_checker", "iter_python_files",
+    "lint_file", "lint_paths",
+    "apply_baseline", "load_baseline", "save_baseline",
+    "DEFAULT_SCAN_SET",
+]
+
+#: what `python tools/mxlint.py` scans when given no paths: the package
+#: itself plus the operational CLIs that ride along with it in CI
+DEFAULT_SCAN_SET = ("mxnet_tpu", "tools/chaos_check.py",
+                    "tools/metrics_dump.py", "tools/mxlint.py")

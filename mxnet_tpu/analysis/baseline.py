@@ -1,0 +1,75 @@
+"""mxlint baseline: committed ledger of accepted pre-existing findings.
+
+The adoption problem every new linter has: the first run over a mature tree
+surfaces findings that are real but not this PR's to fix. The baseline file
+records them by (rule, path, fingerprint) — fingerprints hash source-line
+text, not line numbers, so unrelated edits don't invalidate the ledger —
+and the CI gate fails only on findings *not* in the baseline. Stale entries
+(baselined findings that no longer occur, i.e. someone fixed them) are also
+reported so the ledger only ever shrinks; ``--update-baseline`` rewrites it
+from the current scan.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+__all__ = ["load_baseline", "save_baseline", "apply_baseline"]
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> List[Finding]:
+    """Read a baseline file; a missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unsupported baseline version "
+                         f"{data.get('version')!r}")
+    return [Finding.from_dict(d) for d in data.get("findings", [])]
+
+
+def save_baseline(path: str, findings: Sequence[Finding]):
+    """Write the baseline atomically (write-temp + rename, the checkpoint
+    discipline) so an interrupted update can't leave a torn ledger."""
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": "accepted mxlint findings; update with "
+                   "`python tools/mxlint.py --update-baseline`",
+        "findings": [f.to_dict() for f in
+                     sorted(findings, key=lambda f: (f.path, f.line, f.rule))],
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Sequence[Finding]
+                   ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """Split a scan against the ledger.
+
+    Returns ``(new, matched, stale)``: findings not in the baseline (these
+    gate), findings covered by it, and baseline entries the scan no longer
+    produces (fixed — remove them via ``--update-baseline``).
+    """
+    base_keys: Dict[Tuple[str, str, str], Finding] = {
+        b.key(): b for b in baseline}
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.key() in base_keys:
+            matched.append(f)
+            seen.add(f.key())
+        else:
+            new.append(f)
+    stale = [b for k, b in sorted(base_keys.items()) if k not in seen]
+    return new, matched, stale

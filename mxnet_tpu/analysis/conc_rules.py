@@ -1,0 +1,385 @@
+"""Concurrency rules: lightweight race and deadlock detection.
+
+The stack runs three always-on thread populations (serving worker + client
+threads, telemetry reporter, resilience watchdog monitor), all coordinating
+through per-object ``threading.Lock``/``Condition`` fields. Two invariants
+are checkable syntactically:
+
+  CONC200  unlocked shared mutation — within a class that owns a lock, an
+           instance attribute mutated both inside ``with self._lock:`` and
+           outside any lock is (absent an argument the AST can't see) a
+           data race. ``__init__`` writes are exempt (the object is not yet
+           published); helpers called with the lock held carry a
+           ``# mxlint: disable=CONC200`` on their ``def`` line, which
+           doubles as documentation of the caller-holds-lock contract.
+  CONC201  lock-order cycles — every lexically nested ``with lockA: ...
+           with lockB:`` (including one level of ``self._method()`` call
+           resolution) contributes an edge lockA -> lockB to a per-file
+           acquisition graph; a cycle means two threads can acquire the
+           locks in opposite orders and deadlock.
+
+A ``Condition(lock)`` aliases its lock (acquiring either is acquiring the
+same underlying mutex), which the analysis models via lock *groups* — the
+``InferenceServer._lock``/``_cond`` pair is one lock, not two.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile, register
+
+__all__ = ["UnlockedSharedMutation", "LockOrderCycles"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# container methods that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "extend", "extendleft", "insert", "add",
+             "remove", "discard", "pop", "popleft", "popitem", "clear",
+             "update", "setdefault", "sort", "reverse"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    return _dotted(call.func).rsplit(".", 1)[-1] in _LOCK_CTORS
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' when node is exactly ``self.attr``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassLocks:
+    """Lock fields of one class, partitioned into alias groups.
+
+    ``self._cond = threading.Condition(self._lock)`` puts ``_cond`` and
+    ``_lock`` in the same group.
+    """
+
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.group_of: Dict[str, str] = {}     # attr -> canonical attr
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) or \
+                    not _is_lock_ctor(node.value):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                alias = None
+                for arg in node.value.args:    # Condition(self._lock)
+                    inner = _self_attr(arg)
+                    if inner is not None:
+                        alias = inner
+                if alias is not None:
+                    canon = self.group_of.get(alias, alias)
+                    self.group_of.setdefault(alias, canon)
+                    self.group_of[attr] = canon
+                else:
+                    self.group_of.setdefault(attr, attr)
+
+    def __bool__(self):
+        return bool(self.group_of)
+
+    def group(self, attr: str) -> Optional[str]:
+        return self.group_of.get(attr)
+
+
+def _acquired_groups(withnode: ast.With, locks: _ClassLocks) -> List[str]:
+    out = []
+    for item in withnode.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            g = locks.group(attr)
+            if g is not None:
+                out.append(g)
+    return out
+
+
+def _methods(cls: ast.ClassDef) -> List[ast.FunctionDef]:
+    return [n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+class _MutationScan(ast.NodeVisitor):
+    """Collect per-attribute (locked_sites, unlocked_sites) for one method."""
+
+    def __init__(self, locks: _ClassLocks):
+        self.locks = locks
+        self.held = 0                       # depth of held owning-lock withs
+        self.locked: Dict[str, List[ast.AST]] = {}
+        self.unlocked: Dict[str, List[ast.AST]] = {}
+
+    def _record(self, attr: str, node: ast.AST):
+        if self.locks.group(attr) is not None:
+            return                          # writes to the lock field itself
+        (self.locked if self.held else self.unlocked).setdefault(
+            attr, []).append(node)
+
+    def visit_With(self, node: ast.With):
+        n = len(_acquired_groups(node, self.locks))
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held += n
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= n
+
+    def _visit_assign_target(self, tgt: ast.AST, node: ast.AST):
+        attr = _self_attr(tgt)
+        if attr is None and isinstance(tgt, ast.Subscript):
+            attr = _self_attr(tgt.value)    # self.d[k] = v mutates self.d
+        if attr is not None:
+            self._record(attr, node)
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._visit_assign_target(tgt, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._visit_assign_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._visit_assign_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            self._visit_assign_target(tgt, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # self.attr.append(...) and friends mutate self.attr in place
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                self._record(attr, node)
+        self.generic_visit(node)
+
+    # nested defs/lambdas execute later but still touch shared state from
+    # whatever thread calls them — scan them, but as *unlocked* context
+    # (the enclosing with-block does not guard a deferred call)
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        outer = self.held
+        self.held = 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = outer
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        outer = self.held
+        self.held = 0
+        self.visit(node.body)
+        self.held = outer
+
+
+@register
+class UnlockedSharedMutation(Checker):
+    rule = "CONC200"
+    name = "unlocked-shared-mutation"
+    help = ("In a class owning a threading lock, an instance attribute is "
+            "mutated both under the lock and outside it: the unlocked "
+            "writes race the locked ones. Take the lock, or mark a "
+            "caller-holds-lock helper with `# mxlint: disable=CONC200` on "
+            "its def line.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _ClassLocks(cls)
+            if not locks:
+                continue
+            locked: Dict[str, List[ast.AST]] = {}
+            unlocked: Dict[str, List[Tuple[ast.AST, str]]] = {}
+            for meth in _methods(cls):
+                scan = _MutationScan(locks)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                if meth.name == "__init__":
+                    continue      # pre-publication writes can't race
+                for attr, nodes in scan.locked.items():
+                    locked.setdefault(attr, []).extend(nodes)
+                for attr, nodes in scan.unlocked.items():
+                    unlocked.setdefault(attr, []).extend(
+                        (n, meth.name) for n in nodes)
+            for attr in sorted(set(locked) & set(unlocked)):
+                lock_line = locked[attr][0].lineno
+                for node, meth_name in unlocked[attr]:
+                    yield src.finding(
+                        self.rule, node,
+                        f"`{cls.name}.{attr}` is mutated under the lock "
+                        f"(e.g. line {lock_line}) but without it in "
+                        f"`{meth_name}()`: unlocked write races the locked "
+                        "ones — hold the lock here too")
+
+
+class _EdgeScan(ast.NodeVisitor):
+    """Collect lock-acquisition edges for CONC201 within one class."""
+
+    def __init__(self, cls_name: str, locks: _ClassLocks,
+                 methods: Dict[str, ast.FunctionDef]):
+        self.cls_name = cls_name
+        self.locks = locks
+        self.methods = methods
+        self.held: List[str] = []
+        self.edges: Dict[Tuple[str, str], ast.AST] = {}
+        self._call_depth = 0
+        self._visiting: Set[str] = set()
+
+    def _qual(self, group: str) -> str:
+        return f"{self.cls_name}.{group}"
+
+    def _acquire(self, groups: List[str], node: ast.AST):
+        for g in groups:
+            for h in self.held:
+                if h != g:
+                    self.edges.setdefault(
+                        (self._qual(h), self._qual(g)), node)
+
+    def visit_With(self, node: ast.With):
+        groups = [g for g in _acquired_groups(node, self.locks)
+                  if g not in self.held]
+        self._acquire(groups, node)
+        self.held.extend(groups)
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(groups):]
+
+    def visit_Call(self, node: ast.Call):
+        # one level of self._method() resolution: locks the callee takes
+        # are acquired while the caller's locks are held
+        if self.held and self._call_depth == 0 and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            callee = self.methods.get(node.func.attr)
+            if callee is not None and callee.name not in self._visiting:
+                self._visiting.add(callee.name)
+                self._call_depth += 1
+                for stmt in callee.body:
+                    self.visit(stmt)
+                self._call_depth -= 1
+                self._visiting.discard(callee.name)
+        self.generic_visit(node)
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], ast.AST]
+                 ) -> List[List[str]]:
+    """Simple cycle detection over the acquisition digraph: returns each
+    strongly-connected component with >= 2 nodes as a sorted node list."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str):          # iterative tarjan
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+@register
+class LockOrderCycles(Checker):
+    rule = "CONC201"
+    name = "lock-order-cycle"
+    help = ("Two locks are acquired in opposite orders on different paths: "
+            "two threads interleaving those paths deadlock. Impose one "
+            "global acquisition order.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _ClassLocks(cls)
+            if len(set(locks.group_of.values())) < 2:
+                continue          # a cycle needs two distinct locks
+            methods = {m.name: m for m in _methods(cls)}
+            scan = _EdgeScan(cls.name, locks, methods)
+            for meth in methods.values():
+                scan.held = []
+                scan.visit(meth)
+            for comp in _find_cycles(scan.edges):
+                in_cycle = set(comp)
+                sites = sorted(
+                    (node.lineno, a, b)
+                    for (a, b), node in scan.edges.items()
+                    if a in in_cycle and b in in_cycle)
+                first = min(((a, b), node)
+                            for (a, b), node in scan.edges.items()
+                            if a in in_cycle and b in in_cycle)[1]
+                order = " -> ".join(f"{a}=>{b} (line {ln})"
+                                    for ln, a, b in sites)
+                yield src.finding(
+                    self.rule, first,
+                    f"lock-order cycle among {{{', '.join(comp)}}}: "
+                    f"acquisitions {order} can interleave into a deadlock; "
+                    "impose a single acquisition order")

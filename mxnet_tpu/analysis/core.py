@@ -1,0 +1,262 @@
+"""mxlint core: finding model, checker registry, suppressions, runner.
+
+The static-analysis counterpart of the runtime guardrails (telemetry name
+lint, resilience fault sites): the invariants that make whole-program TPU
+compilation and threaded serving safe — no host sync under a trace, no
+traced-value branching, no use-after-donate, lock-consistent mutation — are
+checkable on the AST, so they gate in CI instead of relying on reviewer
+vigilance.
+
+Architecture (the classic pluggable-linter shape):
+
+  - :class:`Checker` subclasses register themselves with :func:`register`;
+    each owns one rule code (``TPU100``, ``CONC200``, ...) and walks a parsed
+    :class:`SourceFile`, yielding :class:`Finding`\\ s.
+  - Suppressions are comments: ``# mxlint: disable=RULE[,RULE|all]`` on the
+    offending line silences that line; on a ``def``/``class`` line it
+    silences the whole scope (the sanctioned way to encode "caller holds the
+    lock" helpers); ``# mxlint: disable-file=RULE`` anywhere silences the
+    file.
+  - Findings carry a *fingerprint* — a hash of (rule, path, source-line
+    text, occurrence index) that is stable under unrelated line insertions —
+    so the committed baseline (:mod:`.baseline`) survives drift without
+    pinning line numbers.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "SourceFile", "Checker", "register", "all_checkers",
+           "get_checker", "iter_python_files", "lint_file", "lint_paths"]
+
+_DISABLE_RE = re.compile(
+    r"#\s*mxlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)")
+_SCOPE_LINE_RE = re.compile(r"^\s*(?:async\s+def|def|class)\b")
+
+
+class Finding:
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet",
+                 "fingerprint")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, snippet: str = "", fingerprint: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.snippet = snippet
+        self.fingerprint = fingerprint
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: stable across line-number drift."""
+        return (self.rule, self.path, self.fingerprint)
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet, "fingerprint": self.fingerprint}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Finding":
+        return cls(d["rule"], d["path"], d.get("line", 0), d.get("col", 0),
+                   d.get("message", ""), d.get("snippet", ""),
+                   d.get("fingerprint", ""))
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet}"
+        return out
+
+    def __repr__(self):
+        return f"<Finding {self.rule} {self.path}:{self.line}>"
+
+
+class SourceFile:
+    """A parsed python file plus its suppression map.
+
+    ``path`` is stored repo-relative when the file lives under ``root`` so
+    fingerprints and baselines are machine-independent.
+    """
+
+    def __init__(self, filename: str, text: Optional[str] = None,
+                 root: Optional[str] = None):
+        if text is None:
+            with open(filename, "r", encoding="utf-8") as f:
+                text = f.read()
+        self.filename = filename
+        self.path = self._relpath(filename, root)
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=filename)
+        self._file_disabled: set = set()
+        self._line_disabled: Dict[int, set] = {}
+        self._scope_disabled: List[Tuple[int, int, set]] = []
+        self._collect_suppressions()
+        self._fp_seen: Dict[Tuple[str, str], int] = {}
+
+    @staticmethod
+    def _relpath(filename: str, root: Optional[str]) -> str:
+        if root:
+            try:
+                rel = os.path.relpath(os.path.abspath(filename),
+                                      os.path.abspath(root))
+                if not rel.startswith(".."):
+                    return rel.replace(os.sep, "/")
+            except ValueError:        # different drive (windows)
+                pass
+        return filename.replace(os.sep, "/")
+
+    # -- suppressions --------------------------------------------------------
+    def _collect_suppressions(self):
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError):
+            comments = [(i + 1, ln[ln.index("#"):])
+                        for i, ln in enumerate(self.lines) if "#" in ln]
+        scope_lines: Dict[int, set] = {}
+        for lineno, comment in comments:
+            m = _DISABLE_RE.search(comment)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(2).split(",")
+                     if r.strip()}
+            if m.group(1) == "disable-file":
+                self._file_disabled |= rules
+            else:
+                self._line_disabled.setdefault(lineno, set()).update(rules)
+                src = self.lines[lineno - 1] if lineno <= len(self.lines) else ""
+                if _SCOPE_LINE_RE.match(src):
+                    scope_lines[lineno] = rules
+        if scope_lines:
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    rules = scope_lines.get(node.lineno)
+                    if rules:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        self._scope_disabled.append((node.lineno, end, rules))
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rule = rule.upper()
+
+        def hit(rules: set) -> bool:
+            return rule in rules or "ALL" in rules
+        if hit(self._file_disabled):
+            return True
+        if line in self._line_disabled and hit(self._line_disabled[line]):
+            return True
+        return any(lo <= line <= hi and hit(rules)
+                   for lo, hi, rules in self._scope_disabled)
+
+    # -- finding construction ------------------------------------------------
+    def finding(self, rule: str, node, message: str) -> Finding:
+        """Build a Finding anchored at an AST node, with a drift-stable
+        fingerprint (hash of rule + path + source-line text + occurrence
+        index among identical lines)."""
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        seen_key = (rule, snippet)
+        idx = self._fp_seen.get(seen_key, 0)
+        self._fp_seen[seen_key] = idx + 1
+        raw = f"{rule}|{self.path}|{snippet}|{idx}"
+        fp = hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+        return Finding(rule, self.path, line, col, message, snippet, fp)
+
+
+class Checker:
+    """Base class for one lint rule. Subclasses set ``rule`` / ``name`` /
+    ``help`` and implement :meth:`check`."""
+
+    rule: str = ""
+    name: str = ""
+    help: str = ""
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_CHECKERS: Dict[str, Checker] = {}
+
+
+def register(cls):
+    """Class decorator: add a Checker to the global registry (keyed by its
+    rule code; duplicate codes are a programming error)."""
+    if not cls.rule:
+        raise ValueError(f"{cls.__name__} has no rule code")
+    if cls.rule in _CHECKERS:
+        raise ValueError(f"duplicate mxlint rule {cls.rule}")
+    _CHECKERS[cls.rule] = cls()
+    return cls
+
+
+def all_checkers() -> List[Checker]:
+    return [_CHECKERS[r] for r in sorted(_CHECKERS)]
+
+
+def get_checker(rule: str) -> Optional[Checker]:
+    return _CHECKERS.get(rule.upper())
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of ``*.py`` files."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(set(out))
+
+
+def lint_file(filename: str, rules: Optional[Sequence[str]] = None,
+              root: Optional[str] = None,
+              text: Optional[str] = None) -> List[Finding]:
+    """Run (a subset of) the registered checkers over one file. Suppressed
+    findings are dropped here; syntax errors become a single MX000 finding
+    instead of raising (a linter must not die on the code it lints)."""
+    try:
+        src = SourceFile(filename, text=text, root=root)
+    except SyntaxError as e:
+        path = SourceFile._relpath(filename, root)
+        return [Finding("MX000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}",
+                        fingerprint=hashlib.sha256(
+                            f"MX000|{path}".encode()).hexdigest()[:16])]
+    wanted = {r.upper() for r in rules} if rules else None
+    findings: List[Finding] = []
+    for checker in all_checkers():
+        if wanted is not None and checker.rule not in wanted:
+            continue
+        for f in checker.check(src):
+            if not src.is_suppressed(f.rule, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every python file under ``paths``; the whole-scan entry point."""
+    findings: List[Finding] = []
+    for filename in iter_python_files(paths):
+        findings.extend(lint_file(filename, rules=rules, root=root))
+    return findings

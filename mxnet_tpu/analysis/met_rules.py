@@ -1,0 +1,60 @@
+"""Metric-hygiene rules.
+
+MET300 moves the telemetry registry's registration-time name lint
+(``telemetry.metrics.METRIC_NAME_RE``) to review time: a metric family
+declared with a literal name that fails ``^mxtpu_[a-z0-9_]+$`` is caught by
+the linter before the code ever runs, instead of blowing up at import in
+the first process that touches the module. Non-literal names (f-strings,
+variables) are skipped — the runtime lint still owns those.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Finding, SourceFile, register
+
+__all__ = ["MetricNameLint"]
+
+# keep in sync with telemetry.metrics.METRIC_NAME_RE; re-declared literally
+# so the linter never imports the (jax-loading) telemetry package
+import re
+_METRIC_NAME_RE = re.compile(r"^mxtpu_[a-z0-9_]+$")
+
+_FACTORY_NAMES = {"counter", "gauge", "histogram"}
+
+
+@register
+class MetricNameLint(Checker):
+    rule = "MET300"
+    name = "metric-name-lint"
+    help = ("Metric families must be named ^mxtpu_[a-z0-9_]+$ (the "
+            "registry rejects anything else at registration); catching the "
+            "violation statically keeps a bad name from ever reaching a "
+            "running process or a dashboard.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                fname = func.attr
+            elif isinstance(func, ast.Name):
+                fname = func.id
+            else:
+                continue
+            if fname not in _FACTORY_NAMES:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue          # dynamic name: runtime lint owns it
+            name = first.value
+            if not _METRIC_NAME_RE.match(name):
+                yield src.finding(
+                    self.rule, first,
+                    f"metric name {name!r} fails the registry lint "
+                    "^mxtpu_[a-z0-9_]+$ — the registration call will raise "
+                    "at import; namespace it mxtpu_ and use lowercase "
+                    "snake_case")

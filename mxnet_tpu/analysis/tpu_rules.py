@@ -1,0 +1,368 @@
+"""TPU-pitfall rules: the trace/compile boundary checkers.
+
+Whole-program compilation (the Julia-to-TPU discipline) makes three Python
+habits silently catastrophic inside traced code:
+
+  TPU100  host sync under trace — ``.asnumpy()`` / ``.asscalar()`` /
+          ``float(x)`` on a traced value forces a device round-trip per call
+          (or a tracer error), destroying the one-dispatch-per-step model.
+  TPU101  traced-value control flow — a Python ``if``/``while`` on a traced
+          value either fails to trace or bakes one branch in and recompiles
+          every time the value flips: the recompile storm.
+  TPU102  use-after-donate — reading a buffer after it was donated to a
+          compiled call (``donate_argnums``) dereferences deleted device
+          memory; the autoformat/donation path in parallel/train_step.py is
+          built around never doing this.
+
+Traced contexts are found syntactically: ``hybrid_forward`` methods (the
+HybridBlock trace surface — ``self`` and ``F`` are not traced, the data args
+are) and functions decorated with a ``jit``/``pjit``-suffixed decorator.
+Taint starts at the traced parameters and propagates through simple
+assignments; the checks are deliberately shallow (no inter-procedural flow)
+— a linter's job is the obvious 95% with zero false-positive noise, the
+suppression comment covers intentional exceptions.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile, register
+
+__all__ = ["HostSyncUnderTrace", "TracedControlFlow", "UseAfterDonate"]
+
+# NDArray-only host-sync methods: any call under a trace is a finding
+_SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read"}
+# generic python methods: only a finding when the receiver is traced
+_SYNC_METHODS_TAINTED = {"item", "tolist"}
+_NUMPY_MODULES = {"np", "onp", "numpy"}
+_NUMPY_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray"}
+_BUILTIN_SYNCS = {"float", "int", "bool", "complex"}
+# attribute reads that are static under trace (shape/dtype are python-side)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "ctx", "stype"}
+_STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @partial(jax.jit, ...) / @pjit(...) shapes."""
+    if isinstance(dec, ast.Call):
+        name = _dotted(dec.func)
+        if name.rsplit(".", 1)[-1] in ("jit", "pjit"):
+            return True
+        if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _is_jit_decorator(dec.args[0])
+        return False
+    return _dotted(dec).rsplit(".", 1)[-1] in ("jit", "pjit")
+
+
+def _traced_params(fn: ast.FunctionDef
+                   ) -> Optional[Tuple[List[str], Set[str]]]:
+    """``(value_params, seq_params)`` for a traced context, else None.
+
+    ``value_params`` hold traced arrays directly; ``seq_params`` (``*args``
+    / ``**kwargs``) are python containers OF traced arrays — their length
+    and truthiness are static per trace signature, only their elements are
+    traced.
+    """
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    if fn.name == "hybrid_forward":
+        # hybrid_forward(self, F, x, ...): self and the op namespace F are
+        # python-side; everything after is traced (incl. kwarg params/weights)
+        traced = args[2:] if len(args) >= 2 else []
+        traced += [a.arg for a in fn.args.kwonlyargs]
+    elif any(_is_jit_decorator(d) for d in fn.decorator_list):
+        traced = [a for a in args if a not in ("self", "cls")]
+        traced += [a.arg for a in fn.args.kwonlyargs]
+    else:
+        return None
+    seqs = set()
+    if fn.args.vararg:
+        seqs.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        seqs.add(fn.args.kwarg.arg)
+    return traced, seqs
+
+
+def _depends(node: ast.AST, tainted: Set[str], seqs: Set[str]) -> bool:
+    """True when the *value* of ``node`` depends on traced data.
+
+    Static-under-trace escapes return False: ``.shape``/``.dtype`` reads,
+    ``len()``/``isinstance()``, identity checks (``is None``), and the bare
+    truthiness of a ``*args``-style container (a python tuple). A subscript
+    of such a container IS traced (its elements are arrays).
+    """
+    if isinstance(node, ast.Name):
+        if node.id in seqs:
+            return False          # tuple truthiness/iteration is static
+        return node.id in tainted
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _depends(node.value, tainted, seqs)
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func).rsplit(".", 1)[-1]
+        if fname in _STATIC_FUNCS:
+            return False
+        return (_depends(node.func, tainted, seqs)
+                or any(_depends(a, tainted, seqs) for a in node.args)
+                or any(_depends(k.value, tainted, seqs)
+                       for k in node.keywords))
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False          # `x is None` is a static python-side check
+        return any(_depends(n, tainted, seqs)
+                   for n in [node.left] + list(node.comparators))
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        if isinstance(v, ast.Name) and v.id in seqs:
+            return True           # element of a traced-array container
+        return (_depends(v, tainted, seqs)
+                or _depends(node.slice, tainted, seqs))
+    if isinstance(node, ast.Starred):
+        v = node.value            # *states forwards the traced elements
+        if isinstance(v, ast.Name) and v.id in seqs:
+            return True
+        return _depends(v, tainted, seqs)
+    return any(_depends(c, tainted, seqs)
+               for c in ast.iter_child_nodes(node))
+
+
+def _taint_set(fn: ast.FunctionDef, params: List[str],
+               seqs: Set[str]) -> Set[str]:
+    """Traced params + names assigned from value-dependent expressions
+    (fixpoint over simple assignments; no inter-procedural flow). Only
+    Store-context names taint — ``self.x = traced`` does not taint ``self``."""
+    tainted = set(params)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is not None:
+                if _depends(node.value, tainted, seqs):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name) and \
+                                    isinstance(n.ctx, ast.Store) and \
+                                    n.id not in tainted and n.id not in seqs:
+                                tainted.add(n.id)
+                                changed = True
+            elif isinstance(node, ast.AugAssign):
+                if _depends(node.value, tainted, seqs) and \
+                        isinstance(node.target, ast.Name) and \
+                        node.target.id not in tainted and \
+                        node.target.id not in seqs:
+                    tainted.add(node.target.id)
+                    changed = True
+    return tainted
+
+
+def _iter_traced_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            tp = _traced_params(node)
+            if tp is not None:
+                yield node, tp[0], tp[1]
+
+
+@register
+class HostSyncUnderTrace(Checker):
+    rule = "TPU100"
+    name = "host-sync-under-trace"
+    help = ("Host synchronization (.asnumpy/.asscalar/float()/np.asarray) "
+            "reachable from traced code (hybrid_forward / @jit) forces a "
+            "device round-trip per call or a tracer error.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for fn, params, seqs in _iter_traced_functions(src.tree):
+            tainted = _taint_set(fn, params, seqs)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = self._sync_reason(node, tainted, seqs)
+                if f:
+                    yield src.finding(
+                        self.rule, node,
+                        f"{f} inside traced `{fn.name}` forces a host "
+                        "sync; keep device values symbolic (use F.* ops) "
+                        "or hoist the conversion out of the traced scope")
+
+    @staticmethod
+    def _sync_reason(call: ast.Call, tainted: Set[str],
+                     seqs: Set[str]) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_METHODS:
+                return f"`.{func.attr}()`"
+            if func.attr in _SYNC_METHODS_TAINTED and \
+                    _depends(func.value, tainted, seqs):
+                return f"`.{func.attr}()` on traced value"
+            if func.attr in _NUMPY_SYNC_FUNCS and \
+                    _dotted(func.value) in _NUMPY_MODULES:
+                if any(_depends(a, tainted, seqs) for a in call.args):
+                    return f"`{_dotted(func.value)}.{func.attr}()` on " \
+                           "traced value"
+        elif isinstance(func, ast.Name) and func.id in _BUILTIN_SYNCS:
+            if any(_depends(a, tainted, seqs) for a in call.args):
+                return f"`{func.id}()` on traced value"
+        return None
+
+
+@register
+class TracedControlFlow(Checker):
+    rule = "TPU101"
+    name = "traced-value-control-flow"
+    help = ("Python if/while on a traced value bakes one branch into the "
+            "compiled program and recompiles when it flips (or fails to "
+            "trace). Use F.where / lax.cond-style select instead.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for fn, params, seqs in _iter_traced_functions(src.tree):
+            tainted = _taint_set(fn, params, seqs)
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    kind = {"If": "if", "While": "while",
+                            "IfExp": "conditional expression"}[
+                                type(node).__name__]
+                    if _depends(node.test, tainted, seqs):
+                        yield src.finding(
+                            self.rule, node,
+                            f"python `{kind}` branches on a traced value "
+                            f"inside `{fn.name}`: one recompile per "
+                            "distinct value (recompile storm); select with "
+                            "F.where/F.broadcast_* or branch on static "
+                            "shape/dtype only")
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """For a jit/pjit wrapper construction, the literal donate_argnums
+    positions (None when absent or not statically known)."""
+    if _dotted(call.func).rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return None               # dynamic: can't reason statically
+    return None
+
+
+@register
+class UseAfterDonate(Checker):
+    rule = "TPU102"
+    name = "use-after-donate"
+    help = ("A buffer passed at a donate_argnums position is deleted when "
+            "the compiled call runs; reading the python variable afterwards "
+            "dereferences freed device memory. Rebind it to the call's "
+            "output instead.")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for scope in ast.walk(src.tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)):
+                yield from self._check_scope(src, scope)
+
+    def _check_scope(self, src: SourceFile, scope) -> Iterable[Finding]:
+        # donating callables bound in this scope: name -> donated positions
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                pos = _donated_positions(node.value)
+                if pos is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donating[tgt.id] = pos
+        if not donating:
+            return
+        # events in execution order: value expressions run before their
+        # assignment targets bind, and a donation takes effect only once the
+        # call's argument expressions were read — so `x = g(x)` is the
+        # *correct* rebind-to-output pattern, not a use-after-donate
+        events = []               # (kind, name, node)
+
+        def emit(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return            # deferred execution: out of linear order
+            if isinstance(node, ast.Assign):
+                emit(node.value)
+                for tgt in node.targets:
+                    emit_target(tgt)
+                return
+            if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    emit(node.value)
+                if isinstance(node, ast.AugAssign):
+                    emit(node.target)         # x += 1 also *reads* x
+                emit_target(node.target)
+                return
+            if isinstance(node, ast.For):
+                emit(node.iter)
+                emit_target(node.target)
+                for n in node.body + node.orelse:
+                    emit(n)
+                return
+            if isinstance(node, ast.withitem):
+                emit(node.context_expr)
+                if node.optional_vars is not None:
+                    emit_target(node.optional_vars)
+                return
+            if isinstance(node, ast.Name):
+                events.append(("rebind" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "read",
+                    node.id, node))
+                return
+            for child in ast.iter_child_nodes(node):
+                emit(child)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in donating:
+                for i in donating[node.func.id]:
+                    if i < len(node.args) and \
+                            isinstance(node.args[i], ast.Name):
+                        events.append(("donate", node.args[i].id, node))
+
+        def emit_target(tgt):
+            # Store names rebind; Load names inside a target (subscript base
+            # `a` in `a[i] = v`, index `i`) are genuine reads
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    events.append(("rebind" if isinstance(
+                        n.ctx, (ast.Store, ast.Del)) else "read", n.id, n))
+
+        for stmt in scope.body:
+            emit(stmt)
+        consumed: Dict[str, int] = {}      # name -> line donated
+        for kind, name, node in events:
+            if kind == "donate":
+                consumed[name] = node.lineno
+            elif kind == "rebind":
+                consumed.pop(name, None)
+            elif kind == "read" and name in consumed:
+                yield src.finding(
+                    self.rule, node,
+                    f"`{name}` was donated to a compiled call at line "
+                    f"{consumed[name]} and read again here: donated "
+                    "buffers are deleted by XLA — rebind the name to the "
+                    "call's output (or drop donate_argnums)")
+                consumed.pop(name)         # one report per donation

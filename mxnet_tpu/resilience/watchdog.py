@@ -111,7 +111,7 @@ class Watchdog:
             self._ensure_thread()
 
     # -- monitor ------------------------------------------------------------
-    def _ensure_thread(self):    # caller holds the lock
+    def _ensure_thread(self):  # caller holds the lock  # mxlint: disable=CONC200
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(
@@ -139,11 +139,15 @@ class Watchdog:
                         pass        # a broken callback must not kill the monitor
 
     def stop(self):
-        self._stop.set()
-        t = self._thread
+        # take the lock: a beat()/watch() racing this stop could otherwise
+        # resurrect the monitor via _ensure_thread between the event set and
+        # the handle clear, leaving a live thread with no handle to join
+        with self._lock:
+            self._stop.set()
+            t = self._thread
+            self._thread = None
         if t is not None:
             t.join(timeout=self.poll_s * 4 + 1.0)
-        self._thread = None
 
 
 class CircuitBreaker:
@@ -185,7 +189,7 @@ class CircuitBreaker:
         self.transitions = []       # recent (old, new) pairs, bounded
 
     # -- internals (caller holds the lock) ----------------------------------
-    def _set(self, new: str):
+    def _set(self, new: str):  # mxlint: disable=CONC200
         old = self._state
         if old == new:
             return
@@ -199,7 +203,7 @@ class CircuitBreaker:
             except Exception:
                 pass
 
-    def _tick(self):
+    def _tick(self):  # mxlint: disable=CONC200
         if self._state == OPEN and \
                 time.monotonic() - self._opened_at >= self.cooldown_s:
             self._probes = 0

@@ -158,6 +158,10 @@ class InferenceServer:
         with self._cond:
             if self._state == _STOPPED and self._thread is None:
                 return
+            # snapshot the worker handle under the lock: a concurrent stop()
+            # (or a start() after abandon) must never see a half-cleared
+            # self._thread, so all joining below works on the local
+            thread = self._thread
             if drain:
                 self._state = _DRAINING
             else:
@@ -166,9 +170,9 @@ class InferenceServer:
                 for q in self._queues.values():
                     q.fail_all(exc)
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
                 # drain wedged (hung device step / endpoint queue): abandon.
                 # The daemon worker may eventually finish its in-flight call;
                 # it will find the state _STOPPED and exit, and resolve() on
@@ -185,13 +189,15 @@ class InferenceServer:
                     self._cond.notify_all()
                 if abandoned:
                     _DRAIN_ABANDONED.inc(abandoned)
-                self._thread.join(1.0)
-                if self._thread.is_alive():
+                thread.join(1.0)
+                if thread.is_alive():
                     # keep the handle: start() must refuse to run a second
                     # worker beside a wedged one
                     self._watchdog.stop()
                     return
-            self._thread = None
+            with self._cond:
+                if self._thread is thread:
+                    self._thread = None
         self._watchdog.stop()
 
     @property

@@ -32,7 +32,11 @@ from ..base import MXNetError
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "DEFAULT_BUCKETS", "METRIC_NAME_RE"]
 
-# dashboards key on metric names: lint them at registration, not at scrape
+# dashboards key on metric names: lint them at registration, not at scrape.
+# mxlint rule MET300 (mxnet_tpu.analysis, STATIC_ANALYSIS.md) enforces the
+# same pattern statically on literal names, so violations gate in review
+# before any process ever registers them; this runtime check remains the
+# authority for dynamically-built names.
 METRIC_NAME_RE = re.compile(r"^mxtpu_[a-z0-9_]+$")
 
 # fixed log-spaced duration buckets: 2^(k/2) microseconds (ratio ~1.41,

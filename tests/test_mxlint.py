@@ -1,0 +1,558 @@
+"""mxlint (mxnet_tpu.analysis) tests: every rule on known-bad + corrected
+fixtures, suppression comments, baseline round-trip, JSON schema, and the
+tier-1 CI gate — the self-scan of mxnet_tpu/ + the tool scripts must match
+the committed baseline exactly (`python tools/mxlint.py --check`)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MXLINT = os.path.join(REPO, "tools", "mxlint.py")
+
+
+def lint(src, rules=None, name="fixture.py"):
+    return analysis.lint_file(name, rules=rules, text=src)
+
+
+def codes(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# TPU100 — host sync under trace
+# ---------------------------------------------------------------------------
+TPU100_BAD = '''
+class Net:
+    def hybrid_forward(self, F, x):
+        host = x.asnumpy()
+        scalar = float(x)
+        y = x * 2
+        z = y.item()
+        return F.relu(x)
+'''
+
+TPU100_FIXED = '''
+class Net:
+    def hybrid_forward(self, F, x):
+        n = len(x.shape)
+        return F.relu(x) * n
+'''
+
+
+def test_tpu100_fires_on_host_sync():
+    fs = lint(TPU100_BAD)
+    assert codes(fs) == ["TPU100"] * 3
+    assert fs[0].line == 4 and ".asnumpy()" in fs[0].message
+    assert "float()" in fs[1].message
+    # taint propagated through y = x * 2 into y.item()
+    assert ".item()" in fs[2].message
+
+
+def test_tpu100_silent_on_fixed():
+    assert lint(TPU100_FIXED) == []
+
+
+def test_tpu100_untraced_function_is_fine():
+    assert lint("def helper(x):\n    return x.asnumpy()\n") == []
+
+
+def test_tpu100_numpy_asarray_on_traced_value():
+    src = ("import numpy as np\n"
+           "class Net:\n"
+           "    def hybrid_forward(self, F, x):\n"
+           "        return np.asarray(x)\n")
+    assert codes(lint(src)) == ["TPU100"]
+
+
+def test_tpu100_jit_decorated_counts_as_traced():
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    return x.asnumpy()\n")
+    assert codes(lint(src)) == ["TPU100"]
+
+
+# ---------------------------------------------------------------------------
+# TPU101 — traced-value control flow
+# ---------------------------------------------------------------------------
+TPU101_BAD = '''
+import jax
+@jax.jit
+def step(x, y):
+    if x > 0:
+        return y
+    while y.sum() > 1:
+        y = y / 2
+    z = x + 1
+    return z if z > 0 else -z
+'''
+
+TPU101_FIXED = '''
+class Net:
+    def hybrid_forward(self, F, x, mask=None):
+        if mask is None:
+            mask = F.ones_like(x)
+        if x.shape[0] > 4:
+            x = x[:4]
+        if len(x.shape) == 2:
+            x = x * 1
+        return x * mask
+'''
+
+
+def test_tpu101_fires_on_if_while_ifexp():
+    fs = lint(TPU101_BAD)
+    assert codes(fs) == ["TPU101"] * 3
+    assert [f.line for f in fs] == [5, 7, 10]
+
+
+def test_tpu101_static_checks_are_fine():
+    # `is None`, .shape, len() are python-side static: no recompile storm
+    assert lint(TPU101_FIXED) == []
+
+
+def test_tpu101_vararg_truthiness_is_static():
+    # `if not states:` on *states (a tuple) is static per trace signature,
+    # but branching on an element of it is not
+    ok = ("class Net:\n"
+          "    def hybrid_forward(self, F, x, *states):\n"
+          "        if not states:\n"
+          "            return x\n"
+          "        return x + states[0]\n")
+    bad = ("class Net:\n"
+           "    def hybrid_forward(self, F, x, *states):\n"
+           "        if states[0] > 0:\n"
+           "            return x\n"
+           "        return x\n")
+    assert lint(ok) == []
+    assert codes(lint(bad)) == ["TPU101"]
+
+
+# ---------------------------------------------------------------------------
+# TPU102 — use-after-donate
+# ---------------------------------------------------------------------------
+TPU102_BAD = '''
+import jax
+def run(update, params, grads):
+    g = jax.jit(update, donate_argnums=(0,))
+    new = g(params, grads)
+    return params.sum()
+'''
+
+TPU102_FIXED = '''
+import jax
+def run(update, params, grads):
+    g = jax.jit(update, donate_argnums=(0,))
+    params = g(params, grads)
+    return params.sum()
+'''
+
+
+def test_tpu102_fires_on_read_after_donate():
+    fs = lint(TPU102_BAD)
+    assert codes(fs) == ["TPU102"]
+    assert fs[0].line == 6 and "`params`" in fs[0].message
+
+
+def test_tpu102_rebind_to_output_is_the_fix():
+    # x = g(x) reads-then-donates-then-rebinds: the sanctioned pattern
+    assert lint(TPU102_FIXED) == []
+
+
+def test_tpu102_non_donating_jit_is_fine():
+    src = ("import jax\n"
+           "def run(update, params, grads):\n"
+           "    g = jax.jit(update)\n"
+           "    new = g(params, grads)\n"
+           "    return params.sum()\n")
+    assert lint(src) == []
+
+
+def test_tpu102_dynamic_argnums_skipped():
+    # donate positions not statically known: stay silent, never guess
+    src = ("import jax\n"
+           "def run(update, params, pos):\n"
+           "    g = jax.jit(update, donate_argnums=pos)\n"
+           "    new = g(params)\n"
+           "    return params.sum()\n")
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CONC200 — unlocked shared mutation
+# ---------------------------------------------------------------------------
+CONC200_BAD = '''
+import threading
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+    def locked(self):
+        with self._lock:
+            self.count += 1
+            self.items.append(1)
+    def racy(self):
+        self.count += 1
+        self.items.append(2)
+'''
+
+CONC200_FIXED = '''
+import threading
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+    def locked(self):
+        with self._lock:
+            self.count += 1
+    def also_locked(self):
+        with self._lock:
+            self.count = 0
+'''
+
+
+def test_conc200_fires_on_unlocked_write_and_mutator():
+    fs = lint(CONC200_BAD)
+    assert codes(fs) == ["CONC200", "CONC200"]
+    assert {f.line for f in fs} == {13, 14}
+    assert "racy" in fs[0].message
+
+
+def test_conc200_silent_when_consistently_locked():
+    assert lint(CONC200_FIXED) == []
+
+
+def test_conc200_init_writes_exempt():
+    # __init__ publishes the object only after construction: no race
+    assert "CONC200" not in codes(lint(CONC200_FIXED))
+
+
+def test_conc200_condition_aliases_its_lock():
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._cond = threading.Condition(self._lock)\n"
+           "        self.depth = 0\n"
+           "    def a(self):\n"
+           "        with self._cond:\n"
+           "            self.depth += 1\n"
+           "    def b(self):\n"
+           "        with self._lock:\n"
+           "            self.depth -= 1\n")
+    assert lint(src) == []
+
+
+def test_conc200_lockless_class_skipped():
+    src = ("class P:\n"
+           "    def bump(self):\n"
+           "        self.n = 1\n"
+           "    def bump2(self):\n"
+           "        self.n = 2\n")
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# CONC201 — lock-order cycles
+# ---------------------------------------------------------------------------
+CONC201_BAD = '''
+import threading
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+CONC201_FIXED = '''
+import threading
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+    def also_ab(self):
+        with self._a:
+            with self._b:
+                pass
+'''
+
+
+def test_conc201_fires_on_opposite_order():
+    fs = lint(CONC201_BAD)
+    assert codes(fs) == ["CONC201"]
+    assert "TwoLocks._a" in fs[0].message and "TwoLocks._b" in fs[0].message
+
+
+def test_conc201_consistent_order_is_fine():
+    assert lint(CONC201_FIXED) == []
+
+
+def test_conc201_sees_through_self_method_calls():
+    src = ("import threading\n"
+           "class T:\n"
+           "    def __init__(self):\n"
+           "        self._a = threading.Lock()\n"
+           "        self._b = threading.Lock()\n"
+           "    def ab(self):\n"
+           "        with self._a:\n"
+           "            self.takes_b()\n"
+           "    def takes_b(self):\n"
+           "        with self._b:\n"
+           "            pass\n"
+           "    def ba(self):\n"
+           "        with self._b:\n"
+           "            self.takes_a()\n"
+           "    def takes_a(self):\n"
+           "        with self._a:\n"
+           "            pass\n")
+    assert codes(lint(src)) == ["CONC201"]
+
+
+# ---------------------------------------------------------------------------
+# MET300 — metric-name lint, statically
+# ---------------------------------------------------------------------------
+MET300_BAD = '''
+from mxnet_tpu import telemetry
+BAD1 = telemetry.counter("serving_requests", "no namespace")
+BAD2 = telemetry.gauge("mxtpu_Bad_Case", "uppercase")
+OK = telemetry.histogram("mxtpu_ok_name", "fine")
+'''
+
+
+def test_met300_fires_on_bad_literal_names():
+    fs = lint(MET300_BAD)
+    assert codes(fs) == ["MET300", "MET300"]
+    assert "serving_requests" in fs[0].message
+    assert "mxtpu_Bad_Case" in fs[1].message
+
+
+def test_met300_dynamic_names_skipped():
+    src = ("from mxnet_tpu import telemetry\n"
+           "def make(n):\n"
+           "    return telemetry.counter(f'mxtpu_{n}')\n")
+    assert lint(src) == []
+
+
+def test_met300_matches_runtime_lint_pattern():
+    # the static pattern must never drift from the registry's runtime lint
+    from mxnet_tpu.analysis import met_rules
+    from mxnet_tpu.telemetry.metrics import METRIC_NAME_RE
+    assert met_rules._METRIC_NAME_RE.pattern == METRIC_NAME_RE.pattern
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+def test_line_suppression():
+    src = ("class Net:\n"
+           "    def hybrid_forward(self, F, x):\n"
+           "        v = x.asnumpy()  # mxlint: disable=TPU100\n"
+           "        return F.relu(x)\n")
+    assert lint(src) == []
+
+
+def test_line_suppression_wrong_rule_does_not_silence():
+    src = ("class Net:\n"
+           "    def hybrid_forward(self, F, x):\n"
+           "        v = x.asnumpy()  # mxlint: disable=TPU101\n"
+           "        return F.relu(x)\n")
+    assert codes(lint(src)) == ["TPU100"]
+
+
+def test_scope_suppression_on_def_line():
+    # the caller-holds-lock idiom: disable on the def silences the body
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0\n"
+           "    def locked(self):\n"
+           "        with self._lock:\n"
+           "            self.n += 1\n"
+           "    def helper(self):  # mxlint: disable=CONC200\n"
+           "        self.n += 1\n"
+           "        self.n += 2\n")
+    assert lint(src) == []
+
+
+def test_file_suppression():
+    src = ("# mxlint: disable-file=TPU100\n"
+           "class Net:\n"
+           "    def hybrid_forward(self, F, x):\n"
+           "        return x.asnumpy()\n")
+    assert lint(src) == []
+
+
+def test_disable_all():
+    src = ("class Net:\n"
+           "    def hybrid_forward(self, F, x):\n"
+           "        return x.asnumpy()  # mxlint: disable=all\n")
+    assert lint(src) == []
+
+
+def test_syntax_error_becomes_mx000():
+    fs = lint("def broken(:\n")
+    assert codes(fs) == ["MX000"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+def test_baseline_roundtrip(tmp_path):
+    f1 = lint(TPU100_BAD, name="a.py")
+    path = str(tmp_path / "baseline.json")
+    analysis.save_baseline(path, f1)
+    loaded = analysis.load_baseline(path)
+    assert [b.key() for b in loaded] == [f.key() for f in f1]
+
+    # same scan against the ledger: everything matched, nothing gates
+    new, matched, stale = analysis.apply_baseline(f1, loaded)
+    assert new == [] and stale == [] and len(matched) == len(f1)
+
+    # a fresh finding gates; a fixed one shows up stale
+    f2 = lint(TPU100_BAD + "\nBAD = float(1)\n"
+              "class M:\n"
+              "    def hybrid_forward(self, F, q):\n"
+              "        return q.asscalar()\n", name="a.py")
+    new, matched, stale = analysis.apply_baseline(f2, loaded)
+    assert len(new) == 1 and ".asscalar()" in new[0].message
+    fixed = lint(TPU100_FIXED, name="a.py")
+    new, matched, stale = analysis.apply_baseline(fixed, loaded)
+    assert new == [] and len(stale) == len(f1)
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    f1 = lint(TPU100_BAD, name="a.py")
+    shifted = lint("# leading comment\n# another\n" + TPU100_BAD, name="a.py")
+    assert [f.key() for f in f1] == [f.key() for f in shifted]
+    assert [f.line for f in f1] != [f.line for f in shifted]
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    assert analysis.load_baseline(str(tmp_path / "nope.json")) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSON schema + the tier-1 CI gate
+# ---------------------------------------------------------------------------
+def _run_mxlint(*argv, cwd=None):
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)   # the CLI must be self-sufficient
+    return subprocess.run([sys.executable, MXLINT, *argv],
+                          capture_output=True, text=True, env=env,
+                          cwd=cwd or REPO)
+
+
+def test_cli_json_schema(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(TPU100_BAD + CONC200_BAD)
+    r = _run_mxlint("--json", "--no-baseline", str(bad))
+    assert r.returncode == 1, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["version"] == 1
+    assert doc["counts"] == {"TPU100": 3, "CONC200": 2}
+    assert doc["total"] == 5 and doc["baselined"] == 0
+    assert len(doc["new"]) == 5 and doc["stale"] == []
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "snippet", "fingerprint"}
+        assert isinstance(f["line"], int) and f["fingerprint"]
+
+
+def test_cli_baseline_update_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(CONC200_BAD)
+    baseline = tmp_path / "base.json"
+    # gate fails before baselining, passes after, fails again on new code
+    assert _run_mxlint("--baseline", str(baseline), str(bad)).returncode == 1
+    r = _run_mxlint("--baseline", str(baseline), "--update-baseline",
+                    str(bad))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert _run_mxlint("--baseline", str(baseline), str(bad)).returncode == 0
+    bad.write_text(CONC200_BAD + TPU100_BAD)
+    assert _run_mxlint("--baseline", str(baseline), str(bad)).returncode == 1
+    # --check also fails on stale entries (ledger must shrink with the code)
+    bad.write_text(CONC200_FIXED)
+    assert _run_mxlint("--baseline", str(baseline),
+                       str(bad)).returncode == 0
+    assert _run_mxlint("--baseline", str(baseline), "--check",
+                       str(bad)).returncode == 1
+
+
+def test_cli_list_rules():
+    r = _run_mxlint("--list-rules")
+    assert r.returncode == 0
+    for rule in ("TPU100", "TPU101", "TPU102", "CONC200", "CONC201",
+                 "MET300"):
+        assert rule in r.stdout
+
+
+def test_cli_rule_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(TPU100_BAD + CONC200_BAD)
+    r = _run_mxlint("--json", "--no-baseline", "--rules", "CONC200",
+                    str(bad))
+    doc = json.loads(r.stdout)
+    assert set(doc["counts"]) == {"CONC200"}
+
+
+def test_cli_runs_without_jax_import():
+    """The linter must work in a bare interpreter: the stub-parent import
+    path must not pull in jax (slim CI images, pre-commit hooks)."""
+    r = _run_mxlint("--list-rules")
+    assert r.returncode == 0
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, runpy; sys.argv = ['mxlint', '--list-rules']; "
+         f"runpy.run_path({MXLINT!r}, run_name='__main__')\n"],
+        capture_output=True, text=True, cwd=REPO)
+    # runpy raises SystemExit(0): returncode 0 and jax never imported
+    assert probe.returncode == 0, probe.stderr
+
+
+def test_ci_gate_self_scan_matches_baseline():
+    """THE tier-1 gate: mxnet_tpu/ + tools scripts lint clean against the
+    committed baseline. New findings (or stale ledger entries) fail CI."""
+    r = _run_mxlint("--check")
+    assert r.returncode == 0, (
+        "mxlint gate failed — fix the finding or (for accepted pre-existing "
+        "ones) run `python tools/mxlint.py --update-baseline`:\n"
+        + r.stdout + r.stderr)
+    assert "0 new, 0 stale" in r.stdout
+
+
+def test_self_scan_covers_the_tool_scripts():
+    files = analysis.iter_python_files(
+        [os.path.join(REPO, p) for p in analysis.DEFAULT_SCAN_SET])
+    names = {os.path.basename(f) for f in files}
+    assert {"chaos_check.py", "metrics_dump.py", "mxlint.py",
+            "server.py", "watchdog.py", "metrics.py"} <= names
+    assert len(files) > 150
+
+
+def test_api_self_scan_agrees_with_cli():
+    findings = analysis.lint_paths(
+        [os.path.join(REPO, p) for p in analysis.DEFAULT_SCAN_SET],
+        root=REPO)
+    baseline = analysis.load_baseline(
+        os.path.join(REPO, "tools", "mxlint_baseline.json"))
+    new, _matched, stale = analysis.apply_baseline(findings, baseline)
+    assert new == [], [f.format() for f in new]
+    assert stale == [], [f.format() for f in stale]

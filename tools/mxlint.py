@@ -1,0 +1,149 @@
+"""mxlint — TPU-pitfall & concurrency linter for the mxnet_tpu tree.
+
+The CI gate for the invariants STATIC_ANALYSIS.md catalogs: host syncs under
+a trace (TPU100), traced-value control flow (TPU101), use-after-donate
+(TPU102), unlocked shared mutation (CONC200), lock-order cycles (CONC201),
+and metric-name hygiene (MET300).
+
+    # gate: scan the default set, fail on anything not in the baseline
+    python tools/mxlint.py --check
+
+    # same, explicit paths
+    python tools/mxlint.py mxnet_tpu tools/chaos_check.py
+
+    # machine-readable output
+    python tools/mxlint.py --json
+
+    # accept the current findings as the new baseline
+    python tools/mxlint.py --update-baseline
+
+    # one rule only, ignore the baseline
+    python tools/mxlint.py --rules CONC200 --no-baseline mxnet_tpu/serving
+
+Suppressions: ``# mxlint: disable=RULE[,RULE|all]`` on the offending line
+(on a ``def``/``class`` line it covers the whole scope — the idiom for
+caller-holds-lock helpers); ``# mxlint: disable-file=RULE`` for a file.
+
+Exit status: 0 when the scan matches the committed baseline exactly; 1 when
+there are new findings, or (with ``--check``) stale baseline entries —
+fixed findings must be removed from the ledger with ``--update-baseline``
+so it only ever shrinks.
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Import the analysis package WITHOUT executing mxnet_tpu/__init__ (which
+# loads jax): a stub parent package with just __path__ lets the relative
+# imports inside mxnet_tpu.analysis resolve while keeping the linter
+# runnable in any bare python (pre-commit hooks, slim CI images).
+if "mxnet_tpu" not in sys.modules:
+    _stub = types.ModuleType("mxnet_tpu")
+    _stub.__path__ = [os.path.join(REPO, "mxnet_tpu")]
+    sys.modules["mxnet_tpu"] = _stub
+
+from mxnet_tpu import analysis  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "mxlint_baseline.json")
+
+
+def _resolve_paths(paths):
+    """Make CLI paths repo-root-relative so fingerprints are stable no
+    matter the invocation cwd."""
+    out = []
+    for p in paths:
+        cand = p if os.path.exists(p) else os.path.join(REPO, p)
+        out.append(cand)
+    return out
+
+
+def _json_report(findings, new, stale, baselined):
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "version": 1,
+        "counts": counts,
+        "total": len(findings),
+        "baselined": baselined,
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.to_dict() for f in new],
+        "stale": [f.to_dict() for f in stale],
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to scan (default: "
+                         + " ".join(analysis.DEFAULT_SCAN_SET) + ")")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (e.g. TPU100,CONC200)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of text")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline ledger path (default tools/"
+                         "mxlint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding as new")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept the current findings as the new baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate mode: also fail on stale baseline entries")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for c in analysis.all_checkers():
+            print(f"{c.rule}  {c.name}")
+            print(f"    {c.help}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    paths = _resolve_paths(args.paths or list(analysis.DEFAULT_SCAN_SET))
+    findings = analysis.lint_paths(paths, rules=rules, root=REPO)
+
+    if args.update_baseline:
+        analysis.save_baseline(args.baseline, findings)
+        print(f"mxlint: baseline updated: {len(findings)} finding(s) "
+              f"recorded in {os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline = [] if args.no_baseline else analysis.load_baseline(
+        args.baseline)
+    new, matched, stale = analysis.apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps(_json_report(findings, new, stale, len(matched)),
+                         indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print(f.format())
+        if stale:
+            print(f"mxlint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed findings "
+                  "still in the ledger — run --update-baseline):")
+            for b in stale:
+                print(f"    {b.path}: {b.rule} {b.message[:70]}")
+        print(f"mxlint: {len(findings)} finding(s) "
+              f"({len(matched)} baselined, {len(new)} new, "
+              f"{len(stale)} stale) across "
+              f"{len(analysis.iter_python_files(paths))} file(s)")
+
+    if new:
+        return 1
+    if stale and args.check:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
