@@ -3,38 +3,57 @@
 Static enforcement of the invariants the rest of the stack is built on
 (STATIC_ANALYSIS.md is the rule catalog):
 
-  TPU100  host sync reachable from traced code (hybrid_forward / @jit)
-  TPU101  python control flow on a traced value (recompile storms)
-  TPU102  use-after-donate (reads of buffers consumed by donate_argnums)
+  TPU100  host sync reachable from traced code (hybrid_forward / @jit),
+          through any chain of helper/method calls (via-chain reported)
+  TPU101  python control flow on a traced value, incl. helpers that
+          branch on an argument's value (recompile storms)
+  TPU102  use-after-donate (reads of buffers consumed by donate_argnums,
+          directly or by a helper that donates its argument)
   CONC200 instance attribute mutated with and without its owning lock
   CONC201 lock-order cycles in the acquisition graph (potential deadlock)
   MET300  telemetry metric names failing ^mxtpu_[a-z0-9_]+$ statically
+  THR400  thread lifecycle: started-never-joined non-daemon threads,
+          restart-after-stop races
+  EXC500  broad excepts that swallow the transient/fatal classification
+          in RetryPolicy-wrapped / checkpoint paths (call-graph marked)
+  ENV600  MXNET_* knob / mxtpu_* metric drift between code and the
+          operator docs, both directions
+
+v2 analyzes the scan set as one program: project symbol table + call graph
+(:mod:`.callgraph`), per-function effect summaries propagated to a fixpoint
+(:mod:`.summaries`), an incremental mtime+content-keyed cache
+(:mod:`.cache`), and SARIF 2.1.0 output (:mod:`.sarif`).
 
 Deliberately dependency-free (stdlib ``ast`` only) and import-light: the
 package never imports jax or the rest of mxnet_tpu, so the linter runs in
 any python — CI images, pre-commit hooks — without the accelerator stack.
 
-CLI: ``python tools/mxlint.py [paths ...]`` (text/JSON output, per-line
-``# mxlint: disable=RULE`` suppressions, committed baseline in
-``tools/mxlint_baseline.json``).
+CLI: ``python tools/mxlint.py [paths ...]`` (text/JSON/SARIF output,
+``--changed-only`` git-scoped scans, per-line ``# mxlint: disable=RULE``
+suppressions, committed baseline in ``tools/mxlint_baseline.json``).
 """
 from __future__ import annotations
 
-from .core import (Checker, Finding, SourceFile, all_checkers, get_checker,
-                   iter_python_files, lint_file, lint_paths, register)
+from .core import (Checker, Finding, SourceFile, LAST_SCAN_STATS, VERSION,
+                   all_checkers, get_checker, iter_python_files, lint_file,
+                   lint_paths, register)
 from .baseline import apply_baseline, load_baseline, save_baseline
+from .sarif import to_sarif
 
 # importing the rule modules populates the registry
 from . import tpu_rules    # noqa: F401  (TPU100/TPU101/TPU102)
 from . import conc_rules   # noqa: F401  (CONC200/CONC201)
 from . import met_rules    # noqa: F401  (MET300)
+from . import thr_rules    # noqa: F401  (THR400)
+from . import exc_rules    # noqa: F401  (EXC500)
+from . import env_rules    # noqa: F401  (ENV600)
 
 __all__ = [
     "Checker", "Finding", "SourceFile", "register",
     "all_checkers", "get_checker", "iter_python_files",
-    "lint_file", "lint_paths",
+    "lint_file", "lint_paths", "LAST_SCAN_STATS",
     "apply_baseline", "load_baseline", "save_baseline",
-    "DEFAULT_SCAN_SET",
+    "to_sarif", "VERSION", "DEFAULT_SCAN_SET",
 ]
 
 #: what `python tools/mxlint.py` scans when given no paths: the package

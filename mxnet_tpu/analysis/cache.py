@@ -1,0 +1,127 @@
+"""Incremental analysis cache: re-analyze only what changed.
+
+The tier-1 gate scans ~160 files on every run; almost none of them changed
+since the last run. The cache makes the warm path cheap while staying
+*exactly* as strict as a cold scan:
+
+  - Keyed by **content**, gated by mtime: an entry is consulted only when
+    the file's (mtime, size) match — else the sha256 is recomputed and
+    compared, so ``touch`` alone never invalidates and edits always do.
+  - Stores, per file: the **local** (pre-propagation) function summaries
+    and the per-file checker findings, plus a *dependency record* — every
+    call-ref resolution the file's functions made and the propagated-
+    summary digest of each resolved callee.
+  - A file's findings replay from cache only when its content is unchanged
+    AND its dependency record still holds (same resolutions, same callee
+    digests). Edit a helper and every transitive caller's digest chain
+    moves, so dependent callers re-analyze — the interprocedural findings
+    can never go stale.
+  - Project-scoped rules (EXC500 marking, ENV600 doc drift) are recomputed
+    every run from the summary data — they are global by nature and cheap
+    once summaries exist — so the warm report is bitwise identical to a
+    cold one.
+
+The file is JSON (atomic write-temp + rename, the checkpoint discipline)
+and self-invalidates on version or rule-set mismatch.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+__all__ = ["AnalysisCache", "content_sha"]
+
+CACHE_VERSION = 2
+
+
+def content_sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:24]
+
+
+class AnalysisCache:
+    """Load/consult/update one cache file. All misses are silent — a
+    corrupt or incompatible cache is simply a cold scan."""
+
+    def __init__(self, path: Optional[str], tool_key: str = ""):
+        self.path = path
+        self.tool_key = tool_key
+        self.entries: Dict[str, Dict] = {}
+        self._dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    data = json.load(f)
+                if data.get("version") == CACHE_VERSION and \
+                        data.get("tool_key") == tool_key:
+                    self.entries = data.get("files", {})
+            except (OSError, ValueError):
+                self.entries = {}
+
+    # -- freshness -----------------------------------------------------------
+    def fresh_entry(self, relpath: str, filename: str,
+                    text: str) -> Optional[Dict]:
+        """The entry for ``relpath`` iff the on-disk content still matches;
+        refreshes the stored mtime on a content hit so the stat fast path
+        works next time."""
+        ent = self.entries.get(relpath)
+        if ent is None:
+            return None
+        try:
+            st = os.stat(filename)
+            stat_hit = (ent.get("mtime") == st.st_mtime_ns
+                        and ent.get("size") == st.st_size)
+        except OSError:
+            st = None
+            stat_hit = False
+        if stat_hit:
+            return ent
+        if ent.get("sha") == content_sha(text):
+            if st is not None:
+                ent["mtime"] = st.st_mtime_ns
+                ent["size"] = st.st_size
+                self._dirty = True
+            return ent
+        return None
+
+    @staticmethod
+    def deps_match(ent: Dict, deps: Dict) -> bool:
+        return ent.get("deps") == deps
+
+    # -- updates -------------------------------------------------------------
+    def put(self, relpath: str, filename: str, text: str,
+            summaries: Dict, findings, deps: Dict):
+        try:
+            st = os.stat(filename)
+            mtime, size = st.st_mtime_ns, st.st_size
+        except OSError:
+            mtime, size = 0, len(text)
+        self.entries[relpath] = {
+            "sha": content_sha(text), "mtime": mtime, "size": size,
+            "summaries": summaries, "deps": deps,
+            "findings": [f.to_dict() for f in findings],
+        }
+        self._dirty = True
+
+    def update_deps(self, relpath: str, deps: Dict):
+        ent = self.entries.get(relpath)
+        if ent is not None and ent.get("deps") != deps:
+            ent["deps"] = deps
+            self._dirty = True
+
+    def save(self):
+        if not self.path or not self._dirty:
+            return
+        data = {"version": CACHE_VERSION, "tool_key": self.tool_key,
+                "files": self.entries}
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

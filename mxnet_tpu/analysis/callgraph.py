@@ -1,0 +1,374 @@
+"""Project-wide symbol table & call graph: who can call whom, statically.
+
+The resolution mxlint v2 performs is deliberately the *lexical* 95% —
+the indirections real code in this tree actually uses:
+
+  - bare names: nested defs in enclosing functions (python's lexical
+    scoping, resolved at extraction time), module-level functions, and
+    ``from mod import fn`` symbols when ``mod`` is inside the scan set
+  - ``self.method(...)``: methods of the enclosing class, then base
+    classes named in the same module (depth-bounded)
+  - ``alias.fn(...)`` / ``pkg.mod.fn(...)``: through ``import`` /
+    ``from pkg import mod [as alias]`` when the target module is scanned
+
+Anything dynamic (getattr, callables in containers, monkey-patching)
+resolves to None and the rules stay silent — a linter's job is the obvious
+95% with zero false-positive noise.
+
+Quals are ``<repo-relative-path>::<Scope.dotted.name>`` so they are stable
+across machines and double as cache keys; ``display`` (the scope part) is
+what via-chains print.
+"""
+from __future__ import annotations
+
+import ast
+import json
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceFile
+from .summaries import FunctionSummary, ParamSpace, extract_file
+
+__all__ = ["FuncInfo", "ClassInfo", "ModuleTable", "Project", "modname_of"]
+
+
+def modname_of(path: str) -> str:
+    """Dotted module name for a repo-relative ``*.py`` path."""
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[:-len(".__init__")]
+    return mod
+
+
+class FuncInfo:
+    """One function/method definition plus its summary slot."""
+
+    __slots__ = ("qual", "display", "name", "node", "src", "cls", "parent",
+                 "children", "space", "summary", "module")
+
+    def __init__(self, name: str, node: ast.FunctionDef, src: SourceFile,
+                 module: "ModuleTable", cls: Optional[str],
+                 parent: Optional["FuncInfo"]):
+        self.name = name
+        self.node = node
+        self.src = src
+        self.module = module
+        self.cls = cls
+        self.parent = parent
+        scope = name if parent is None else f"{parent.display}.{name}"
+        if cls is not None and parent is None:
+            scope = f"{cls}.{name}"
+        self.display = scope
+        self.qual = f"{src.path}::{scope}"
+        self.children: Dict[str, "FuncInfo"] = {}
+        is_method = cls is not None and parent is None and \
+            not any(isinstance(d, ast.Name) and d.id == "staticmethod"
+                    for d in node.decorator_list)
+        self.space = ParamSpace(node, is_method)
+        self.summary: Optional[FunctionSummary] = None
+
+    def lexical_defs(self) -> Dict[str, str]:
+        """Nested-def names visible from inside this function, innermost
+        winning — the extraction-time half of bare-name resolution."""
+        chain: List[FuncInfo] = []
+        cur: Optional[FuncInfo] = self
+        while cur is not None:
+            chain.append(cur)
+            cur = cur.parent
+        out: Dict[str, str] = {}
+        for info in reversed(chain):          # outermost first
+            for name, child in info.children.items():
+                out[name] = child.qual
+        return out
+
+    def __repr__(self):
+        return f"<FuncInfo {self.qual}>"
+
+
+class ClassInfo:
+    __slots__ = ("name", "node", "methods", "bases")
+
+    def __init__(self, name: str, node: ast.ClassDef):
+        self.name = name
+        self.node = node
+        self.methods: Dict[str, FuncInfo] = {}
+        self.bases: List[str] = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                self.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                self.bases.append(b.attr)
+
+
+class ModuleTable:
+    """Symbols + import map of one scanned file."""
+
+    __slots__ = ("src", "modname", "functions", "classes",
+                 "module_imports", "symbol_imports", "all_functions")
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.modname = modname_of(src.path)
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.module_imports: Dict[str, str] = {}   # alias -> modname
+        self.symbol_imports: Dict[str, Tuple[str, str]] = {}
+        self.all_functions: List[FuncInfo] = []    # definition order
+        self._collect_symbols(src.tree.body, cls=None, parent=None)
+
+    def _make(self, node, cls, parent) -> FuncInfo:
+        info = FuncInfo(node.name, node, self.src, self, cls, parent)
+        self.all_functions.append(info)
+        return info
+
+    def _collect_symbols(self, body, cls, parent):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = self._make(stmt, cls, parent)
+                if parent is not None:
+                    parent.children[stmt.name] = info
+                elif cls is not None:
+                    self.classes[cls].methods[stmt.name] = info
+                else:
+                    self.functions[stmt.name] = info
+                # nested defs: methods' and functions' inner functions
+                self._collect_symbols(stmt.body, cls=None, parent=info)
+            elif isinstance(stmt, ast.ClassDef) and cls is None and \
+                    parent is None:
+                self.classes[stmt.name] = ClassInfo(stmt.name, stmt)
+                self._collect_symbols(stmt.body, cls=stmt.name, parent=None)
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With)) and \
+                    cls is None and parent is None:
+                # module-level defs under try/if guards still count
+                for field in ("body", "orelse", "finalbody"):
+                    self._collect_symbols(getattr(stmt, field, []) or [],
+                                          cls, parent)
+                for h in getattr(stmt, "handlers", []) or []:
+                    self._collect_symbols(h.body, cls, parent)
+
+    def collect_imports(self, known_modules: Set[str]):
+        """Second pass (needs every module's name known first)."""
+        pkg = self.modname if self.src.path.endswith("__init__.py") \
+            else self.modname.rpartition(".")[0]
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        if alias.name in known_modules:
+                            self.module_imports[alias.asname] = alias.name
+                    else:
+                        top = alias.name.split(".")[0]
+                        self.module_imports.setdefault(top, top)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    parts = pkg.split(".") if pkg else []
+                    if node.level - 1 <= len(parts):
+                        keep = parts[:len(parts) - (node.level - 1)]
+                        base = ".".join(keep + ([node.module]
+                                                if node.module else []))
+                    else:
+                        continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    target = f"{base}.{alias.name}" if base else alias.name
+                    if target in known_modules:
+                        self.module_imports[local] = target
+                    elif base:
+                        self.symbol_imports[local] = (base, alias.name)
+
+
+class Project:
+    """The scan set as one program: files, symbols, summaries, resolution."""
+
+    def __init__(self, sources: Sequence[SourceFile],
+                 root: Optional[str] = None):
+        self.root = root
+        self.files: Dict[str, SourceFile] = {s.path: s for s in sources}
+        self.modules: Dict[str, ModuleTable] = {}
+        self.tables: Dict[str, ModuleTable] = {}   # by path
+        self.by_qual: Dict[str, FuncInfo] = {}
+        for path in sorted(self.files):
+            table = ModuleTable(self.files[path])
+            self.tables[path] = table
+            self.modules[table.modname] = table
+        # tail index: scans rooted outside the repo (CLI on an absolute
+        # path) get path-flavored modnames no import statement could ever
+        # name; a unique last component still resolves `from util import
+        # f`. Importable dotted names are excluded so e.g. `import numpy`
+        # can never be hijacked onto mxnet_tpu.numpy.
+        self._by_tail: Dict[str, List[ModuleTable]] = {}
+        for modname, table in self.modules.items():
+            if all(p.isidentifier() for p in modname.split(".")):
+                continue
+            self._by_tail.setdefault(modname.rpartition(".")[2],
+                                     []).append(table)
+        known = set(self.modules) | {
+            t for t, mods in self._by_tail.items() if len(mods) == 1}
+        for path in sorted(self.tables):
+            table = self.tables[path]
+            table.collect_imports(known)
+            for info in table.all_functions:
+                self.by_qual[info.qual] = info
+        self._call_memo: Dict[Tuple[str, int], Optional[FuncInfo]] = {}
+
+    def _module(self, modname: str) -> Optional["ModuleTable"]:
+        mod = self.modules.get(modname)
+        if mod is not None:
+            return mod
+        if "." not in modname:
+            tail = self._by_tail.get(modname)
+            if tail is not None and len(tail) == 1:
+                return tail[0]
+        return None
+
+    # -- summaries -----------------------------------------------------------
+    def extract(self, cached: Optional[Dict[str, Dict]] = None) -> Set[str]:
+        """Compute the *local* summary of every function, loading files
+        present in ``cached`` (path -> {qual: summary-dict}) instead of
+        re-walking them. Returns the paths that were freshly extracted.
+        Call :meth:`propagate` afterwards — the cache must snapshot local
+        summaries first (propagated ones would embed stale callee effects).
+        """
+        fresh: Set[str] = set()
+        for path in sorted(self.tables):
+            table = self.tables[path]
+            entry = (cached or {}).get(path)
+            if entry is not None:
+                hit = True
+                for info in table.all_functions:
+                    d = entry.get(info.qual)
+                    if d is None:
+                        hit = False
+                        break
+                if hit:
+                    for info in table.all_functions:
+                        info.summary = FunctionSummary.from_dict(
+                            entry[info.qual])
+                    continue
+            extract_file(table.src, table.all_functions)
+            fresh.add(path)
+        return fresh
+
+    def propagate(self):
+        from .summaries import propagate as _propagate
+        _propagate(self)
+
+    def local_summaries(self, path: str) -> Dict[str, Dict]:
+        """Serializable {qual: summary} for one file — what the cache
+        stores. Must be snapshotted before :meth:`propagate` mutates the
+        summaries (propagated ones would embed other files' effects)."""
+        return {info.qual: info.summary.to_dict()
+                for info in self.tables[path].all_functions}
+
+    def sorted_functions(self) -> List[FuncInfo]:
+        return [info for path in sorted(self.tables)
+                for info in self.tables[path].all_functions]
+
+    def summary_digests(self) -> Dict[str, str]:
+        return {q: i.summary.digest() for q, i in self.by_qual.items()
+                if i.summary is not None}
+
+    # -- resolution ----------------------------------------------------------
+    def resolve_ref(self, caller: FuncInfo, ref) -> Optional[FuncInfo]:
+        kind, arg = ref[0], ref[1]
+        if kind == "local":
+            return self.by_qual.get(arg)
+        table = caller.module
+        if kind == "name":
+            info = table.functions.get(arg)
+            if info is not None:
+                return info
+            imp = table.symbol_imports.get(arg)
+            if imp is not None:
+                mod = self._module(imp[0])
+                if mod is not None:
+                    return mod.functions.get(imp[1])
+            return None
+        if kind == "self":
+            return self._resolve_method(table, caller.cls, arg, depth=0)
+        if kind == "dotted":
+            parts = arg.split(".")
+            # alias.sub...fn through an imported module, then absolute
+            head = table.module_imports.get(parts[0])
+            candidates = []
+            if head is not None:
+                candidates.append(".".join([head] + parts[1:-1]))
+            candidates.append(".".join(parts[:-1]))
+            for modname in candidates:
+                mod = self._module(modname)
+                if mod is not None:
+                    info = mod.functions.get(parts[-1])
+                    if info is not None:
+                        return info
+            return None
+        return None
+
+    def _resolve_method(self, table: ModuleTable, cls: Optional[str],
+                        meth: str, depth: int) -> Optional[FuncInfo]:
+        if cls is None or depth > 3:
+            return None
+        ci = table.classes.get(cls)
+        if ci is None:
+            return None
+        info = ci.methods.get(meth)
+        if info is not None:
+            return info
+        for base in ci.bases:
+            info = self._resolve_method(table, base, meth, depth + 1)
+            if info is not None:
+                return info
+        return None
+
+    def resolve_call(self, caller: FuncInfo,
+                     call: ast.Call) -> Optional[FuncInfo]:
+        from .summaries import _call_ref
+        key = (caller.qual, id(call))
+        if key in self._call_memo:
+            return self._call_memo[key]
+        ref = _call_ref(call.func, caller.lexical_defs())
+        out = self.resolve_ref(caller, ref) if ref is not None else None
+        self._call_memo[key] = out
+        return out
+
+    def owner_of(self, src: SourceFile,
+                 node: ast.AST) -> Optional[FuncInfo]:
+        """Innermost FuncInfo whose def encloses ``node`` (by line span)."""
+        line = getattr(node, "lineno", 0)
+        best = None
+        table = self.tables.get(src.path)
+        if table is None:
+            return None
+        for info in table.all_functions:
+            lo = info.node.lineno
+            hi = getattr(info.node, "end_lineno", lo)
+            if lo <= line <= hi and (
+                    best is None or lo >= best.node.lineno):
+                best = info
+        return best
+
+    # -- cache support -------------------------------------------------------
+    def resolution_map(self, path: str) -> Dict[str, Optional[str]]:
+        """Every ref this file's functions make -> resolved qual (or None).
+        A changed answer for any entry means the file's findings can no
+        longer be replayed from cache."""
+        out: Dict[str, Optional[str]] = {}
+        table = self.tables.get(path)
+        if table is None:
+            return out
+        for info in table.all_functions:
+            refs = [cs["ref"] for cs in info.summary.calls]
+            refs += [w["ref"] for w in info.summary.wrap_sites]
+            for ref in refs:
+                key = f"{info.qual}|{json.dumps(ref)}"
+                if key not in out:
+                    target = self.resolve_ref(info, ref)
+                    out[key] = target.qual if target is not None else None
+        return out
+
+    def deps_of(self, path: str,
+                resolutions: Dict[str, Optional[str]],
+                digests: Dict[str, str]) -> Dict:
+        quals = sorted({q for q in resolutions.values() if q is not None})
+        return {"res": resolutions,
+                "dig": {q: digests.get(q, "") for q in quals}}

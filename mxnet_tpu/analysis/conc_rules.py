@@ -206,7 +206,7 @@ class UnlockedSharedMutation(Checker):
             "caller-holds-lock helper with `# mxlint: disable=CONC200` on "
             "its def line.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
         for cls in ast.walk(src.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
@@ -355,7 +355,7 @@ class LockOrderCycles(Checker):
             "two threads interleaving those paths deadlock. Impose one "
             "global acquisition order.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
         for cls in ast.walk(src.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue

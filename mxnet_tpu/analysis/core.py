@@ -7,16 +7,28 @@ traced-value branching, no use-after-donate, lock-consistent mutation — are
 checkable on the AST, so they gate in CI instead of relying on reviewer
 vigilance.
 
-Architecture (the classic pluggable-linter shape):
+Architecture (the classic pluggable-linter shape, plus a whole-program
+layer):
 
   - :class:`Checker` subclasses register themselves with :func:`register`;
-    each owns one rule code (``TPU100``, ``CONC200``, ...) and walks a parsed
-    :class:`SourceFile`, yielding :class:`Finding`\\ s.
+    each owns one rule code (``TPU100``, ``CONC200``, ...).  File-scoped
+    checkers walk one parsed :class:`SourceFile` (with the
+    :class:`Project` available for call resolution); project-scoped
+    checkers (``scope = "project"``) run once over the whole scan set
+    (EXC500's call-graph marking, ENV600's code-vs-docs drift).
+  - The scan set is analyzed as one program: a symbol table and call graph
+    (:mod:`.callgraph`), per-function effect summaries propagated to a
+    fixpoint (:mod:`.summaries`), and an optional incremental cache
+    (:mod:`.cache`) that replays findings for files whose content *and*
+    dependency summaries are unchanged.
   - Suppressions are comments: ``# mxlint: disable=RULE[,RULE|all]`` on the
     offending line silences that line; on a ``def``/``class`` line it
     silences the whole scope (the sanctioned way to encode "caller holds the
     lock" helpers); ``# mxlint: disable-file=RULE`` anywhere silences the
-    file.
+    file.  Interprocedural findings honor both ends: a disable on the call
+    site line silences the via-chain finding there, and a disable covering
+    the helper's definition removes the effect from the helper's summary so
+    every caller goes silent.
   - Findings carry a *fingerprint* — a hash of (rule, path, source-line
     text, occurrence index) that is stable under unrelated line insertions —
     so the committed baseline (:mod:`.baseline`) survives drift without
@@ -33,11 +45,21 @@ import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Finding", "SourceFile", "Checker", "register", "all_checkers",
-           "get_checker", "iter_python_files", "lint_file", "lint_paths"]
+           "get_checker", "iter_python_files", "lint_file", "lint_paths",
+           "LAST_SCAN_STATS", "VERSION"]
+
+#: mxlint version: stamps the SARIF driver and keys the incremental cache
+#: (any version bump is a full cold scan)
+VERSION = "2.0"
 
 _DISABLE_RE = re.compile(
     r"#\s*mxlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)")
 _SCOPE_LINE_RE = re.compile(r"^\s*(?:async\s+def|def|class)\b")
+
+#: how the last :func:`lint_paths` run split the scan (for the CLI status
+#: line and the incremental-cache tests): ``checked`` were analyzed fresh,
+#: ``cache_hits`` replayed findings from the cache
+LAST_SCAN_STATS: Dict[str, list] = {"checked": [], "cache_hits": []}
 
 
 class Finding:
@@ -180,13 +202,20 @@ class SourceFile:
 
 class Checker:
     """Base class for one lint rule. Subclasses set ``rule`` / ``name`` /
-    ``help`` and implement :meth:`check`."""
+    ``help`` and implement :meth:`check` (file scope, called once per file
+    with the whole-program :class:`~.callgraph.Project` for call
+    resolution) or :meth:`check_project` (``scope = "project"``, called
+    once per scan)."""
 
     rule: str = ""
     name: str = ""
     help: str = ""
+    scope: str = "file"
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, project) -> Iterable[Finding]:
         raise NotImplementedError
 
 
@@ -227,36 +256,123 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return sorted(set(out))
 
 
-def lint_file(filename: str, rules: Optional[Sequence[str]] = None,
-              root: Optional[str] = None,
-              text: Optional[str] = None) -> List[Finding]:
-    """Run (a subset of) the registered checkers over one file. Suppressed
-    findings are dropped here; syntax errors become a single MX000 finding
-    instead of raising (a linter must not die on the code it lints)."""
-    try:
-        src = SourceFile(filename, text=text, root=root)
-    except SyntaxError as e:
-        path = SourceFile._relpath(filename, root)
-        return [Finding("MX000", path, e.lineno or 0, e.offset or 0,
-                        f"syntax error: {e.msg}",
-                        fingerprint=hashlib.sha256(
-                            f"MX000|{path}".encode()).hexdigest()[:16])]
-    wanted = {r.upper() for r in rules} if rules else None
+def _mx000(filename: str, root: Optional[str], e: SyntaxError) -> Finding:
+    path = SourceFile._relpath(filename, root)
+    return Finding("MX000", path, e.lineno or 0, e.offset or 0,
+                   f"syntax error: {e.msg}",
+                   fingerprint=hashlib.sha256(
+                       f"MX000|{path}".encode()).hexdigest()[:16])
+
+
+def _check_file(src: SourceFile, project) -> List[Finding]:
+    """Run every file-scoped checker over one parsed file."""
     findings: List[Finding] = []
     for checker in all_checkers():
-        if wanted is not None and checker.rule not in wanted:
+        if checker.scope != "file":
             continue
-        for f in checker.check(src):
+        for f in checker.check(src, project):
             if not src.is_suppressed(f.rule, f.line):
                 findings.append(f)
+    return findings
+
+
+def _project_findings(project) -> List[Finding]:
+    findings: List[Finding] = []
+    for checker in all_checkers():
+        if checker.scope != "project":
+            continue
+        for f in checker.check_project(project):
+            src = project.files.get(f.path)
+            if src is None or not src.is_suppressed(f.rule, f.line):
+                findings.append(f)
+    return findings
+
+
+def _filter_sort(findings: List[Finding],
+                 rules: Optional[Sequence[str]]) -> List[Finding]:
+    wanted = {r.upper() for r in rules} if rules else None
+    if wanted is not None:
+        findings = [f for f in findings if f.rule in wanted]
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
+def lint_file(filename: str, rules: Optional[Sequence[str]] = None,
+              root: Optional[str] = None,
+              text: Optional[str] = None) -> List[Finding]:
+    """Lint one file as a single-file program (helper/method indirection
+    within the file still resolves). Suppressed findings are dropped here;
+    syntax errors become a single MX000 finding instead of raising (a
+    linter must not die on the code it lints)."""
+    from .callgraph import Project
+    try:
+        src = SourceFile(filename, text=text, root=root)
+    except SyntaxError as e:
+        return _filter_sort([_mx000(filename, root, e)], rules)
+    project = Project([src], root=root)
+    project.extract()
+    project.propagate()
+    findings = _check_file(src, project) + _project_findings(project)
+    return _filter_sort(findings, rules)
+
+
 def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
-               root: Optional[str] = None) -> List[Finding]:
-    """Lint every python file under ``paths``; the whole-scan entry point."""
+               root: Optional[str] = None,
+               cache_path: Optional[str] = None) -> List[Finding]:
+    """Lint every python file under ``paths`` as one program — the
+    whole-scan entry point.
+
+    With ``cache_path`` the incremental cache is consulted: files whose
+    content and dependency summaries are unchanged replay their findings
+    without re-analysis (see :mod:`.cache`); the report is identical to a
+    cold scan either way. ``LAST_SCAN_STATS`` records the split.
+    """
+    from .callgraph import Project
+    from .cache import AnalysisCache
+    cache = AnalysisCache(cache_path, tool_key=f"mxlint-{VERSION}") \
+        if cache_path else None
+
+    sources: List[SourceFile] = []
     findings: List[Finding] = []
     for filename in iter_python_files(paths):
-        findings.extend(lint_file(filename, rules=rules, root=root))
-    return findings
+        try:
+            sources.append(SourceFile(filename, root=root))
+        except SyntaxError as e:
+            findings.append(_mx000(filename, root, e))
+
+    project = Project(sources, root=root)
+    cached_summaries: Dict[str, Dict] = {}
+    if cache is not None:
+        for path in sorted(project.files):
+            src = project.files[path]
+            ent = cache.fresh_entry(path, src.filename, src.text)
+            if ent is not None:
+                cached_summaries[path] = ent["summaries"]
+    project.extract(cached=cached_summaries)
+    local_snap = {p: project.local_summaries(p) for p in project.files}
+    project.propagate()
+    digests = project.summary_digests()
+
+    LAST_SCAN_STATS["checked"] = []
+    LAST_SCAN_STATS["cache_hits"] = []
+    for path in sorted(project.files):
+        src = project.files[path]
+        resolutions = project.resolution_map(path)
+        deps = project.deps_of(path, resolutions, digests)
+        ent = cache.entries.get(path) if cache is not None else None
+        if path in cached_summaries and ent is not None and \
+                cache.deps_match(ent, deps):
+            file_findings = [Finding.from_dict(d) for d in ent["findings"]]
+            LAST_SCAN_STATS["cache_hits"].append(path)
+        else:
+            file_findings = _check_file(src, project)
+            LAST_SCAN_STATS["checked"].append(path)
+            if cache is not None:
+                cache.put(path, src.filename, src.text,
+                          local_snap[path], file_findings, deps)
+        findings.extend(file_findings)
+
+    findings.extend(_project_findings(project))
+    if cache is not None:
+        cache.save()
+    return _filter_sort(findings, rules)

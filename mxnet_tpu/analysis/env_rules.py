@@ -1,0 +1,175 @@
+"""Cross-artifact drift rules: code knobs & metrics vs the operator docs.
+
+The operational surface of this stack is its ``MXNET_*`` environment knobs
+and ``mxtpu_*`` metric families. Those live in three places that drift
+independently: the code that reads/registers them, and the operator docs
+(README, RESILIENCE.md, OBSERVABILITY.md) that dashboards and runbooks are
+built from. A knob that ships undocumented is a support ticket; a
+documented metric that no longer exists is a silent dashboard hole.
+
+  ENV600  two-way existence check, project-scoped:
+          - every ``MXNET_*`` knob **read** in the operational subsystems
+            (serving/, resilience/, telemetry/ — the modules the docs
+            claim to cover) and every ``mxtpu_*`` metric **registered**
+            anywhere must be mentioned in at least one doc;
+          - every knob/metric token the docs **claim** (outside fenced
+            code blocks — examples don't count) must still exist as a
+            literal in the code. A trailing-underscore token
+            (``mxtpu_serving_*`` written as ``mxtpu_serving_``) is a
+            family wildcard and matches by prefix.
+
+The rule only arms on a full scan (the config registry
+``mxnet_tpu/config.py`` must be in the scan set and at least one doc must
+exist under the project root) — on a partial scan "not found in code"
+would be meaningless.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .core import Checker, Finding, register
+
+__all__ = ["ConfigDocDrift", "DOC_FILES", "KNOB_SCOPES"]
+
+#: the operator docs that participate in the drift check (repo-root
+#: relative; missing ones are skipped)
+DOC_FILES = ("README.md", "OBSERVABILITY.md", "RESILIENCE.md",
+             "STATIC_ANALYSIS.md")
+#: code-side knob reads are collected from these path prefixes only — the
+#: subsystems the docs above document; legacy engine/perf knobs are owned
+#: by ``config.describe()`` and PERF.md
+KNOB_SCOPES = ("mxnet_tpu/serving/", "mxnet_tpu/resilience/",
+               "mxnet_tpu/telemetry/")
+#: presence of this file marks a full scan (the ENV600 arming condition)
+GATE_FILE = "mxnet_tpu/config.py"
+
+_KNOB_FULL = re.compile(r"^MXNET_[A-Z0-9_]*[A-Z0-9]$")
+_MET_FULL = re.compile(r"^mxtpu_[a-z0-9_]*[a-z0-9]$")
+_KNOB_TOKEN = re.compile(r"(?<![A-Za-z0-9_])MXNET_[A-Z0-9_]+")
+_MET_TOKEN = re.compile(r"(?<![A-Za-z0-9_])mxtpu_[a-z0-9_]+")
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _doc_tokens(line: str) -> List[str]:
+    return _KNOB_TOKEN.findall(line) + _MET_TOKEN.findall(line)
+
+
+class _DocIndex:
+    """Tokens the docs mention (anywhere) and claim (outside code fences)."""
+
+    def __init__(self, root: str):
+        import os
+        self.mentions: Set[str] = set()
+        self.claims: List[Tuple[str, str, int, str]] = []
+        seen_claim: Set[Tuple[str, str]] = set()
+        self.docs: List[str] = []
+        for doc in DOC_FILES:
+            path = os.path.join(root, doc)
+            if not os.path.exists(path):
+                continue
+            self.docs.append(doc)
+            with open(path, "r", encoding="utf-8") as f:
+                fenced = False
+                for lineno, line in enumerate(f, 1):
+                    if line.lstrip().startswith("```"):
+                        fenced = not fenced
+                        continue
+                    for tok in _doc_tokens(line):
+                        self.mentions.add(tok)
+                        if not fenced and (tok, doc) not in seen_claim:
+                            seen_claim.add((tok, doc))
+                            self.claims.append((tok, doc, lineno,
+                                                line.strip()))
+
+    def documented(self, name: str) -> bool:
+        if name in self.mentions:
+            return True
+        return any(m.endswith("_") and name.startswith(m)
+                   for m in self.mentions)
+
+
+@register
+class ConfigDocDrift(Checker):
+    rule = "ENV600"
+    name = "config-doc-drift"
+    scope = "project"
+    help = ("Every MXNET_* knob read in serving/resilience/telemetry and "
+            "every mxtpu_* metric registered anywhere must appear in the "
+            "operator docs (README/RESILIENCE.md/OBSERVABILITY.md), and "
+            "every knob/metric the docs claim must still exist in code. "
+            "Undocumented knobs are support tickets; documented ghosts "
+            "are dashboard holes.")
+
+    def check_project(self, project) -> Iterable[Finding]:
+        if project.root is None or GATE_FILE not in project.files:
+            return
+        docs = _DocIndex(project.root)
+        if not docs.docs:
+            return
+        knob_reads: List[Tuple[str, object, ast.AST]] = []
+        registrations: List[Tuple[str, object, ast.AST]] = []
+        universe: Set[str] = set()
+        for path in sorted(project.files):
+            src = project.files[path]
+            scoped = path.startswith(KNOB_SCOPES)
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    if _KNOB_FULL.match(node.value) or \
+                            _MET_FULL.match(node.value):
+                        universe.add(node.value)
+                if not isinstance(node, ast.Call):
+                    continue
+                if scoped:
+                    for arg in list(node.args) + \
+                            [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.Constant) and \
+                                isinstance(arg.value, str) and \
+                                _KNOB_FULL.match(arg.value):
+                            knob_reads.append((arg.value, src, arg))
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+                if fname in _METRIC_FACTORIES and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str) and \
+                        _MET_FULL.match(node.args[0].value):
+                    registrations.append((node.args[0].value, src,
+                                          node.args[0]))
+        doc_list = "/".join(docs.docs)
+        seen_undoc: Set[Tuple[str, str]] = set()
+        for kind, items in (("knob", knob_reads),
+                            ("metric", registrations)):
+            for name, src, node in items:
+                if docs.documented(name):
+                    continue
+                if (name, src.path) in seen_undoc:
+                    continue      # one finding per name per file
+                seen_undoc.add((name, src.path))
+                art = "read" if kind == "knob" else "registered"
+                yield src.finding(
+                    self.rule, node,
+                    f"{kind} `{name}` is {art} here but documented in "
+                    f"none of {doc_list}: add it to the operator docs "
+                    "(undocumented knobs/metrics are config drift)")
+        # docs -> code
+        fp_seen: Dict[str, int] = {}
+        for tok, doc, lineno, snippet in docs.claims:
+            if tok.endswith("_"):
+                if any(u.startswith(tok) for u in universe):
+                    continue
+            elif tok in universe:
+                continue
+            idx = fp_seen.get(snippet, 0)
+            fp_seen[snippet] = idx + 1
+            fp = hashlib.sha256(
+                f"ENV600|{doc}|{snippet}|{idx}".encode()).hexdigest()[:16]
+            yield Finding(
+                "ENV600", doc, lineno, 0,
+                f"`{tok}` is documented here but exists nowhere in the "
+                "scanned code (no literal read/registration): stale doc — "
+                "update or remove the entry", snippet, fp)

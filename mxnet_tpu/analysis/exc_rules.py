@@ -1,0 +1,160 @@
+"""Exception-handling rules for the fault-classified paths.
+
+The resilience layer's whole contract is that errors reach a *classifier*:
+``RetryPolicy.run`` decides transient-vs-fatal from the exception, and the
+checkpoint writer re-raises so ``wait()``/``restore_latest`` can fall back.
+A ``try: ... except Exception: pass`` anywhere along those paths silently
+converts both kinds into "fine", which is strictly worse than crashing —
+the retry loop spins on a fatal error's side effects, or a torn checkpoint
+gets reported as saved.
+
+  EXC500  a broad handler (bare ``except`` / ``except Exception`` /
+          ``except BaseException``) that *swallows* — no re-raise, never
+          uses the bound exception, calls no classifier — inside a function
+          that is (a) passed to ``RetryPolicy.run`` (resolved through the
+          call graph, so wrapped closures and methods count) or reachable
+          from one, or (b) part of a checkpoint write/restore surface
+          (``*Checkpoint*`` classes, ``*checkpoint*``/``*ckpt*``
+          functions) or reachable from one. The finding names the path
+          that makes the handler load-bearing (``reached via: ...``).
+
+Handlers that *use* the error — re-raise, store it for a later
+``wait()``-style surface, log it, classify it — are fine; so is any broad
+except outside the classified paths (guarding a user callback with
+``except Exception: pass`` is the documented watchdog idiom).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Checker, Finding, register
+from .summaries import dotted
+
+__all__ = ["ExceptSwallowsClassification"]
+
+_BROAD = {"Exception", "BaseException"}
+_CLASSIFIERS = {"classify", "classify_error", "is_transient", "is_fatal"}
+_CKPT_MARKERS = ("checkpoint", "ckpt")
+_MAX_DEPTH = 5
+
+
+def _broad_name(handler: ast.ExceptHandler) -> Optional[str]:
+    """'Exception'/'BaseException'/'' when the handler is overbroad."""
+    t = handler.type
+    if t is None:
+        return ""
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        d = dotted(n).rsplit(".", 1)[-1]
+        if d in _BROAD:
+            return d
+    return None
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler neither re-raises nor uses the error."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            callee = dotted(node.func).rsplit(".", 1)[-1]
+            if callee in _CLASSIFIERS:
+                return False
+        if handler.name and isinstance(node, ast.Name) and \
+                node.id == handler.name and isinstance(node.ctx, ast.Load):
+            return False
+    return True
+
+
+def _own_handlers(fn: ast.AST):
+    """Except handlers belonging to this def (nested defs excluded — they
+    are marked and scanned under their own qual)."""
+    stack = [fn]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        first = False
+        if isinstance(node, ast.ExceptHandler):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_checkpointish(info) -> bool:
+    name = info.name.lower()
+    cls = (info.cls or "").lower()
+    return any(m in name or m in cls for m in _CKPT_MARKERS)
+
+
+@register
+class ExceptSwallowsClassification(Checker):
+    rule = "EXC500"
+    name = "except-swallows-classification"
+    scope = "project"
+    help = ("A broad except (bare / Exception / BaseException) that "
+            "neither re-raises nor uses the error, inside a "
+            "RetryPolicy-wrapped or checkpoint-write path: the "
+            "transient/fatal classification never sees the failure, so "
+            "retries spin on fatal errors and torn checkpoints report as "
+            "saved. Re-raise, narrow the type, or record the exception.")
+
+    def check_project(self, project) -> Iterable[Finding]:
+        marked: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        infos = project.sorted_functions()
+        # seeds (a): callables handed to RetryPolicy.run
+        for info in infos:
+            for w in info.summary.wrap_sites:
+                target = project.resolve_ref(info, w["ref"])
+                if target is not None:
+                    marked.setdefault(target.qual,
+                                      ("RetryPolicy-wrapped",
+                                       (info.display,)))
+        # seeds (b): the checkpoint write/restore surface
+        for info in infos:
+            if _is_checkpointish(info):
+                marked.setdefault(info.qual, ("checkpoint", ()))
+        # transitive closure: whatever a marked function calls is on the
+        # classified path too (depth-bounded; first mark wins)
+        frontier = sorted(marked)
+        depth = 0
+        while frontier and depth < _MAX_DEPTH:
+            nxt: List[str] = []
+            for qual in frontier:
+                info = project.by_qual.get(qual)
+                if info is None or info.summary is None:
+                    continue
+                kind, chain = marked[qual]
+                for cs in info.summary.calls:
+                    callee = project.resolve_ref(info, cs["ref"])
+                    if callee is None or callee.qual in marked:
+                        continue
+                    marked[callee.qual] = (kind, chain + (info.display,))
+                    nxt.append(callee.qual)
+            frontier = nxt
+            depth += 1
+        # scan the marked set
+        for qual in sorted(marked):
+            info = project.by_qual.get(qual)
+            if info is None:
+                continue
+            kind, chain = marked[qual]
+            src = info.src
+            via = ""
+            if chain:
+                via = f" (reached via: {' -> '.join(chain)} -> " \
+                      f"{info.display})"
+            for handler in _own_handlers(info.node):
+                broad = _broad_name(handler)
+                if broad is None or not _swallows(handler):
+                    continue
+                what = f"`except {broad}`" if broad else "bare `except:`"
+                yield src.finding(
+                    self.rule, handler,
+                    f"broad {what} swallows the error inside the "
+                    f"{kind} path `{info.display}`{via}: the "
+                    "transient/fatal classification never sees the "
+                    "failure — re-raise, narrow the exception type, or "
+                    "record the error for the caller")

@@ -33,7 +33,7 @@ class MetricNameLint(Checker):
             "violation statically keeps a bad name from ever reaching a "
             "running process or a dashboard.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
         for node in ast.walk(src.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue

@@ -1,0 +1,84 @@
+"""SARIF 2.1.0 emitter: mxlint findings as code-scanning annotations.
+
+SARIF (Static Analysis Results Interchange Format, OASIS 2.1.0) is what CI
+code-scanning UIs ingest: one ``run`` with a ``tool.driver`` carrying the
+rule catalog (the same metadata ``--list-rules`` prints) and one ``result``
+per finding, each with a physical location and our line-drift-stable
+fingerprint under ``partialFingerprints`` so annotation identity survives
+unrelated edits exactly like the baseline ledger does.
+
+Only the minimal, universally consumed subset is emitted — schema/version,
+driver + rules, results with ruleId/level/message/locations/fingerprints —
+and the tests validate that shape structurally.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+__all__ = ["SARIF_VERSION", "SARIF_SCHEMA", "to_sarif"]
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+#: MX000 means the file can't be analyzed at all; everything else is a
+#: gate-failing warning (CI decides severity via the exit code)
+_LEVELS = {"MX000": "error"}
+
+
+def _rules_metadata(checkers) -> List[Dict]:
+    rules = []
+    for c in checkers:
+        rules.append({
+            "id": c.rule,
+            "name": c.name,
+            "shortDescription": {"text": c.name.replace("-", " ")},
+            "fullDescription": {"text": c.help},
+            "defaultConfiguration": {
+                "level": _LEVELS.get(c.rule, "warning")},
+            "properties": {"scope": getattr(c, "scope", "file")},
+        })
+    rules.append({
+        "id": "MX000", "name": "syntax-error",
+        "shortDescription": {"text": "syntax error"},
+        "fullDescription": {"text": "The file does not parse; nothing "
+                                    "else can be checked."},
+        "defaultConfiguration": {"level": "error"},
+        "properties": {"scope": "file"},
+    })
+    return rules
+
+
+def to_sarif(findings: Sequence, checkers, tool_version: str) -> Dict:
+    """Build the SARIF 2.1.0 document for one scan."""
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.rule, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col + 1, 1),
+                               "snippet": {"text": f.snippet}},
+                },
+            }],
+            "partialFingerprints": {"mxlintFingerprint/v1": f.fingerprint},
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "version": tool_version,
+                "informationUri": "STATIC_ANALYSIS.md",
+                "rules": _rules_metadata(checkers),
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }

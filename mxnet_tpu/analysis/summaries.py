@@ -1,0 +1,627 @@
+"""Per-function effect summaries: the interprocedural layer of mxlint.
+
+v1 checked each function body in isolation, which is exactly the blind spot
+real code grows into: a ``hybrid_forward`` that calls a helper which calls
+``.asnumpy()`` passed clean. v2 closes it the way whole-program compilers do
+(the Julia-to-TPU pipeline, TVM's operator-level analysis): compute a small
+*summary* of every function's externally visible effects, propagate
+summaries bottom-up over the call graph to a fixpoint, and let the rules
+consult the summary at each call site instead of re-walking callee bodies.
+
+A :class:`FunctionSummary` records, for one function:
+
+  - ``sync_always``   host syncs that happen no matter what is passed
+                      (``.asnumpy()`` / ``.asscalar()`` / ``.wait_to_read()``
+                      anywhere in the body)
+  - ``sync_param``    param index -> syncs that fire when *that* argument is
+                      traced (``.item()`` / ``float()`` / ``np.asarray()``
+                      on values derived from it)
+  - ``branch_param``  param index -> python control flow on values derived
+                      from it (the recompile-storm summary)
+  - ``donate_param``  param index -> the argument is donated to a compiled
+                      call inside (the "consumes its argument" summary)
+  - ``calls``         serializable call-site records (how to resolve the
+                      callee + which params flow into which argument), the
+                      edges summaries propagate over
+  - ``wrap_sites``    ``<retryish>.run(fn)`` sites (EXC500's seed set)
+
+Every effect carries provenance — the ultimate source location plus the
+*via-chain* of function names it propagated through — so a finding reported
+at a traced call site can say exactly which path reaches the sync.
+
+Suppressions participate at extraction time: an effect whose source line is
+``# mxlint: disable``-d (including a def-scope disable on the helper) never
+enters the summary, so silencing the helper silences every caller — the
+def-site side of the call-site/def-site suppression contract.
+
+Summaries are plain data (tuples/dicts, no AST nodes) precisely so the
+incremental cache can persist them: an unchanged file's summaries load from
+the cache without re-walking its AST.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import SourceFile
+
+__all__ = ["Effect", "ParamSpace", "FunctionSummary", "extract_file",
+           "origins_of", "build_origin_map", "traced_params",
+           "MAX_CHAIN"]
+
+#: via-chains longer than this stop growing (recursion guard; nobody debugs
+#: a nine-hop indirection from a lint message anyway)
+MAX_CHAIN = 6
+
+# -- the syntactic vocabulary shared with tpu_rules (kept here so both the
+# -- summary extractor and the call-site checkers agree on what syncs) ------
+SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read"}
+SYNC_METHODS_TAINTED = {"item", "tolist"}
+NUMPY_MODULES = {"np", "onp", "numpy"}
+NUMPY_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray"}
+BUILTIN_SYNCS = {"float", "int", "bool", "complex"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "ctx", "stype"}
+STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """@jit / @jax.jit / @partial(jax.jit, ...) / @pjit(...) shapes."""
+    if isinstance(dec, ast.Call):
+        name = dotted(dec.func)
+        if name.rsplit(".", 1)[-1] in ("jit", "pjit"):
+            return True
+        if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return is_jit_decorator(dec.args[0])
+        return False
+    return dotted(dec).rsplit(".", 1)[-1] in ("jit", "pjit")
+
+
+def donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """For a jit/pjit wrapper construction, the literal donate_argnums
+    positions (None when absent or not statically known)."""
+    if dotted(call.func).rsplit(".", 1)[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, int)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return None               # dynamic: can't reason statically
+    return None
+
+
+# ---------------------------------------------------------------------------
+# parameter space
+# ---------------------------------------------------------------------------
+class ParamSpace:
+    """One function's parameters as a flat index space.
+
+    Indices cover positional params (``self``/``cls`` of methods excluded —
+    call sites never pass them explicitly), then keyword-only params, then
+    the ``*args`` / ``**kwargs`` catch-alls. ``map_pos``/``map_kw`` translate
+    a call-site argument slot into this space.
+    """
+
+    __slots__ = ("names", "npos", "vararg_idx", "kwarg_idx", "seq_idxs",
+                 "_index")
+
+    def __init__(self, fn: ast.FunctionDef, is_method: bool):
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if is_method and pos and pos[0] in ("self", "cls"):
+            pos = pos[1:]
+        names = list(pos)
+        names += [a.arg for a in fn.args.kwonlyargs]
+        self.npos = len(pos)
+        self.vararg_idx = self.kwarg_idx = None
+        self.seq_idxs: Set[int] = set()
+        if fn.args.vararg:
+            self.vararg_idx = len(names)
+            self.seq_idxs.add(self.vararg_idx)
+            names.append(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            self.kwarg_idx = len(names)
+            self.seq_idxs.add(self.kwarg_idx)
+            names.append(fn.args.kwarg.arg)
+        self.names = names
+        self._index = {n: i for i, n in enumerate(names)}
+
+    def index(self, name: str) -> Optional[int]:
+        return self._index.get(name)
+
+    def map_pos(self, i: int) -> Optional[int]:
+        if i < self.npos:
+            return i
+        return self.vararg_idx
+
+    def map_kw(self, name: str) -> Optional[int]:
+        idx = self._index.get(name)
+        if idx is not None and idx not in self.seq_idxs:
+            return idx
+        return self.kwarg_idx
+
+
+def traced_params(fn: ast.FunctionDef,
+                  space: ParamSpace) -> Optional[Set[int]]:
+    """Indices (in ``space``) of params holding traced values, or None when
+    ``fn`` is not a traced context. ``hybrid_forward(self, F, x, ...)``: the
+    op namespace ``F`` is python-side, everything after is traced;
+    ``@jit``-decorated: every param is."""
+    if fn.name == "hybrid_forward":
+        # space already dropped self; params from index 1 (after F) traced,
+        # including the *args/**kwargs containers (of traced arrays)
+        return {i for i in range(len(space.names)) if i >= 1}
+    if any(is_jit_decorator(d) for d in fn.decorator_list):
+        return set(range(len(space.names)))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# origin dataflow (the v1 taint fixpoint, generalized to per-param sets)
+# ---------------------------------------------------------------------------
+def origins_of(node: ast.AST, omap: Dict[str, Set[int]],
+               seqs: Set[str], space: ParamSpace) -> Set[int]:
+    """Parameter indices the *value* of ``node`` depends on.
+
+    The static-under-trace escapes return the empty set: ``.shape`` /
+    ``.dtype`` reads, ``len()``/``isinstance()``, identity checks
+    (``is None``), and the bare truthiness of a ``*args``-style container
+    (a python tuple). A subscript of such a container IS its elements.
+    """
+    if isinstance(node, ast.Name):
+        if node.id in seqs:
+            return set()          # tuple truthiness/iteration is static
+        return omap.get(node.id, set())
+    if isinstance(node, ast.Constant):
+        return set()
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return set()
+        return origins_of(node.value, omap, seqs, space)
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func).rsplit(".", 1)[-1]
+        if fname in STATIC_FUNCS:
+            return set()
+        out = origins_of(node.func, omap, seqs, space)
+        for a in node.args:
+            out = out | origins_of(a, omap, seqs, space)
+        for k in node.keywords:
+            out = out | origins_of(k.value, omap, seqs, space)
+        return out
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return set()          # `x is None` is a static python-side check
+        out = set()
+        for n in [node.left] + list(node.comparators):
+            out = out | origins_of(n, omap, seqs, space)
+        return out
+    if isinstance(node, ast.Subscript):
+        v = node.value
+        if isinstance(v, ast.Name) and v.id in seqs:
+            idx = space.index(v.id)
+            return {idx} if idx is not None else set()
+        return (origins_of(v, omap, seqs, space)
+                | origins_of(node.slice, omap, seqs, space))
+    if isinstance(node, ast.Starred):
+        v = node.value            # *states forwards the traced elements
+        if isinstance(v, ast.Name) and v.id in seqs:
+            idx = space.index(v.id)
+            return {idx} if idx is not None else set()
+        return origins_of(v, omap, seqs, space)
+    out = set()
+    for c in ast.iter_child_nodes(node):
+        out = out | origins_of(c, omap, seqs, space)
+    return out
+
+
+def build_origin_map(fn: ast.FunctionDef,
+                     space: ParamSpace) -> Tuple[Dict[str, Set[int]],
+                                                 Set[str]]:
+    """``(name -> param origins, seq param names)`` for ``fn``: params seed
+    their own index; assignments propagate to a fixpoint (same shape as the
+    v1 taint loop — only Store-context names carry, seq containers stay
+    static)."""
+    seqs = {space.names[i] for i in space.seq_idxs}
+    omap: Dict[str, Set[int]] = {
+        n: {i} for i, n in enumerate(space.names) if i not in space.seq_idxs}
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and node.value is not None:
+                org = origins_of(node.value, omap, seqs, space)
+                if not org:
+                    continue
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) and \
+                                isinstance(n.ctx, ast.Store) and \
+                                n.id not in seqs and \
+                                not org <= omap.get(n.id, set()):
+                            omap[n.id] = omap.get(n.id, set()) | org
+                            changed = True
+            elif isinstance(node, ast.AugAssign):
+                org = origins_of(node.value, omap, seqs, space)
+                if org and isinstance(node.target, ast.Name) and \
+                        node.target.id not in seqs and \
+                        not org <= omap.get(node.target.id, set()):
+                    omap[node.target.id] = \
+                        omap.get(node.target.id, set()) | org
+                    changed = True
+    return omap, seqs
+
+
+# ---------------------------------------------------------------------------
+# effects & summaries
+# ---------------------------------------------------------------------------
+class Effect:
+    """One summarized effect with provenance.
+
+    ``path``/``line`` locate the ultimate source (where the sync/branch/
+    donation textually lives); ``chain`` is the tuple of function display
+    names between the summarized function and that source (empty for a
+    local effect). Identity for dedup is ``(reason, path, line)`` — the
+    first (shortest) chain to reach a site wins.
+    """
+
+    __slots__ = ("kind", "reason", "path", "line", "chain")
+
+    def __init__(self, kind: str, reason: str, path: str, line: int,
+                 chain: Tuple[str, ...] = ()):
+        self.kind = kind
+        self.reason = reason
+        self.path = path
+        self.line = line
+        self.chain = tuple(chain)
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.reason, self.path, self.line)
+
+    def lifted(self, via: str) -> "Effect":
+        return Effect(self.kind, self.reason, self.path, self.line,
+                      (via,) + self.chain)
+
+    def to_dict(self) -> Dict:
+        return {"kind": self.kind, "reason": self.reason, "path": self.path,
+                "line": self.line, "chain": list(self.chain)}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Effect":
+        return cls(d["kind"], d["reason"], d["path"], d["line"],
+                   tuple(d.get("chain", ())))
+
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+class FunctionSummary:
+    """Externally visible effects of one function (see module docstring)."""
+
+    __slots__ = ("qual", "display", "sync_always", "sync_param",
+                 "branch_param", "donate_param", "calls", "wrap_sites")
+
+    def __init__(self, qual: str, display: str):
+        self.qual = qual
+        self.display = display
+        self.sync_always: List[Effect] = []
+        self.sync_param: Dict[int, List[Effect]] = {}
+        self.branch_param: Dict[int, List[Effect]] = {}
+        self.donate_param: Dict[int, List[Effect]] = {}
+        self.calls: List[Dict] = []       # serializable call-site records
+        self.wrap_sites: List[Dict] = []  # <retryish>.run(fn) records
+
+    # -- merge with dedupe (returns True when something was added) ----------
+    @staticmethod
+    def _add(bucket: List[Effect], eff: Effect, cap: int = 4) -> bool:
+        if len(bucket) >= cap or any(e.key() == eff.key() for e in bucket):
+            return False
+        bucket.append(eff)
+        return True
+
+    def add_always(self, eff: Effect) -> bool:
+        return self._add(self.sync_always, eff)
+
+    def add_param(self, table: Dict[int, List[Effect]], idx: int,
+                  eff: Effect) -> bool:
+        return self._add(table.setdefault(idx, []), eff)
+
+    def to_dict(self) -> Dict:
+        def tbl(t):
+            return {str(k): [e.to_dict() for e in v]
+                    for k, v in sorted(t.items())}
+        return {"qual": self.qual, "display": self.display,
+                "sync_always": [e.to_dict() for e in self.sync_always],
+                "sync_param": tbl(self.sync_param),
+                "branch_param": tbl(self.branch_param),
+                "donate_param": tbl(self.donate_param),
+                "calls": self.calls, "wrap_sites": self.wrap_sites}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "FunctionSummary":
+        s = cls(d["qual"], d["display"])
+        s.sync_always = [Effect.from_dict(e) for e in d["sync_always"]]
+        for name in ("sync_param", "branch_param", "donate_param"):
+            setattr(s, name, {int(k): [Effect.from_dict(e) for e in v]
+                              for k, v in d[name].items()})
+        s.calls = d["calls"]
+        s.wrap_sites = d["wrap_sites"]
+        return s
+
+    def digest(self) -> str:
+        """Content hash of the (propagated) summary — the unit of cache
+        invalidation: callers re-analyze when a callee's digest moves."""
+        raw = json.dumps(self.to_dict(), sort_keys=True)
+        return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+def _call_ref(func: ast.AST, local_defs: Dict[str, str]) -> Optional[List]:
+    """Serializable reference for a call target: how the resolver should
+    look it up. ``local_defs`` maps lexically visible nested-def names to
+    their quals (resolved at extraction time — python scoping is lexical)."""
+    if isinstance(func, ast.Name):
+        if func.id in local_defs:
+            return ["local", local_defs[func.id]]
+        return ["name", func.id]
+    d = dotted(func)
+    if not d:
+        return None
+    parts = d.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        return ["self", parts[1]]
+    if len(parts) >= 2:
+        return ["dotted", d]
+    return None
+
+
+_RETRY_CTORS = ("RetryPolicy", "RetryPolicy.from_config")
+
+
+def _retryish_targets(tree: ast.AST) -> Set[str]:
+    """Dotted names assigned from a RetryPolicy construction anywhere in the
+    file (module globals, locals, ``self._retry = RetryPolicy(...)``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted(node.value.func)
+            if callee.rsplit(".", 2)[-1] == "RetryPolicy" or \
+                    callee.endswith("RetryPolicy.from_config"):
+                for tgt in node.targets:
+                    d = dotted(tgt)
+                    if d:
+                        out.add(d)
+    return out
+
+
+class _Extractor:
+    """Walk one function body and populate its FunctionSummary."""
+
+    def __init__(self, src: SourceFile, fn: ast.FunctionDef,
+                 summary: FunctionSummary, space: ParamSpace,
+                 local_defs: Dict[str, str], retryish: Set[str]):
+        self.src = src
+        self.fn = fn
+        self.s = summary
+        self.space = space
+        self.local_defs = local_defs
+        self.retryish = retryish
+        self.omap, self.seqs = build_origin_map(fn, space)
+        # local donating callables: name -> donated positions
+        self.donating: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                pos = donated_positions(node.value)
+                if pos is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.donating[tgt.id] = pos
+
+    def _ok(self, rule: str, node: ast.AST) -> bool:
+        return not self.src.is_suppressed(rule, getattr(node, "lineno", 0))
+
+    def _org(self, node: ast.AST) -> Set[int]:
+        return origins_of(node, self.omap, self.seqs, self.space)
+
+    def run(self):
+        src, s = self.src, self.s
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Call):
+                self._visit_call(node)
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if not self._ok("TPU101", node):
+                    continue
+                kind = {"If": "if", "While": "while",
+                        "IfExp": "conditional expression"}[
+                            type(node).__name__]
+                for idx in sorted(self._org(node.test)):
+                    s.add_param(s.branch_param, idx,
+                                Effect("branch", f"python `{kind}`",
+                                       src.path, node.lineno))
+
+    def _visit_call(self, call: ast.Call):
+        src, s, space = self.src, self.s, self.space
+        func = call.func
+        # -- host syncs ------------------------------------------------------
+        if isinstance(func, ast.Attribute):
+            if func.attr in SYNC_METHODS and self._ok("TPU100", call):
+                s.add_always(Effect("sync", f"`.{func.attr}()`",
+                                    src.path, call.lineno))
+            elif func.attr in SYNC_METHODS_TAINTED and \
+                    self._ok("TPU100", call):
+                for idx in sorted(self._org(func.value)):
+                    s.add_param(s.sync_param, idx,
+                                Effect("sync",
+                                       f"`.{func.attr}()` on traced value",
+                                       src.path, call.lineno))
+            elif func.attr in NUMPY_SYNC_FUNCS and \
+                    dotted(func.value) in NUMPY_MODULES and \
+                    self._ok("TPU100", call):
+                org = set()
+                for a in call.args:
+                    org |= self._org(a)
+                for idx in sorted(org):
+                    s.add_param(s.sync_param, idx,
+                                Effect("sync",
+                                       f"`{dotted(func.value)}."
+                                       f"{func.attr}()` on traced value",
+                                       src.path, call.lineno))
+        elif isinstance(func, ast.Name) and func.id in BUILTIN_SYNCS and \
+                self._ok("TPU100", call):
+            org = set()
+            for a in call.args:
+                org |= self._org(a)
+            for idx in sorted(org):
+                s.add_param(s.sync_param, idx,
+                            Effect("sync", f"`{func.id}()` on traced value",
+                                   src.path, call.lineno))
+        # -- donations through a locally built jit callable ------------------
+        if isinstance(func, ast.Name) and func.id in self.donating and \
+                self._ok("TPU102", call):
+            for i in self.donating[func.id]:
+                if i < len(call.args) and \
+                        isinstance(call.args[i], ast.Name):
+                    idx = space.index(call.args[i].id)
+                    if idx is not None:
+                        s.add_param(s.donate_param, idx,
+                                    Effect("donate", "donate_argnums",
+                                           src.path, call.lineno))
+        # -- RetryPolicy wrap sites (EXC500 seeds) ---------------------------
+        if isinstance(func, ast.Attribute) and func.attr == "run" and \
+                call.args:
+            recv = dotted(func.value)
+            if recv and ("retry" in recv.lower() or "policy" in recv.lower()
+                         or recv in self.retryish):
+                ref = _call_ref(call.args[0], self.local_defs)
+                if ref is not None:
+                    s.wrap_sites.append({"ref": ref, "line": call.lineno})
+        # -- generic call-site record (the propagation edge) -----------------
+        ref = _call_ref(func, self.local_defs)
+        if ref is None:
+            return
+        pos = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                break             # past a splat the positions are unknown
+            pos.append({
+                "origins": sorted(self._org(a)),
+                "name_param": (space.index(a.id)
+                               if isinstance(a, ast.Name) else None),
+            })
+        kw = {}
+        for k in call.keywords:
+            if k.arg is None:
+                continue          # **kwargs splat: positions unknown
+            kw[k.arg] = {
+                "origins": sorted(self._org(k.value)),
+                "name_param": (space.index(k.value.id)
+                               if isinstance(k.value, ast.Name) else None),
+            }
+        self.s.calls.append({"ref": ref, "line": call.lineno,
+                             "col": call.col_offset, "pos": pos, "kw": kw})
+
+
+def extract_file(src: SourceFile,
+                 functions: Iterable) -> None:
+    """Populate ``info.summary`` for every FuncInfo of one file (the
+    FuncInfos come from the callgraph's symbol pass)."""
+    retryish = _retryish_targets(src.tree)
+    for info in functions:
+        summary = FunctionSummary(info.qual, info.display)
+        local_defs = info.lexical_defs()
+        _Extractor(src, info.node, summary, info.space, local_defs,
+                   retryish).run()
+        info.summary = summary
+
+
+# ---------------------------------------------------------------------------
+# propagation
+# ---------------------------------------------------------------------------
+def _lift_callsite(caller, callee, cs: Dict, src_of) -> bool:
+    """Merge ``callee``'s summary into ``caller``'s through one call site.
+    Returns True when the caller's summary grew."""
+    cal, cee = caller.summary, callee.summary
+    src = src_of(caller)
+    grew = False
+
+    def suppressed(rule: str) -> bool:
+        return src is not None and src.is_suppressed(rule, cs["line"])
+
+    # arg slot -> callee param index -> caller-side origin info
+    def arg_records():
+        for i, rec in enumerate(cs["pos"]):
+            j = callee.space.map_pos(i)
+            if j is not None:
+                yield j, rec
+        for name, rec in sorted(cs["kw"].items()):
+            j = callee.space.map_kw(name)
+            if j is not None:
+                yield j, rec
+
+    if cee.sync_always and not suppressed("TPU100"):
+        for eff in cee.sync_always:
+            if len(eff.chain) < MAX_CHAIN:
+                grew |= cal.add_always(eff.lifted(callee.display))
+    for j, rec in arg_records():
+        if rec["origins"]:
+            if not suppressed("TPU100"):
+                for eff in cee.sync_param.get(j, ()):
+                    if len(eff.chain) < MAX_CHAIN:
+                        for o in rec["origins"]:
+                            grew |= cal.add_param(
+                                cal.sync_param, o, eff.lifted(callee.display))
+            if not suppressed("TPU101"):
+                for eff in cee.branch_param.get(j, ()):
+                    if len(eff.chain) < MAX_CHAIN:
+                        for o in rec["origins"]:
+                            grew |= cal.add_param(
+                                cal.branch_param, o,
+                                eff.lifted(callee.display))
+        if rec["name_param"] is not None and not suppressed("TPU102"):
+            for eff in cee.donate_param.get(j, ()):
+                if len(eff.chain) < MAX_CHAIN:
+                    grew |= cal.add_param(cal.donate_param,
+                                          rec["name_param"],
+                                          eff.lifted(callee.display))
+    return grew
+
+
+def propagate(project) -> None:
+    """Fixpoint: lift callee summaries into callers until nothing grows.
+    Effect dedup (by ultimate site) plus the chain cap bounds the loop even
+    through recursion."""
+    infos = project.sorted_functions()
+
+    def src_of(info):
+        return info.src
+
+    for _ in range(64):           # fixpoint reached far earlier in practice
+        grew = False
+        for info in infos:
+            for cs in info.summary.calls:
+                callee = project.resolve_ref(info, cs["ref"])
+                if callee is None or callee is info:
+                    continue
+                grew |= _lift_callsite(info, callee, cs, src_of)
+        if not grew:
+            break

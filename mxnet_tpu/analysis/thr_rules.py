@@ -1,0 +1,258 @@
+"""Thread-lifecycle rules.
+
+The stack keeps three always-on thread populations alive (serving worker,
+telemetry reporter, watchdog monitor) plus transient writers (async
+checkpoint saves). Their lifecycle contract is simple and checkable:
+
+  THR400  a started thread must either be a **daemon** (the interpreter may
+          exit under it — the watchdog/reporter pattern) or be **joined on
+          some path** (the serving-worker drain pattern). A non-daemon
+          thread that is started and never joined outlives its owner: it
+          pins the process at shutdown and leaks a runnable into whatever
+          state the owner left behind. The rule also flags the
+          restart-after-stop race: calling ``.start()`` on a thread object
+          constructed in some *other* method of a stop/start lifecycle
+          re-starts a used ``Thread``, which raises ``RuntimeError`` — the
+          fix the serving/watchdog code uses is constructing a fresh
+          ``Thread`` under the lock right before every start.
+
+Aliases are tracked one level (``t = self._thread; t.join()`` counts as
+joining the attribute — the snapshot-under-the-lock idiom InferenceServer
+uses). A local thread that escapes (stored, appended, passed, returned) is
+assumed managed elsewhere: silence over false positives.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile, register
+from .summaries import dotted
+
+__all__ = ["ThreadLifecycle"]
+
+_HANDLE_ATTRS = {"start", "join", "is_alive", "daemon", "setDaemon", "name",
+                 "ident", "native_id"}
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        dotted(node.func).rsplit(".", 1)[-1] == "Thread"
+
+
+def _ctor_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _walk_no_nested(fn: ast.AST):
+    """Pre-order, source-order walk of a function body that does not
+    descend into nested defs/lambdas (they have their own scan) or class
+    bodies — source order matters for the alias tracking."""
+    for child in ast.iter_child_nodes(fn):
+        yield child
+        if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+            yield from _walk_no_nested(child)
+
+
+class _MethodScan:
+    """Per-method thread facts, attrs and locals unified as handles:
+    ``("attr", name)`` / ``("local", name)``."""
+
+    def __init__(self, meth: ast.FunctionDef):
+        self.meth = meth
+        self.alias: Dict[str, Tuple[str, str]] = {}   # local -> handle
+        self.ctor_daemon: Dict[Tuple[str, str], bool] = {}
+        self.fresh: Set[Tuple[str, str]] = set()      # constructed here
+        self.daemon_set: Set[Tuple[str, str]] = set()
+        self.starts: List[Tuple[Tuple[str, str], ast.Call]] = []
+        self.joins: Set[Tuple[str, str]] = set()
+        self.escaped: Set[Tuple[str, str]] = set()
+        self._parents: Dict[int, ast.AST] = {}
+        for node in _walk_no_nested(meth):
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+        self._scan()
+
+    def _handle(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        attr = _self_attr(node)
+        if attr is not None:
+            return ("attr", attr)
+        if isinstance(node, ast.Name):
+            if node.id in self.alias:
+                return self.alias[node.id]
+            return ("local", node.id)
+        return None
+
+    def _scan(self):
+        for node in _walk_no_nested(self.meth):
+            if isinstance(node, ast.Assign):
+                self._scan_assign(node)
+            elif isinstance(node, ast.Call):
+                self._scan_call(node)
+        # a local handle loaded outside start/join/flag contexts escaped
+        for node in _walk_no_nested(self.meth):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                h = self._handle(node)
+                if h is None or not self._is_thread(h):
+                    continue
+                parent = self._parents.get(id(node))
+                if isinstance(parent, ast.Attribute) and \
+                        parent.attr in _HANDLE_ATTRS:
+                    continue
+                if isinstance(parent, ast.Assign) and \
+                        node is parent.value and all(
+                            _self_attr(t) is not None or
+                            isinstance(t, ast.Name)
+                            for t in parent.targets):
+                    continue      # pure alias/attr store, handled below
+                if isinstance(parent, ast.Compare):
+                    continue      # `self._thread is thread` etc.
+                self.escaped.add(h)
+
+    def _is_thread(self, h: Tuple[str, str]) -> bool:
+        return h in self.ctor_daemon or h in self.fresh
+
+    def _scan_assign(self, node: ast.Assign):
+        val = node.value
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            h = ("attr", attr) if attr is not None else (
+                ("local", tgt.id) if isinstance(tgt, ast.Name) else None)
+            if h is None:
+                continue
+            if _is_thread_ctor(val):
+                self.ctor_daemon[h] = _ctor_daemon(val)
+                self.fresh.add(h)
+            elif isinstance(val, ast.Constant) and val.value is True and \
+                    attr is None and tgt.id in self.alias:
+                pass
+            else:
+                src_h = self._handle(val) if isinstance(
+                    val, (ast.Name, ast.Attribute)) else None
+                if src_h is not None:
+                    if attr is None and isinstance(tgt, ast.Name):
+                        self.alias[tgt.id] = src_h       # t = self._thread
+                    elif attr is not None and src_h in self.ctor_daemon:
+                        # self._t = t: the ctor facts move to the attr
+                        self.ctor_daemon[h] = self.ctor_daemon[src_h]
+                        self.fresh.add(h)
+        # x.daemon = True (on attr or alias)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon" and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                h = self._handle(tgt.value)
+                if h is not None:
+                    self.daemon_set.add(h)
+
+    def _scan_call(self, node: ast.Call):
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        h = self._handle(func.value)
+        if h is None:
+            return
+        if func.attr == "start":
+            self.starts.append((h, node))
+        elif func.attr == "join":
+            self.joins.add(h)
+        elif func.attr == "setDaemon" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is True:
+            self.daemon_set.add(h)
+
+
+@register
+class ThreadLifecycle(Checker):
+    rule = "THR400"
+    name = "thread-lifecycle"
+    help = ("A started thread must be joined on some path or be a daemon; "
+            "a non-daemon thread that is never joined pins process exit "
+            "and outlives its owner's state. Re-starting a Thread object "
+            "constructed in another method of a stop/start lifecycle "
+            "raises RuntimeError — construct a fresh Thread before each "
+            "start.")
+
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(src, node)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_locals(src, node)
+
+    # -- class-owned threads (self._thread lifecycles) -----------------------
+    def _check_class(self, src: SourceFile,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        scans = [(m, _MethodScan(m)) for m in methods]
+        daemon_attrs: Set[str] = set()
+        joined_attrs: Set[str] = set()
+        thread_attrs: Set[str] = set()
+        for _m, s in scans:
+            for (kind, name), is_daemon in s.ctor_daemon.items():
+                if kind == "attr":
+                    thread_attrs.add(name)
+                    if is_daemon:
+                        daemon_attrs.add(name)
+            for kind, name in s.daemon_set:
+                if kind == "attr":
+                    daemon_attrs.add(name)
+            for kind, name in s.joins:
+                if kind == "attr":
+                    joined_attrs.add(name)
+        for meth, s in scans:
+            for (kind, name), call in s.starts:
+                if kind != "attr" or name not in thread_attrs:
+                    continue
+                if name not in joined_attrs and name not in daemon_attrs:
+                    yield src.finding(
+                        self.rule, call,
+                        f"`{cls.name}.{name}` is started here but joined "
+                        "nowhere in the class and is not a daemon: the "
+                        "thread outlives its owner and pins process exit "
+                        "— join it on the stop/shutdown path or construct "
+                        "it with daemon=True")
+                elif name in joined_attrs and \
+                        ("attr", name) not in s.fresh:
+                    yield src.finding(
+                        self.rule, call,
+                        f"`self.{name}.start()` on a Thread constructed "
+                        f"outside `{meth.name}()`: in a stop/start "
+                        "lifecycle this re-starts a used Thread object, "
+                        "which raises RuntimeError — construct a fresh "
+                        "Thread in this method before starting it")
+
+    # -- function-local threads ---------------------------------------------
+    def _check_locals(self, src: SourceFile,
+                      fn: ast.FunctionDef) -> Iterable[Finding]:
+        s = _MethodScan(fn)
+        for (kind, name), call in s.starts:
+            if kind != "local":
+                continue
+            h = (kind, name)
+            if h not in s.ctor_daemon:
+                continue          # not provably a Thread we saw constructed
+            if h in s.escaped or h in s.joins:
+                continue
+            if s.ctor_daemon[h] or h in s.daemon_set:
+                continue
+            yield src.finding(
+                self.rule, call,
+                f"local thread `{name}` is started in `{fn.name}()` but "
+                "never joined there and is non-daemon: it outlives the "
+                "call — join it before returning, hand it to an owner "
+                "that joins it, or construct it with daemon=True")

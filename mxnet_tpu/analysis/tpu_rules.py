@@ -18,164 +18,89 @@ Traced contexts are found syntactically: ``hybrid_forward`` methods (the
 HybridBlock trace surface — ``self`` and ``F`` are not traced, the data args
 are) and functions decorated with a ``jit``/``pjit``-suffixed decorator.
 Taint starts at the traced parameters and propagates through simple
-assignments; the checks are deliberately shallow (no inter-procedural flow)
-— a linter's job is the obvious 95% with zero false-positive noise, the
-suppression comment covers intentional exceptions.
+assignments — and, since v2, **through calls**: the per-function summaries
+(:mod:`.summaries`) say whether a callee host-syncs, branches on its Nth
+argument's value, or donates it, so ``hybrid_forward`` calling a helper
+calling ``.asnumpy()`` fires at the call site with a ``via:``-chain naming
+the path. Findings land on the caller's line (suppressions stay local and
+actionable); silencing the helper's definition silences every caller.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from types import SimpleNamespace
+from typing import Dict, Iterable, Optional, Set, Tuple
 
 from .core import Checker, Finding, SourceFile, register
+from .summaries import (BUILTIN_SYNCS, NUMPY_MODULES, NUMPY_SYNC_FUNCS,
+                        SYNC_METHODS, SYNC_METHODS_TAINTED, Effect,
+                        build_origin_map, donated_positions, dotted,
+                        origins_of, traced_params)
 
 __all__ = ["HostSyncUnderTrace", "TracedControlFlow", "UseAfterDonate"]
 
-# NDArray-only host-sync methods: any call under a trace is a finding
-_SYNC_METHODS = {"asnumpy", "asscalar", "wait_to_read"}
-# generic python methods: only a finding when the receiver is traced
-_SYNC_METHODS_TAINTED = {"item", "tolist"}
-_NUMPY_MODULES = {"np", "onp", "numpy"}
-_NUMPY_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray"}
-_BUILTIN_SYNCS = {"float", "int", "bool", "complex"}
-# attribute reads that are static under trace (shape/dtype are python-side)
-_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "ctx", "stype"}
-_STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+def _via(callee, eff: Effect) -> str:
+    chain = " -> ".join((callee.display,) + eff.chain)
+    return f"via: {chain} ({eff.reason} at {eff.site()})"
 
 
-def _dotted(node: ast.AST) -> str:
-    """Best-effort dotted name of a Name/Attribute chain ('' otherwise)."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
+def _traced_roots(src: SourceFile, project):
+    """(FuncInfo, traced param idx set, origin map, seq names) for every
+    traced context in one file."""
+    table = project.tables.get(src.path) if project is not None else None
+    if table is None:
+        return
+    for info in table.all_functions:
+        traced = traced_params(info.node, info.space)
+        if traced is not None:
+            omap, seqs = build_origin_map(info.node, info.space)
+            yield info, traced, omap, seqs
 
 
-def _is_jit_decorator(dec: ast.AST) -> bool:
-    """@jit / @jax.jit / @partial(jax.jit, ...) / @pjit(...) shapes."""
-    if isinstance(dec, ast.Call):
-        name = _dotted(dec.func)
-        if name.rsplit(".", 1)[-1] in ("jit", "pjit"):
-            return True
-        if name.rsplit(".", 1)[-1] == "partial" and dec.args:
-            return _is_jit_decorator(dec.args[0])
-        return False
-    return _dotted(dec).rsplit(".", 1)[-1] in ("jit", "pjit")
+class _Root:
+    """Taint context of one traced function, shared by TPU100/TPU101."""
 
+    def __init__(self, project, info, traced, omap, seqs):
+        self.project = project
+        self.info = info
+        self.traced = traced
+        self.omap = omap
+        self.seqs = seqs
 
-def _traced_params(fn: ast.FunctionDef
-                   ) -> Optional[Tuple[List[str], Set[str]]]:
-    """``(value_params, seq_params)`` for a traced context, else None.
+    def tainted(self, node: ast.AST) -> bool:
+        return bool(origins_of(node, self.omap, self.seqs, self.info.space)
+                    & self.traced)
 
-    ``value_params`` hold traced arrays directly; ``seq_params`` (``*args``
-    / ``**kwargs``) are python containers OF traced arrays — their length
-    and truthiness are static per trace signature, only their elements are
-    traced.
-    """
-    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
-    if fn.name == "hybrid_forward":
-        # hybrid_forward(self, F, x, ...): self and the op namespace F are
-        # python-side; everything after is traced (incl. kwarg params/weights)
-        traced = args[2:] if len(args) >= 2 else []
-        traced += [a.arg for a in fn.args.kwonlyargs]
-    elif any(_is_jit_decorator(d) for d in fn.decorator_list):
-        traced = [a for a in args if a not in ("self", "cls")]
-        traced += [a.arg for a in fn.args.kwonlyargs]
-    else:
-        return None
-    seqs = set()
-    if fn.args.vararg:
-        seqs.add(fn.args.vararg.arg)
-    if fn.args.kwarg:
-        seqs.add(fn.args.kwarg.arg)
-    return traced, seqs
+    def callee_of(self, call: ast.Call):
+        """Resolved callee worth consulting: skip self-recursion and
+        lexically nested defs (their bodies are already in this walk)."""
+        callee = self.project.resolve_call(self.info, call)
+        if callee is None or callee is self.info or callee.summary is None:
+            return None
+        node, root = callee.node, self.info.node
+        if callee.src is self.info.src and \
+                root.lineno <= node.lineno <= getattr(root, "end_lineno",
+                                                      root.lineno):
+            return None
+        return callee
 
-
-def _depends(node: ast.AST, tainted: Set[str], seqs: Set[str]) -> bool:
-    """True when the *value* of ``node`` depends on traced data.
-
-    Static-under-trace escapes return False: ``.shape``/``.dtype`` reads,
-    ``len()``/``isinstance()``, identity checks (``is None``), and the bare
-    truthiness of a ``*args``-style container (a python tuple). A subscript
-    of such a container IS traced (its elements are arrays).
-    """
-    if isinstance(node, ast.Name):
-        if node.id in seqs:
-            return False          # tuple truthiness/iteration is static
-        return node.id in tainted
-    if isinstance(node, ast.Constant):
-        return False
-    if isinstance(node, ast.Attribute):
-        if node.attr in _STATIC_ATTRS:
-            return False
-        return _depends(node.value, tainted, seqs)
-    if isinstance(node, ast.Call):
-        fname = _dotted(node.func).rsplit(".", 1)[-1]
-        if fname in _STATIC_FUNCS:
-            return False
-        return (_depends(node.func, tainted, seqs)
-                or any(_depends(a, tainted, seqs) for a in node.args)
-                or any(_depends(k.value, tainted, seqs)
-                       for k in node.keywords))
-    if isinstance(node, ast.Compare):
-        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
-            return False          # `x is None` is a static python-side check
-        return any(_depends(n, tainted, seqs)
-                   for n in [node.left] + list(node.comparators))
-    if isinstance(node, ast.Subscript):
-        v = node.value
-        if isinstance(v, ast.Name) and v.id in seqs:
-            return True           # element of a traced-array container
-        return (_depends(v, tainted, seqs)
-                or _depends(node.slice, tainted, seqs))
-    if isinstance(node, ast.Starred):
-        v = node.value            # *states forwards the traced elements
-        if isinstance(v, ast.Name) and v.id in seqs:
-            return True
-        return _depends(v, tainted, seqs)
-    return any(_depends(c, tainted, seqs)
-               for c in ast.iter_child_nodes(node))
-
-
-def _taint_set(fn: ast.FunctionDef, params: List[str],
-               seqs: Set[str]) -> Set[str]:
-    """Traced params + names assigned from value-dependent expressions
-    (fixpoint over simple assignments; no inter-procedural flow). Only
-    Store-context names taint — ``self.x = traced`` does not taint ``self``."""
-    tainted = set(params)
-    changed = True
-    while changed:
-        changed = False
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign) and node.value is not None:
-                if _depends(node.value, tainted, seqs):
-                    for tgt in node.targets:
-                        for n in ast.walk(tgt):
-                            if isinstance(n, ast.Name) and \
-                                    isinstance(n.ctx, ast.Store) and \
-                                    n.id not in tainted and n.id not in seqs:
-                                tainted.add(n.id)
-                                changed = True
-            elif isinstance(node, ast.AugAssign):
-                if _depends(node.value, tainted, seqs) and \
-                        isinstance(node.target, ast.Name) and \
-                        node.target.id not in tainted and \
-                        node.target.id not in seqs:
-                    tainted.add(node.target.id)
-                    changed = True
-    return tainted
-
-
-def _iter_traced_functions(tree: ast.AST):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef):
-            tp = _traced_params(node)
-            if tp is not None:
-                yield node, tp[0], tp[1]
+    def tainted_args(self, call: ast.Call, callee) -> Set[int]:
+        """Callee param indices that receive a traced value at this site."""
+        out: Set[int] = set()
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                break             # past a splat the positions are unknown
+            j = callee.space.map_pos(i)
+            if j is not None and self.tainted(a):
+                out.add(j)
+        for k in call.keywords:
+            if k.arg is None:
+                continue
+            j = callee.space.map_kw(k.arg)
+            if j is not None and self.tainted(k.value):
+                out.add(j)
+        return out
 
 
 @register
@@ -183,61 +108,89 @@ class HostSyncUnderTrace(Checker):
     rule = "TPU100"
     name = "host-sync-under-trace"
     help = ("Host synchronization (.asnumpy/.asscalar/float()/np.asarray) "
-            "reachable from traced code (hybrid_forward / @jit) forces a "
-            "device round-trip per call or a tracer error.")
+            "reachable from traced code (hybrid_forward / @jit) — directly "
+            "or through any chain of helper calls — forces a device "
+            "round-trip per call or a tracer error.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
-        for fn, params, seqs in _iter_traced_functions(src.tree):
-            tainted = _taint_set(fn, params, seqs)
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        for info, traced, omap, seqs in _traced_roots(src, project):
+            root = _Root(project, info, traced, omap, seqs)
+            fn = info.node
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
-                f = self._sync_reason(node, tainted, seqs)
-                if f:
+                reason = self._sync_reason(node, root)
+                if reason:
                     yield src.finding(
                         self.rule, node,
-                        f"{f} inside traced `{fn.name}` forces a host "
+                        f"{reason} inside traced `{fn.name}` forces a host "
                         "sync; keep device values symbolic (use F.* ops) "
                         "or hoist the conversion out of the traced scope")
+                    continue
+                callee = root.callee_of(node)
+                if callee is None:
+                    continue
+                eff = self._summary_sync(node, root, callee)
+                if eff is not None:
+                    yield src.finding(
+                        self.rule, node,
+                        f"call to `{callee.display}()` host-syncs "
+                        f"{_via(callee, eff)} inside traced `{fn.name}`; "
+                        "keep the helper symbolic or hoist it out of the "
+                        "traced scope")
 
     @staticmethod
-    def _sync_reason(call: ast.Call, tainted: Set[str],
-                     seqs: Set[str]) -> Optional[str]:
+    def _sync_reason(call: ast.Call, root: _Root) -> Optional[str]:
         func = call.func
         if isinstance(func, ast.Attribute):
-            if func.attr in _SYNC_METHODS:
+            if func.attr in SYNC_METHODS:
                 return f"`.{func.attr}()`"
-            if func.attr in _SYNC_METHODS_TAINTED and \
-                    _depends(func.value, tainted, seqs):
+            if func.attr in SYNC_METHODS_TAINTED and \
+                    root.tainted(func.value):
                 return f"`.{func.attr}()` on traced value"
-            if func.attr in _NUMPY_SYNC_FUNCS and \
-                    _dotted(func.value) in _NUMPY_MODULES:
-                if any(_depends(a, tainted, seqs) for a in call.args):
-                    return f"`{_dotted(func.value)}.{func.attr}()` on " \
+            if func.attr in NUMPY_SYNC_FUNCS and \
+                    dotted(func.value) in NUMPY_MODULES:
+                if any(root.tainted(a) for a in call.args):
+                    return f"`{dotted(func.value)}.{func.attr}()` on " \
                            "traced value"
-        elif isinstance(func, ast.Name) and func.id in _BUILTIN_SYNCS:
-            if any(_depends(a, tainted, seqs) for a in call.args):
+        elif isinstance(func, ast.Name) and func.id in BUILTIN_SYNCS:
+            if any(root.tainted(a) for a in call.args):
                 return f"`{func.id}()` on traced value"
         return None
+
+    @staticmethod
+    def _summary_sync(call: ast.Call, root: _Root,
+                      callee) -> Optional[Effect]:
+        s = callee.summary
+        if s.sync_always:
+            return s.sync_always[0]
+        hot = None
+        for j in root.tainted_args(call, callee):
+            for eff in s.sync_param.get(j, ()):
+                if hot is None or eff.key() < hot.key():
+                    hot = eff
+        return hot
 
 
 @register
 class TracedControlFlow(Checker):
     rule = "TPU101"
     name = "traced-value-control-flow"
-    help = ("Python if/while on a traced value bakes one branch into the "
-            "compiled program and recompiles when it flips (or fails to "
-            "trace). Use F.where / lax.cond-style select instead.")
+    help = ("Python if/while on a traced value — in the traced body or in "
+            "any helper it forwards the value to — bakes one branch into "
+            "the compiled program and recompiles when it flips (or fails "
+            "to trace). Use F.where / lax.cond-style select instead.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
-        for fn, params, seqs in _iter_traced_functions(src.tree):
-            tainted = _taint_set(fn, params, seqs)
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        for info, traced, omap, seqs in _traced_roots(src, project):
+            root = _Root(project, info, traced, omap, seqs)
+            fn = info.node
             for node in ast.walk(fn):
                 if isinstance(node, (ast.If, ast.While, ast.IfExp)):
                     kind = {"If": "if", "While": "while",
                             "IfExp": "conditional expression"}[
                                 type(node).__name__]
-                    if _depends(node.test, tainted, seqs):
+                    if root.tainted(node.test):
                         yield src.finding(
                             self.rule, node,
                             f"python `{kind}` branches on a traced value "
@@ -245,60 +198,102 @@ class TracedControlFlow(Checker):
                             "distinct value (recompile storm); select with "
                             "F.where/F.broadcast_* or branch on static "
                             "shape/dtype only")
-
-
-def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
-    """For a jit/pjit wrapper construction, the literal donate_argnums
-    positions (None when absent or not statically known)."""
-    if _dotted(call.func).rsplit(".", 1)[-1] not in ("jit", "pjit"):
-        return None
-    for kw in call.keywords:
-        if kw.arg != "donate_argnums":
-            continue
-        v = kw.value
-        if isinstance(v, ast.Constant) and isinstance(v.value, int):
-            return (v.value,)
-        if isinstance(v, (ast.Tuple, ast.List)) and all(
-                isinstance(e, ast.Constant) and isinstance(e.value, int)
-                for e in v.elts):
-            return tuple(e.value for e in v.elts)
-        return None               # dynamic: can't reason statically
-    return None
+                elif isinstance(node, ast.Call):
+                    callee = root.callee_of(node)
+                    if callee is None:
+                        continue
+                    hot = None
+                    for j in root.tainted_args(node, callee):
+                        for eff in callee.summary.branch_param.get(j, ()):
+                            if hot is None or eff.key() < hot.key():
+                                hot = eff
+                    if hot is not None:
+                        yield src.finding(
+                            self.rule, node,
+                            f"call to `{callee.display}()` branches on the "
+                            f"traced value passed here, {_via(callee, hot)} "
+                            f"inside `{fn.name}`: one recompile per "
+                            "distinct value (recompile storm); select "
+                            "on-device instead")
 
 
 @register
 class UseAfterDonate(Checker):
     rule = "TPU102"
     name = "use-after-donate"
-    help = ("A buffer passed at a donate_argnums position is deleted when "
-            "the compiled call runs; reading the python variable afterwards "
-            "dereferences freed device memory. Rebind it to the call's "
-            "output instead.")
+    help = ("A buffer passed at a donate_argnums position — of a jit-built "
+            "callable or of a helper whose summary says it donates that "
+            "argument — is deleted when the compiled call runs; reading "
+            "the python variable afterwards dereferences freed device "
+            "memory. Rebind it to the call's output instead.")
 
-    def check(self, src: SourceFile) -> Iterable[Finding]:
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
         for scope in ast.walk(src.tree):
             if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
                                   ast.Module)):
-                yield from self._check_scope(src, scope)
+                yield from self._check_scope(src, project, scope)
 
-    def _check_scope(self, src: SourceFile, scope) -> Iterable[Finding]:
+    def _owner(self, src: SourceFile, project, scope):
+        """Resolution context for calls in this scope: the FuncInfo for a
+        def, a bare-module shim otherwise."""
+        if project is None:
+            return None
+        table = project.tables.get(src.path)
+        if table is None:
+            return None
+        if isinstance(scope, ast.Module):
+            return SimpleNamespace(module=table, cls=None,
+                                   qual=f"{src.path}::<module>",
+                                   lexical_defs=lambda: {})
+        for info in table.all_functions:
+            if info.node is scope:
+                return info
+        return None
+
+    def _check_scope(self, src: SourceFile, project,
+                     scope) -> Iterable[Finding]:
         # donating callables bound in this scope: name -> donated positions
         donating: Dict[str, Tuple[int, ...]] = {}
         for node in ast.walk(scope):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call):
-                pos = _donated_positions(node.value)
+                pos = donated_positions(node.value)
                 if pos is not None:
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             donating[tgt.id] = pos
-        if not donating:
+        owner = self._owner(src, project, scope)
+        if not donating and owner is None:
             return
         # events in execution order: value expressions run before their
         # assignment targets bind, and a donation takes effect only once the
         # call's argument expressions were read — so `x = g(x)` is the
         # *correct* rebind-to-output pattern, not a use-after-donate
-        events = []               # (kind, name, node)
+        events = []               # (kind, name, node, via)
+
+        def callee_donations(call: ast.Call):
+            """(name, effect) donated through a summarized helper call."""
+            if owner is None or project is None:
+                return
+            callee = project.resolve_call(owner, call)
+            if callee is None or callee.summary is None or \
+                    not callee.summary.donate_param:
+                return
+            for i, a in enumerate(call.args):
+                if isinstance(a, ast.Starred):
+                    break
+                j = callee.space.map_pos(i)
+                if j in callee.summary.donate_param and \
+                        isinstance(a, ast.Name):
+                    yield a.id, callee, callee.summary.donate_param[j][0]
+            for k in call.keywords:
+                if k.arg is None:
+                    continue
+                j = callee.space.map_kw(k.arg)
+                if j in callee.summary.donate_param and \
+                        isinstance(k.value, ast.Name):
+                    yield (k.value.id, callee,
+                           callee.summary.donate_param[j][0])
 
         def emit(node):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -330,17 +325,22 @@ class UseAfterDonate(Checker):
             if isinstance(node, ast.Name):
                 events.append(("rebind" if isinstance(
                     node.ctx, (ast.Store, ast.Del)) else "read",
-                    node.id, node))
+                    node.id, node, None))
                 return
             for child in ast.iter_child_nodes(node):
                 emit(child)
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Name) and \
-                    node.func.id in donating:
-                for i in donating[node.func.id]:
-                    if i < len(node.args) and \
-                            isinstance(node.args[i], ast.Name):
-                        events.append(("donate", node.args[i].id, node))
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in donating:
+                    for i in donating[node.func.id]:
+                        if i < len(node.args) and \
+                                isinstance(node.args[i], ast.Name):
+                            events.append(("donate", node.args[i].id,
+                                           node, None))
+                else:
+                    for name, callee, eff in callee_donations(node):
+                        events.append(("donate", name, node,
+                                       (callee, eff)))
 
         def emit_target(tgt):
             # Store names rebind; Load names inside a target (subscript base
@@ -348,21 +348,25 @@ class UseAfterDonate(Checker):
             for n in ast.walk(tgt):
                 if isinstance(n, ast.Name):
                     events.append(("rebind" if isinstance(
-                        n.ctx, (ast.Store, ast.Del)) else "read", n.id, n))
+                        n.ctx, (ast.Store, ast.Del)) else "read",
+                        n.id, n, None))
 
         for stmt in scope.body:
             emit(stmt)
-        consumed: Dict[str, int] = {}      # name -> line donated
-        for kind, name, node in events:
+        consumed: Dict[str, Tuple[int, Optional[tuple]]] = {}
+        for kind, name, node, via in events:
             if kind == "donate":
-                consumed[name] = node.lineno
+                consumed[name] = (node.lineno, via)
             elif kind == "rebind":
                 consumed.pop(name, None)
             elif kind == "read" and name in consumed:
+                line, dvia = consumed[name]
+                how = "to a compiled call" if dvia is None else \
+                    f"inside `{dvia[0].display}()` ({_via(*dvia)})"
                 yield src.finding(
                     self.rule, node,
-                    f"`{name}` was donated to a compiled call at line "
-                    f"{consumed[name]} and read again here: donated "
+                    f"`{name}` was donated {how} at line "
+                    f"{line} and read again here: donated "
                     "buffers are deleted by XLA — rebind the name to the "
                     "call's output (or drop donate_argnums)")
                 consumed.pop(name)         # one report per donation

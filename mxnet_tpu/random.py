@@ -49,7 +49,7 @@ def _prng_impl():
     import jax
     try:
         return "rbg" if jax.default_backend() not in ("cpu",) else "threefry2x32"
-    except Exception:  # backend not initialized yet
+    except RuntimeError:  # backend not initialized yet
         return "threefry2x32"
 
 

@@ -452,11 +452,12 @@ def test_baseline_missing_file_is_empty(tmp_path):
 # ---------------------------------------------------------------------------
 # CLI: JSON schema + the tier-1 CI gate
 # ---------------------------------------------------------------------------
-def _run_mxlint(*argv, cwd=None):
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)   # the CLI must be self-sufficient
+def _run_mxlint(*argv, cwd=None, env=None):
+    full_env = dict(os.environ)
+    full_env.pop("PYTHONPATH", None)   # the CLI must be self-sufficient
+    full_env.update(env or {})
     return subprocess.run([sys.executable, MXLINT, *argv],
-                          capture_output=True, text=True, env=env,
+                          capture_output=True, text=True, env=full_env,
                           cwd=cwd or REPO)
 
 
@@ -556,3 +557,546 @@ def test_api_self_scan_agrees_with_cli():
     new, _matched, stale = analysis.apply_baseline(findings, baseline)
     assert new == [], [f.format() for f in new]
     assert stale == [], [f.format() for f in stale]
+
+
+# ===========================================================================
+# v2 — whole-program interprocedural analysis
+# ===========================================================================
+IP_TPU100 = '''
+def helper(v):
+    return v.asnumpy()
+
+def inner(v):
+    return float(v)
+
+def outer(v):
+    return inner(v)
+
+class Net:
+    def hybrid_forward(self, F, x):
+        a = helper(x)
+        b = outer(x)
+        c = self._scale(x)
+        return F.relu(x)
+
+    def _scale(self, v):
+        return v.item()
+'''
+
+IP_TPU100_FIXED = '''
+def helper(v):
+    return v * 2
+
+def outer(v):
+    return helper(v)
+
+class Net:
+    def hybrid_forward(self, F, x):
+        a = helper(x)
+        b = outer(x)
+        c = self._scale(x)
+        return F.relu(x)
+
+    def _scale(self, v):
+        return v + 1
+'''
+
+
+def test_interproc_tpu100_fires_through_helpers():
+    fs = lint(IP_TPU100)
+    assert codes(fs) == ["TPU100"] * 3
+    # reported at the call sites inside the traced fn, not at the helpers
+    assert [f.line for f in fs] == [13, 14, 15]
+    assert "via: helper" in fs[0].message and ".asnumpy()" in fs[0].message
+    # transitive: outer -> inner -> float()
+    assert "via: outer -> inner" in fs[1].message
+    # method indirection
+    assert "via: Net._scale" in fs[2].message and ".item()" in fs[2].message
+
+
+def test_interproc_tpu100_fixed_is_silent():
+    assert lint(IP_TPU100_FIXED) == []
+
+
+def test_interproc_helper_alone_is_silent():
+    # the helper in isolation is fine — only traced callers make it a bug
+    assert lint("def helper(v):\n    return v.asnumpy()\n") == []
+
+
+def test_interproc_tpu101_through_helper():
+    src = ('def branchy(q):\n'
+           '    if q > 0:\n'
+           '        return q\n'
+           '    return -q\n'
+           'class Net:\n'
+           '    def hybrid_forward(self, F, x):\n'
+           '        d = branchy(x)\n'
+           '        e = branchy(x.shape[0])\n'
+           '        return F.relu(x)\n')
+    fs = lint(src)
+    assert codes(fs) == ["TPU101"]
+    assert fs[0].line == 7 and "via: branchy" in fs[0].message
+    # the .shape call is static under trace: the second call stays silent
+
+
+def test_interproc_tpu102_through_donating_helper():
+    src = ('import jax\n'
+           'def donator(update, params, grads):\n'
+           '    g = jax.jit(update, donate_argnums=(0,))\n'
+           '    return g(params, grads)\n'
+           'def caller(update, params, grads):\n'
+           '    out = donator(update, params, grads)\n'
+           '    return params.sum()\n')
+    fs = lint(src)
+    assert codes(fs) == ["TPU102"]
+    assert fs[0].line == 7 and "`params`" in fs[0].message
+    assert "donator" in fs[0].message
+    fixed = src.replace("out = donator", "params = donator")
+    assert lint(fixed) == []
+
+
+def test_interproc_cross_file_resolution(tmp_path):
+    (tmp_path / "util.py").write_text(
+        "def pull(v):\n    return v.asnumpy()\n")
+    (tmp_path / "net.py").write_text(
+        "from util import pull\n"
+        "class Net:\n"
+        "    def hybrid_forward(self, F, x):\n"
+        "        return pull(x)\n")
+    fs = analysis.lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert codes(fs) == ["TPU100"]
+    assert fs[0].path == "net.py" and fs[0].line == 4
+    assert "via: pull" in fs[0].message
+
+
+def test_interproc_call_site_suppression():
+    src = IP_TPU100.replace("a = helper(x)",
+                            "a = helper(x)  # mxlint: disable=TPU100")
+    fs = lint(src)
+    # only the suppressed call site goes quiet; the other two still fire
+    assert codes(fs) == ["TPU100"] * 2
+    assert all("helper" not in f.message.split("via:")[0] or
+               "outer" in f.message or "Net._scale" in f.message
+               for f in fs)
+
+
+def test_interproc_def_site_suppression_silences_all_callers():
+    src = IP_TPU100.replace("def helper(v):",
+                            "def helper(v):  # mxlint: disable=TPU100")
+    fs = lint(src)
+    assert codes(fs) == ["TPU100"] * 2          # outer + _scale still fire
+    assert not any("via: helper" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# THR400 — thread lifecycle
+# ---------------------------------------------------------------------------
+THR400_BAD = '''
+import threading
+class Worker:
+    def start(self):
+        self._t = threading.Thread(target=self._run)
+        self._t.start()
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+'''
+
+THR400_FIXED = '''
+import threading
+class Worker:
+    def start(self):
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+    def stop(self):
+        t = self._t
+        t.join()
+def scoped(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+def handed_off(fn, pool):
+    t = threading.Thread(target=fn)
+    pool.append(t)
+    t.start()
+'''
+
+
+def test_thr400_fires_on_unjoined_nondaemon():
+    fs = lint(THR400_BAD)
+    assert codes(fs) == ["THR400"] * 2
+    assert "Worker._t" in fs[0].message and "joined nowhere" in fs[0].message
+    assert "fire_and_forget" in fs[1].message
+
+
+def test_thr400_daemon_join_alias_and_escape_are_fine():
+    # daemon + alias join (the InferenceServer snapshot idiom), join in
+    # scope, and an escaping local (assumed managed by its new owner)
+    assert lint(THR400_FIXED) == []
+
+
+def test_thr400_restart_after_stop_race():
+    src = ('import threading\n'
+           'class Restarter:\n'
+           '    def __init__(self):\n'
+           '        self._t = threading.Thread(target=self._run)\n'
+           '    def start(self):\n'
+           '        self._t.start()\n'
+           '    def stop(self):\n'
+           '        self._t.join()\n')
+    fs = lint(src)
+    assert codes(fs) == ["THR400"]
+    assert fs[0].line == 6 and "RuntimeError" in fs[0].message
+    fixed = src.replace(
+        "    def start(self):\n        self._t.start()\n",
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "        self._t.start()\n")
+    assert lint(fixed) == []
+
+
+# ---------------------------------------------------------------------------
+# EXC500 — classification-swallowing excepts
+# ---------------------------------------------------------------------------
+EXC500_BAD = '''
+def flaky():
+    try:
+        return step()
+    except Exception:
+        return None
+def run_it(policy):
+    return policy.run(flaky, site="x")
+class CheckpointWriter:
+    def save(self, path, data):
+        try:
+            write(path, data)
+        except Exception:
+            pass
+'''
+
+EXC500_FIXED = '''
+def flaky():
+    try:
+        return step()
+    except Exception:
+        raise
+def run_it(policy):
+    return policy.run(flaky, site="x")
+class CheckpointWriter:
+    def save(self, path, data):
+        try:
+            write(path, data)
+        except Exception as e:
+            self.last_error = e
+def unrelated():
+    try:
+        poke()
+    except Exception:
+        pass
+'''
+
+
+def test_exc500_fires_in_retry_and_checkpoint_paths():
+    fs = lint(EXC500_BAD)
+    assert codes(fs) == ["EXC500"] * 2
+    assert "RetryPolicy-wrapped" in fs[0].message
+    assert "reached via: run_it -> flaky" in fs[0].message
+    assert "checkpoint path `CheckpointWriter.save`" in fs[1].message
+
+
+def test_exc500_reraise_record_and_unrelated_are_fine():
+    # re-raising, recording the bound error, and broad excepts outside the
+    # classified paths (the watchdog callback-guard idiom) are all fine
+    assert lint(EXC500_FIXED) == []
+
+
+def test_exc500_transitive_marking():
+    src = ('def io_helper():\n'
+           '    try:\n'
+           '        poke()\n'
+           '    except Exception:\n'
+           '        pass\n'
+           'def checkpoint_sync():\n'
+           '    io_helper()\n')
+    fs = lint(src)
+    assert codes(fs) == ["EXC500"]
+    assert "reached via: checkpoint_sync -> io_helper" in fs[0].message
+
+
+def test_exc500_line_suppression():
+    src = EXC500_BAD.replace(
+        "        except Exception:\n            pass",
+        "        except Exception:  # mxlint: disable=EXC500\n"
+        "            pass")
+    fs = lint(src)
+    assert codes(fs) == ["EXC500"]          # only the retry one remains
+
+
+# ---------------------------------------------------------------------------
+# ENV600 — code vs docs drift
+# ---------------------------------------------------------------------------
+def _env_tree(tmp_path, with_gate=True, readme=None):
+    (tmp_path / "mxnet_tpu" / "serving").mkdir(parents=True)
+    if with_gate:
+        (tmp_path / "mxnet_tpu" / "config.py").write_text(
+            'def register(name, default):\n    return name\n')
+    (tmp_path / "mxnet_tpu" / "serving" / "server.py").write_text(
+        'import os\n'
+        'A = os.environ.get("MXNET_DOCUMENTED_KNOB")\n'
+        'B = os.environ.get("MXNET_GHOST_KNOB")\n'
+        'def counter(name, help=""):\n'
+        '    return name\n'
+        'C = counter("mxtpu_documented_total", "x")\n'
+        'D = counter("mxtpu_undocumented_total", "y")\n')
+    if readme is None:
+        readme = ('# ops\n'
+                  'Knobs: `MXNET_DOCUMENTED_KNOB`, stale '
+                  '`MXNET_REMOVED_KNOB`.\n'
+                  'Metrics: `mxtpu_documented_total`, stale '
+                  '`mxtpu_ghost_metric`.\n'
+                  '```\n'
+                  'MXNET_FENCED_EXAMPLE=1 mxtpu_fenced_example\n'
+                  '```\n')
+    (tmp_path / "README.md").write_text(readme)
+    return analysis.lint_paths([str(tmp_path / "mxnet_tpu")],
+                               root=str(tmp_path), rules=["ENV600"])
+
+
+def test_env600_both_directions(tmp_path):
+    fs = _env_tree(tmp_path)
+    msgs = {f"{f.path}:{f.line}": f.message for f in fs}
+    assert len(fs) == 4, [f.format() for f in fs]
+    assert any("MXNET_GHOST_KNOB" in m and "documented in none" in m
+               for m in msgs.values())
+    assert any("mxtpu_undocumented_total" in m for m in msgs.values())
+    assert any("MXNET_REMOVED_KNOB" in m and "stale doc" in m
+               for m in msgs.values())
+    assert any("mxtpu_ghost_metric" in m for m in msgs.values())
+    # fenced tokens never become claims
+    assert not any("FENCED" in m or "fenced" in m for m in msgs.values())
+    # doc-side findings anchor in the doc file
+    doc_findings = [f for f in fs if f.path == "README.md"]
+    assert len(doc_findings) == 2 and all(f.line > 0 for f in doc_findings)
+
+
+def test_env600_wildcard_family_doc(tmp_path):
+    fs = _env_tree(tmp_path, readme=(
+        'Knobs: `MXNET_DOCUMENTED_KNOB`, `MXNET_GHOST_KNOB`.\n'
+        'Metric families: mxtpu_documented_*, mxtpu_undocumented_*.\n'))
+    assert fs == [], [f.format() for f in fs]
+
+
+def test_env600_gated_off_on_partial_scans(tmp_path):
+    fs = _env_tree(tmp_path, with_gate=False)
+    assert fs == []          # no config.py in the scan set: rule disarmed
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 output
+# ---------------------------------------------------------------------------
+def test_sarif_output_validates(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(TPU100_BAD + CONC200_BAD)
+    out = tmp_path / "report.sarif"
+    r = _run_mxlint("--no-baseline", "--no-cache", "--json",
+                    "--sarif", str(out), str(bad))
+    assert r.returncode == 1
+    jr = json.loads(r.stdout)
+    doc = json.loads(out.read_text())
+    # minimal SARIF 2.1.0 schema shape
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    assert len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "mxlint" and driver["version"]
+    rule_ids = {x["id"] for x in driver["rules"]}
+    # rule metadata mirrors --list-rules (every registered rule + MX000)
+    assert rule_ids == {c.rule for c in analysis.all_checkers()} | {"MX000"}
+    for meta in driver["rules"]:
+        assert meta["shortDescription"]["text"]
+        assert meta["fullDescription"]["text"]
+        assert meta["defaultConfiguration"]["level"] in ("warning", "error")
+    results = run["results"]
+    assert len(results) == jr["total"] == 5
+    fingerprints = {f["fingerprint"] for f in jr["findings"]}
+    for res in results:
+        assert res["ruleId"] in rule_ids
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        # annotation identity = the baseline's line-drift-stable fingerprint
+        assert res["partialFingerprints"]["mxlintFingerprint/v1"] in \
+            fingerprints
+
+
+def test_cli_list_rules_v2_families():
+    r = _run_mxlint("--list-rules")
+    assert r.returncode == 0
+    for rule in ("THR400", "EXC500", "ENV600"):
+        assert rule in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# --changed-only (git-scoped scans)
+# ---------------------------------------------------------------------------
+_EMPTY_TREE = "4b825dc642cb6eb9a060e54bf8d69288fbee4904"  # git's empty tree
+
+
+def _git_repo(path, files):
+    path.mkdir(exist_ok=True)
+    for name, text in files.items():
+        (path / name).write_text(text)
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+    for args in (["init", "-q", "."], ["add", "-A"],
+                 ["commit", "-qm", "seed"]):
+        subprocess.run(["git", "-C", str(path), *args], check=True,
+                       capture_output=True, env={**os.environ, **env})
+
+
+def test_changed_only_scopes_to_git_diff(tmp_path):
+    repo = tmp_path / "r"
+    _git_repo(repo, {"a.py": "def f(x):\n    return x\n",
+                     "b.py": "def g(x):\n    return x\n"})
+    (repo / "b.py").write_text(
+        "class N:\n"
+        "    def hybrid_forward(self, F, x):\n"
+        "        return x.asnumpy()\n")
+    r = _run_mxlint("--json", "--no-baseline", "--no-cache",
+                    "--changed-only", "HEAD", "--",
+                    str(repo / "a.py"), str(repo / "b.py"))
+    doc = json.loads(r.stdout)
+    assert doc["counts"] == {"TPU100": 1}
+    assert all(f["path"].endswith("b.py") for f in doc["findings"])
+    # nothing changed vs HEAD once committed -> empty scan, rc 0
+    subprocess.run(["git", "-C", str(repo), "add", "-A"], check=True,
+                   capture_output=True)
+    subprocess.run(["git", "-C", str(repo), "commit", "-qm", "x"],
+                   check=True, capture_output=True,
+                   env={**os.environ, "GIT_AUTHOR_NAME": "t",
+                        "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+    r = _run_mxlint("--no-baseline", "--no-cache", "--changed-only",
+                    "HEAD", "--", str(repo / "a.py"), str(repo / "b.py"))
+    assert r.returncode == 0 and "no scanned files changed" in r.stdout
+
+
+def test_changed_only_falls_back_outside_git(tmp_path):
+    work = tmp_path / "nogit"
+    work.mkdir()
+    (work / "a.py").write_text(
+        "class N:\n"
+        "    def hybrid_forward(self, F, x):\n"
+        "        return x.asnumpy()\n")
+    r = _run_mxlint("--json", "--no-baseline", "--no-cache",
+                    "--changed-only", "HEAD", "--", str(work / "a.py"),
+                    env={"GIT_CEILING_DIRECTORIES": str(tmp_path)},
+                    cwd=str(work))
+    assert "running the full scan" in r.stderr
+    assert json.loads(r.stdout)["counts"] == {"TPU100": 1}
+
+
+def test_changed_only_plus_cache_match_cold_full_scan(tmp_path):
+    repo = tmp_path / "r"
+    _git_repo(repo, {
+        "util.py": "def pull(v):\n    return v.asnumpy()\n",
+        "net.py": ("from util import pull\n"
+                   "class Net:\n"
+                   "    def hybrid_forward(self, F, x):\n"
+                   "        return pull(x)\n"),
+        "racy.py": CONC200_BAD,
+    })
+    cold = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--no-cache",
+        str(repo)).stdout)["findings"]
+    assert {f["rule"] for f in cold} == {"TPU100", "CONC200"}
+    cache = str(tmp_path / "cache.json")
+    warm1 = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--cache", cache,
+        str(repo)).stdout)["findings"]
+    warm2 = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--cache", cache,
+        str(repo)).stdout)["findings"]
+    # warm / cache-hit reports are bitwise identical to the cold scan
+    assert warm1 == cold and warm2 == cold
+    # --changed-only vs the empty tree = every tracked file = the full scan
+    co = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--cache", cache,
+        "--changed-only", _EMPTY_TREE, "--", str(repo)).stdout)["findings"]
+    assert co == cold
+
+
+# ---------------------------------------------------------------------------
+# incremental cache: correctness + perf guard
+# ---------------------------------------------------------------------------
+def test_incremental_cache_reanalyzes_dependent_callers(tmp_path):
+    from mxnet_tpu.analysis import core as _core
+    (tmp_path / "helper.py").write_text("def pull(v):\n    return v\n")
+    (tmp_path / "net.py").write_text(
+        "from helper import pull\n"
+        "class Net:\n"
+        "    def hybrid_forward(self, F, x):\n"
+        "        return pull(x)\n")
+    (tmp_path / "other.py").write_text("def standalone():\n    return 1\n")
+    cache = str(tmp_path / "cache.json")
+    root = str(tmp_path)
+    cold = analysis.lint_paths([root], root=root, cache_path=cache)
+    assert cold == []
+    assert sorted(_core.LAST_SCAN_STATS["checked"]) == \
+        ["helper.py", "net.py", "other.py"]
+    warm = analysis.lint_paths([root], root=root, cache_path=cache)
+    assert warm == []
+    assert sorted(_core.LAST_SCAN_STATS["cache_hits"]) == \
+        ["helper.py", "net.py", "other.py"]
+    # edit ONLY the helper: its summary digest moves, so the dependent
+    # caller re-analyzes (and fires at its unchanged call site); the
+    # unrelated file replays from cache
+    (tmp_path / "helper.py").write_text(
+        "def pull(v):\n    return v.asnumpy()\n")
+    fs = analysis.lint_paths([root], root=root, cache_path=cache)
+    assert [(f.rule, f.path, f.line) for f in fs] == \
+        [("TPU100", "net.py", 4)]
+    assert sorted(_core.LAST_SCAN_STATS["checked"]) == \
+        ["helper.py", "net.py"]
+    assert _core.LAST_SCAN_STATS["cache_hits"] == ["other.py"]
+    # revert the helper: callers re-analyze again and the finding clears
+    (tmp_path / "helper.py").write_text("def pull(v):\n    return v\n")
+    assert analysis.lint_paths([root], root=root, cache_path=cache) == []
+
+
+def test_incremental_cache_hit_report_is_bitwise_identical(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(IP_TPU100 + CONC200_BAD + THR400_BAD)
+    cache = str(tmp_path / "cache.json")
+    root = str(tmp_path)
+    cold = analysis.lint_paths([root], root=root, cache_path=cache)
+    warm = analysis.lint_paths([root], root=root, cache_path=cache)
+    assert [f.to_dict() for f in warm] == [f.to_dict() for f in cold]
+    nocache = analysis.lint_paths([root], root=root)
+    assert [f.to_dict() for f in nocache] == [f.to_dict() for f in cold]
+
+
+def test_incremental_cache_perf_guard(tmp_path):
+    """The warm --check gate must beat the cold scan: the whole point of
+    the cache is that tier-1 re-analyzes only changed files."""
+    import time
+    from mxnet_tpu.analysis import core as _core
+    paths = [os.path.join(REPO, p) for p in analysis.DEFAULT_SCAN_SET]
+    cache = str(tmp_path / "cache.json")
+    t0 = time.perf_counter()
+    cold = analysis.lint_paths(paths, root=REPO, cache_path=cache)
+    cold_s = time.perf_counter() - t0
+    warm_times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        warm = analysis.lint_paths(paths, root=REPO, cache_path=cache)
+        warm_times.append(time.perf_counter() - t0)
+        assert [f.to_dict() for f in warm] == [f.to_dict() for f in cold]
+        assert _core.LAST_SCAN_STATS["checked"] == []
+    assert min(warm_times) < cold_s, (
+        f"warm scan {min(warm_times):.2f}s not faster than cold "
+        f"{cold_s:.2f}s")

@@ -1,9 +1,12 @@
 """mxlint — TPU-pitfall & concurrency linter for the mxnet_tpu tree.
 
-The CI gate for the invariants STATIC_ANALYSIS.md catalogs: host syncs under
-a trace (TPU100), traced-value control flow (TPU101), use-after-donate
-(TPU102), unlocked shared mutation (CONC200), lock-order cycles (CONC201),
-and metric-name hygiene (MET300).
+The CI gate for the invariants STATIC_ANALYSIS.md catalogs: host syncs
+under a trace (TPU100), traced-value control flow (TPU101), use-after-
+donate (TPU102) — all three firing through helper/method indirection with a
+``via:``-chain — unlocked shared mutation (CONC200), lock-order cycles
+(CONC201), metric-name hygiene (MET300), thread lifecycle (THR400),
+classification-swallowing excepts (EXC500), and code-vs-docs config drift
+(ENV600).
 
     # gate: scan the default set, fail on anything not in the baseline
     python tools/mxlint.py --check
@@ -13,6 +16,12 @@ and metric-name hygiene (MET300).
 
     # machine-readable output
     python tools/mxlint.py --json
+    python tools/mxlint.py --sarif report.sarif      # code-scanning upload
+
+    # pre-commit mode: only files changed vs HEAD (or an explicit ref);
+    # falls back to a full scan outside a git checkout
+    python tools/mxlint.py --changed-only
+    python tools/mxlint.py --changed-only origin/main
 
     # accept the current findings as the new baseline
     python tools/mxlint.py --update-baseline
@@ -20,9 +29,17 @@ and metric-name hygiene (MET300).
     # one rule only, ignore the baseline
     python tools/mxlint.py --rules CONC200 --no-baseline mxnet_tpu/serving
 
+Full scans keep an incremental cache (.mxlint_cache.json, mtime+content
+keyed): unchanged files with unchanged dependency summaries replay their
+findings, so the warm gate re-analyzes only what moved. ``--no-cache``
+forces a cold scan; the report is identical either way.
+
 Suppressions: ``# mxlint: disable=RULE[,RULE|all]`` on the offending line
 (on a ``def``/``class`` line it covers the whole scope — the idiom for
 caller-holds-lock helpers); ``# mxlint: disable-file=RULE`` for a file.
+Interprocedural findings are reported at the call site, so a call-site
+disable silences them locally and a def-scope disable on the helper
+silences every caller.
 
 Exit status: 0 when the scan matches the committed baseline exactly; 1 when
 there are new findings, or (with ``--check``) stale baseline entries —
@@ -32,6 +49,7 @@ so it only ever shrinks.
 import argparse
 import json
 import os
+import subprocess
 import sys
 import types
 
@@ -50,6 +68,7 @@ if "mxnet_tpu" not in sys.modules:
 from mxnet_tpu import analysis  # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "mxlint_baseline.json")
+DEFAULT_CACHE = os.path.join(REPO, ".mxlint_cache.json")
 
 
 def _resolve_paths(paths):
@@ -60,6 +79,50 @@ def _resolve_paths(paths):
         cand = p if os.path.exists(p) else os.path.join(REPO, p)
         out.append(cand)
     return out
+
+
+def _git_root(start):
+    """Toplevel of the checkout containing ``start`` (None outside git)."""
+    try:
+        r = subprocess.run(
+            ["git", "-C", start, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return r.stdout.strip() if r.returncode == 0 and r.stdout.strip() \
+        else None
+
+
+def changed_files(ref, scan_paths, repo=None):
+    """Scan-set files touched vs ``ref`` per ``git diff --name-only`` (plus
+    untracked files, so a brand-new module is linted before its first
+    commit). The checkout is found from the first scan path, so the tool
+    works on any tree, not just this repo. Returns None outside a git
+    checkout — the caller falls back to the full scan."""
+    if repo is None:
+        start = next((p if os.path.isdir(p) else os.path.dirname(p) or "."
+                      for p in scan_paths if os.path.exists(p)), REPO)
+        repo = _git_root(start)
+        if repo is None:
+            return None
+    try:
+        diff = subprocess.run(
+            ["git", "-C", repo, "diff", "--name-only", ref, "--"],
+            capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "-C", repo, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+        names = set(diff.stdout.split())
+        if untracked.returncode == 0:
+            names |= set(untracked.stdout.split())
+    except (OSError, subprocess.SubprocessError):
+        return None
+    changed_abs = {os.path.normpath(os.path.join(repo, n)) for n in names}
+    return [f for f in analysis.iter_python_files(scan_paths)
+            if os.path.normpath(os.path.abspath(f)) in changed_abs]
 
 
 def _json_report(findings, new, stale, baselined):
@@ -88,6 +151,13 @@ def main(argv=None):
                     help="comma-separated rule subset (e.g. TPU100,CONC200)")
     ap.add_argument("--json", action="store_true",
                     help="emit a JSON report instead of text")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write a SARIF 2.1.0 report to PATH "
+                         "('-' for stdout)")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="scan only files changed vs REF (default HEAD) "
+                         "per git diff --name-only; full scan outside git")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline ledger path (default tools/"
                          "mxlint_baseline.json)")
@@ -95,6 +165,12 @@ def main(argv=None):
                     help="ignore the baseline: report every finding as new")
     ap.add_argument("--update-baseline", action="store_true",
                     help="accept the current findings as the new baseline")
+    ap.add_argument("--cache", default=None, metavar="PATH",
+                    help="incremental cache path (default: "
+                         ".mxlint_cache.json at the repo root for "
+                         "default-scan-set runs, none for explicit paths)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="cold scan: neither read nor write the cache")
     ap.add_argument("--check", action="store_true",
                     help="CI gate mode: also fail on stale baseline entries")
     ap.add_argument("--list-rules", action="store_true",
@@ -108,8 +184,33 @@ def main(argv=None):
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    if args.cache is None and not args.paths:
+        args.cache = DEFAULT_CACHE
     paths = _resolve_paths(args.paths or list(analysis.DEFAULT_SCAN_SET))
-    findings = analysis.lint_paths(paths, rules=rules, root=REPO)
+    if args.changed_only is not None:
+        subset = changed_files(args.changed_only, paths)
+        if subset is None:
+            print("mxlint: --changed-only: not a git checkout here; "
+                  "running the full scan", file=sys.stderr)
+        else:
+            paths = subset
+            if not paths:
+                print("mxlint: no scanned files changed vs "
+                      f"{args.changed_only}")
+                return 0
+    cache_path = None if args.no_cache else args.cache
+    findings = analysis.lint_paths(paths, rules=rules, root=REPO,
+                                   cache_path=cache_path)
+
+    if args.sarif:
+        doc = analysis.to_sarif(findings, analysis.all_checkers(),
+                                analysis.VERSION)
+        if args.sarif == "-":
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
 
     if args.update_baseline:
         analysis.save_baseline(args.baseline, findings)
@@ -121,7 +222,11 @@ def main(argv=None):
         args.baseline)
     new, matched, stale = analysis.apply_baseline(findings, baseline)
 
-    if args.json:
+    stats = analysis.LAST_SCAN_STATS
+    nfiles = len(stats["checked"]) + len(stats["cache_hits"])
+    if args.sarif == "-":
+        pass                      # SARIF owns stdout; exit code still gates
+    elif args.json:
         print(json.dumps(_json_report(findings, new, stale, len(matched)),
                          indent=1, sort_keys=True))
     else:
@@ -133,10 +238,12 @@ def main(argv=None):
                   "still in the ledger — run --update-baseline):")
             for b in stale:
                 print(f"    {b.path}: {b.rule} {b.message[:70]}")
+        cached = len(stats["cache_hits"])
+        cache_note = f", {cached} from cache" if cached else ""
         print(f"mxlint: {len(findings)} finding(s) "
               f"({len(matched)} baselined, {len(new)} new, "
-              f"{len(stale)} stale) across "
-              f"{len(analysis.iter_python_files(paths))} file(s)")
+              f"{len(stale)} stale) across {nfiles} file(s)"
+              f"{cache_note}")
 
     if new:
         return 1
