@@ -1,19 +1,21 @@
 """Closed-loop load generator for mxnet_tpu.serving (ISSUE r6 benchmark).
 
-N closed-loop clients each keep exactly one request in flight against a
-ModelEndpoint behind the dynamic batcher; at each concurrency level the
-harness reports served img/s and request-latency p50/p99 — the curve that
-shows dynamic batching converting concurrency into device-batch occupancy
-(served throughput should climb toward the direct full-batch rate while p99
-stays bounded by batch_timeout + step time).
+N closed-loop clients each keep exactly one request in flight against one or
+more ModelEndpoints behind the dynamic batcher; at each concurrency level the
+harness reports served img/s and request-latency p50/p95/p99 plus the
+queue-wait share of the tail — the decomposition that shows whether extra
+latency is scheduling (queue wait) or compute (step time). r6 adds
+multi-tenant mode (``--tenants N --mix w1,w2,...``): N endpoints share the
+device through the Router, traffic splits by the mix weights, and a
+per-tenant latency table is emitted so SLO fairness is measurable, plus
+``--serial`` to A/B the double-buffered pipeline against the serial
+prepare-then-step path.
 
-Two endpoints are exercised per run: ResNet-50 bf16 and (optionally) the
-``quantize_net``-produced int8 variant of the same weights — the public-API
-int8 path VERDICT r5 asked to make servable.
+Two dtypes are exercised per single-tenant run: ResNet bf16 and (optionally)
+the ``quantize_net``-produced int8 variant of the same weights — the
+public-API int8 path VERDICT r5 asked to make servable.
 
-Env knobs (benchmark/_timing.py conventions: warm first, median over reps,
-one honest value-fetch per window — here the per-request futures already
-synchronize, so the loadgen measures wall-clock over whole windows):
+Env knobs (benchmark/_timing.py conventions; CLI flags override env):
 
   SLG_MODEL=resnet50_v1   model-zoo name
   SLG_IMG=224             input H=W (smaller for CPU smoke runs)
@@ -29,12 +31,23 @@ synchronize, so the loadgen measures wall-clock over whole windows):
                           combine with MXNET_TELEMETRY_DUMP_PATH for
                           periodic in-run dumps)
 
-Prints one JSON line per (dtype, concurrency):
+CLI:
+  --tenants N       register N endpoints of the model (t0..tN-1) on ONE
+                    server and emit a per-tenant latency table per level
+  --mix w0,w1,...   client-traffic weights per tenant (default uniform)
+  --slo-ms a,b,...  per-tenant scheduling SLO passed to register()
+  --serial          pipeline=False (the pre-r6 prepare-then-step path)
+  --conc / --seconds / --img / --max-batch / --timeout-ms / --dtypes
+                    override the corresponding SLG_* env knobs
+
+Prints one JSON line per (dtype, concurrency[, tenant]):
   {"dtype":..., "conc":..., "img_s":..., "p50_ms":..., "p99_ms":...,
-   "occupancy":..., "compiles":..., "batches":...}
+   "queue_wait_p99_ms":..., "queue_wait_share_p99":..., "occupancy":...,
+   "compiles":..., "batches":...}
 and a final per-dtype summary line with the direct (unserved) single-batch
 forward rate for reference.
 """
+import argparse
 import json
 import os
 import statistics
@@ -83,25 +96,62 @@ def _direct_rate(net, img, in_dtype, batch, reps=3):
     return batch / sec
 
 
-def _run_level(server, name, img, np_dtype, conc, seconds):
-    """Closed loop: `conc` clients, one in-flight request each."""
+def _queue_wait_fields(snap):
+    """Queue-wait decomposition of the latency tail, from a stats snapshot."""
+    qw_p99 = snap["queue_wait"]["p99_us"]
+    lat_p99 = snap["latency"]["p99_us"]
+    return {
+        "queue_wait_p99_ms": round(qw_p99 / 1e3, 2),
+        "queue_wait_share_p99": round(qw_p99 / lat_p99, 3) if lat_p99 else 0.0,
+    }
+
+
+def _percentiles(lat_ms):
+    lat_ms = sorted(lat_ms)
+    n = len(lat_ms)
+    if not n:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    return {
+        "p50_ms": round(lat_ms[n // 2], 2),
+        "p95_ms": round(lat_ms[min(n - 1, int(n * 0.95))], 2),
+        "p99_ms": round(lat_ms[min(n - 1, int(n * 0.99))], 2),
+    }
+
+
+def _run_level(server, names, img, np_dtype, conc, seconds, weights):
+    """Closed loop: ``conc`` clients, one in-flight request each, assigned
+    to tenants proportionally to ``weights``. Returns (aggregate, per_tenant)
+    where per_tenant maps name -> {latencies, served}."""
     stop_at = time.perf_counter() + seconds
-    lat_ms = []
-    served = [0] * conc
     lock = threading.Lock()
+    per = {n: {"lat_ms": [], "served": 0} for n in names}
     rng = onp.random.default_rng(42)
     frames = [rng.random((3, img, img), dtype="float32").astype(np_dtype)
               for _ in range(8)]
+    # proportional client->tenant assignment (every tenant gets >= 1 client
+    # when conc >= len(names))
+    total_w = sum(weights)
+    assign = []
+    for ci in range(conc):
+        acc = 0.0
+        pick = names[-1]
+        for name, w in zip(names, weights):
+            acc += w / total_w
+            if (ci + 0.5) / conc <= acc:
+                pick = name
+                break
+        assign.append(pick)
 
     def client(ci):
+        name = assign[ci]
         i = 0
         while time.perf_counter() < stop_at:
             t0 = time.perf_counter()
             server.predict(name, frames[(ci + i) % len(frames)], timeout=120)
             dt = (time.perf_counter() - t0) * 1e3
             with lock:
-                lat_ms.append(dt)
-            served[ci] += 1
+                per[name]["lat_ms"].append(dt)
+                per[name]["served"] += 1
             i += 1
 
     t_start = time.perf_counter()
@@ -111,67 +161,125 @@ def _run_level(server, name, img, np_dtype, conc, seconds):
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    lat_ms.sort()
-    n = len(lat_ms)
-    return {
-        "img_s": round(sum(served) / wall, 1),
-        "p50_ms": round(lat_ms[n // 2], 2) if n else None,
-        "p99_ms": round(lat_ms[min(n - 1, int(n * 0.99))], 2) if n else None,
-        "requests": n,
-    }
+    all_lat = [d for v in per.values() for d in v["lat_ms"]]
+    agg = {"img_s": round(sum(v["served"] for v in per.values()) / wall, 1),
+           "requests": len(all_lat)}
+    agg.update(_percentiles(all_lat))
+    return agg, per
+
+
+def _parse_args():
+    env = os.environ.get
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--tenants", type=int, default=1)
+    p.add_argument("--mix", default="",
+                   help="comma client-traffic weights per tenant")
+    p.add_argument("--slo-ms", default="",
+                   help="comma per-tenant scheduling SLO (register slo_ms)")
+    p.add_argument("--serial", action="store_true",
+                   help="pipeline=False: serial prepare-then-step dispatch")
+    p.add_argument("--model", default=env("SLG_MODEL", "resnet50_v1"))
+    p.add_argument("--img", type=int, default=int(env("SLG_IMG", 224)))
+    p.add_argument("--classes", type=int, default=int(env("SLG_CLASSES", 1000)))
+    p.add_argument("--dtypes", default=env("SLG_DTYPES", "bf16,int8"))
+    p.add_argument("--conc", default=env("SLG_CONC", "1,2,4,8,16"))
+    p.add_argument("--seconds", type=float, default=float(env("SLG_SECONDS", 5)))
+    p.add_argument("--max-batch", type=int,
+                   default=int(env("SLG_MAX_BATCH", 32)))
+    p.add_argument("--timeout-ms", type=float,
+                   default=float(env("SLG_TIMEOUT_MS", 5)))
+    return p.parse_args()
 
 
 def main():
-    model = os.environ.get("SLG_MODEL", "resnet50_v1")
-    img = int(os.environ.get("SLG_IMG", 224))
-    classes = int(os.environ.get("SLG_CLASSES", 1000))
-    dtypes = os.environ.get("SLG_DTYPES", "bf16,int8").split(",")
-    conc_levels = [int(c) for c in
-                   os.environ.get("SLG_CONC", "1,2,4,8,16").split(",")]
-    seconds = float(os.environ.get("SLG_SECONDS", 5))
-    max_batch = int(os.environ.get("SLG_MAX_BATCH", 32))
-    timeout_ms = float(os.environ.get("SLG_TIMEOUT_MS", 5))
+    args = _parse_args()
+    model, img, classes = args.model, args.img, args.classes
+    dtypes = args.dtypes.split(",")
+    conc_levels = [int(c) for c in str(args.conc).split(",")]
+    seconds, max_batch = args.seconds, args.max_batch
+    timeout_ms = args.timeout_ms
+    tenants = max(1, args.tenants)
+    weights = [float(w) for w in args.mix.split(",")] if args.mix \
+        else [1.0] * tenants
+    if len(weights) != tenants:
+        raise SystemExit(f"--mix needs {tenants} weights, got {len(weights)}")
+    slo_ms = [float(s) for s in args.slo_ms.split(",")] if args.slo_ms \
+        else [None] * tenants
+    if len(slo_ms) != tenants:
+        raise SystemExit(f"--slo-ms needs {tenants} values, got {len(slo_ms)}")
 
     import mxnet_tpu as mx  # noqa: F401  (context/init side effects)
     from mxnet_tpu import serving
 
     for dtype in dtypes:
         dtype = dtype.strip()
-        net = _build_net(model, classes, img, dtype)
         in_dtype = "bfloat16" if dtype == "bf16" else "float32"
-        name = f"{model}_{dtype}"
-        ep = serving.ModelEndpoint(name, net, input_shapes=(3, img, img),
-                                   dtype=in_dtype, max_batch_size=max_batch)
         server = serving.InferenceServer(batch_timeout_ms=timeout_ms,
-                                         max_queue=max_batch * 8)
-        server.register(ep)          # warms every bucket: no serve-time compile
-        compiles_after_warmup = ep.stats.counters["compiles"]
+                                         max_queue=max_batch * 8,
+                                         pipeline=not args.serial)
+        names, eps, nets = [], [], []
+        for ti in range(tenants):
+            net = _build_net(model, classes, img, dtype)
+            name = f"{model}_{dtype}" if tenants == 1 \
+                else f"{model}_{dtype}_t{ti}"
+            ep = serving.ModelEndpoint(name, net, input_shapes=(3, img, img),
+                                       dtype=in_dtype,
+                                       max_batch_size=max_batch)
+            server.register(ep, slo_ms=slo_ms[ti])   # warms every bucket
+            names.append(name)
+            eps.append(ep)
+            nets.append(net)
+        compiles_after_warmup = {n: e.stats.counters["compiles"]
+                                 for n, e in zip(names, eps)}
         server.start()
-        np_dtype = ep.np_dtypes[0]
+        np_dtype = eps[0].np_dtypes[0]
         try:
             for conc in conc_levels:
-                row = _run_level(server, name, img, np_dtype, conc, seconds)
-                snap = serving.stats()[name]
-                row.update({
-                    "dtype": dtype, "conc": conc,
-                    "occupancy": round(snap["batch_occupancy"], 3),
-                    "compiles": snap["counters"]["compiles"],
-                    "batches": snap["counters"]["batches"],
+                agg, per = _run_level(server, names, img, np_dtype, conc,
+                                      seconds, weights)
+                snaps = serving.stats()
+                agg.update({
+                    "dtype": dtype, "conc": conc, "tenants": tenants,
+                    "pipeline": not args.serial,
+                    "occupancy": round(statistics.mean(
+                        snaps[n]["batch_occupancy"] for n in names), 3),
+                    "compiles": sum(snaps[n]["counters"]["compiles"]
+                                    for n in names),
+                    "batches": sum(snaps[n]["counters"]["batches"]
+                                   for n in names),
                 })
-                print(json.dumps(row), flush=True)
+                # queue-wait decomposition over all tenants' requests
+                agg.update(_queue_wait_fields(
+                    snaps[names[0]] if tenants == 1 else
+                    max((snaps[n] for n in names),
+                        key=lambda s: s["latency"]["p99_us"])))
+                print(json.dumps(agg), flush=True)
+                if tenants > 1:
+                    for name in names:        # the per-tenant latency table
+                        row = {"tenant": name, "conc": conc,
+                               "served": per[name]["served"]}
+                        row.update(_percentiles(per[name]["lat_ms"]))
+                        row.update(_queue_wait_fields(snaps[name]))
+                        row["shed"] = snaps[name]["shed"]
+                        print(json.dumps(row), flush=True)
         finally:
             server.stop(drain=True)
-        snap = serving.stats()[name]
-        assert snap["counters"]["compiles"] == compiles_after_warmup, \
-            "serving traffic recompiled beyond warmup buckets"
-        direct = _direct_rate(net, img, in_dtype, max_batch)
+        snaps = serving.stats()
+        for name in names:
+            assert snaps[name]["counters"]["compiles"] == \
+                compiles_after_warmup[name], \
+                "serving traffic recompiled beyond warmup buckets"
+        direct = _direct_rate(nets[0], img, in_dtype, max_batch)
         print(json.dumps({
             "dtype": dtype, "summary": True,
             "direct_b{}_img_s".format(max_batch): round(direct, 1),
-            "buckets": list(ep.buckets),
-            "compiles": snap["counters"]["compiles"],
+            "buckets": list(eps[0].buckets),
+            "compiles": sum(snaps[n]["counters"]["compiles"] for n in names),
+            "prep_overlap_ratio": round(
+                server.health()["prep_overlap_ratio"], 3),
         }), flush=True)
-        serving.unregister(name)
+        for name in names:
+            serving.unregister(name)
 
     # one whole-process telemetry snapshot: serving latency histograms,
     # executable-cache hit/miss/compile-seconds, queue depth / occupancy,

@@ -1,4 +1,4 @@
-"""mxnet_tpu.serving — dynamic-batching inference serving.
+"""mxnet_tpu.serving — pipelined, multi-tenant dynamic-batching inference.
 
 The production request->response path over this framework (the serving-system
 component TensorFlow treats as first-class, PAPERS.md): concurrent client
@@ -7,37 +7,58 @@ a configurable deadline, padded to shape buckets so every bucket hits one
 cached compiled executable (never recompiling in steady state), executed as
 one device step, and sliced back into per-request responses.
 
+r6 rebuilt the dispatch path into a multi-tenant scheduler with a
+double-buffered host pipeline:
+
+- **Router** (router.py): N endpoints multiplex over the single
+  device-owning dispatch path; the next batch is picked
+  earliest-deadline-first across tenants, priced by each bucket's measured
+  step-time EWMA (seeded at warmup), with shortest-job-first among
+  already-late tenants so a long batch cannot convoy short requests.
+  Batches assemble at the last moment — rows arriving during device step k
+  join batch k+1 (continuous batching).
+- **Host pipeline** (pipeline.py): a prep thread concat/pads and
+  ``device_put``s batch k+1 into the next parity's input-buffer set while
+  the worker executes batch k; host time leaves the critical path. Only the
+  worker invokes compiled executables. ``InferenceServer(pipeline=False)``
+  keeps the serial path (bitwise-identical outputs, same executables).
+- **Per-tenant shedding**: each endpoint gets its own CircuitBreaker, so one
+  tenant's overload tightens that tenant's admission, not the whole server.
+
     from mxnet_tpu import serving
 
     ep = serving.ModelEndpoint("resnet50", net, input_shapes=(3, 224, 224),
                                dtype="bfloat16", max_batch_size=32)
     server = serving.InferenceServer(batch_timeout_ms=2.0, max_queue=256)
-    server.register(ep)          # warms every shape bucket (compile-free serving)
+    server.register(ep, slo_ms=50.0)   # warms buckets + seeds the cost model
     server.start()
 
     out = server.predict("resnet50", img)           # blocking
     fut = server.submit("resnet50", img, deadline_ms=50.0)  # async w/ deadline
 
-    serving.stats()["resnet50"]  # p50/p95/p99, occupancy, compile counters
+    serving.stats()["resnet50"]  # p50/p95/p99, queue_wait, prep, shed, ...
     server.stop(drain=True)      # graceful: flushes admitted work first
 
 Numerics contract: a served output is BITWISE equal to the hybridized direct
 forward of the same rows — the endpoint executable is the same
 single-XLA-computation trace CachedOp builds, padding rows never mix into
-real rows, and bucket size does not change per-row results. (Eager op-by-op
-dispatch of the same net may differ by float rounding, because XLA fuses the
-whole traced graph differently than per-op programs.)
+real rows, and bucket size does not change per-row results; the pipelined
+path reuses the serial path's executables, padding and concat, so it is
+bitwise-identical to serial serving too. (Eager op-by-op dispatch of the
+same net may differ by float rounding, because XLA fuses the whole traced
+graph differently than per-op programs.)
 
-Robustness contract: the queue is bounded (ServerOverloadError at admission —
-explicit backpressure instead of unbounded latency), per-request deadlines
-drop expired work before it occupies device rows (RequestTimeoutError), and
-shutdown drains by default with a bounded timeout (abandoned requests are
-failed, never waited on forever). Each device batch step runs under a
-resilience.RetryPolicy (transient failures retried within the batch's
-earliest deadline), a Watchdog flags hung steps, and a CircuitBreaker sheds
-load (HEALTHY→DEGRADED→OPEN→HALF_OPEN) — see ``InferenceServer.health()``
-and RESILIENCE.md. Observability rides the profiler layer: when the
-profiler runs, every serving step is a recorded dispatch event, and
+Robustness contract: the queue is bounded per tenant (ServerOverloadError at
+admission — explicit backpressure instead of unbounded latency), per-request
+deadlines drop expired work before it occupies device rows
+(RequestTimeoutError), and shutdown drains by default with a bounded timeout
+(abandoned requests are failed, never waited on forever). Each device batch
+step runs under a resilience.RetryPolicy (transient failures retried within
+the batch's earliest deadline), a Watchdog flags hung steps (degrading the
+stalled tenant's breaker), and per-tenant CircuitBreakers shed load
+(HEALTHY→DEGRADED→OPEN→HALF_OPEN) — see ``InferenceServer.health()`` and
+RESILIENCE.md. Observability rides the telemetry registry: queue-wait and
+prep histograms, the prep/step overlap gauge, per-tenant shed counters, and
 ``stats()`` snapshots per-endpoint latency histograms, queue depth, batch
 occupancy (real vs padded rows) and executable-cache hit/compile counters.
 """
@@ -46,19 +67,21 @@ from __future__ import annotations
 from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
 from .errors import (RequestTimeoutError, ServerClosedError,
                      ServerOverloadError, ServingError)
+from .router import Router, StepCostEWMA, Tenant
 from .server import InferenceServer
 from . import bucketing
 
 __all__ = ["ModelEndpoint", "InferenceServer", "stats", "get_endpoint",
            "list_endpoints", "unregister", "ServingError",
            "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
-           "bucketing"]
+           "Router", "StepCostEWMA", "Tenant", "bucketing"]
 
 
 def stats():
     """Snapshot of every registered endpoint's serving metrics:
-    ``{endpoint: {counters, queue_depth, batch_occupancy, latency, step}}``.
-    Latency blocks carry count/mean/p50/p95/p99/min/max in microseconds."""
+    ``{endpoint: {counters, queue_depth, batch_occupancy, latency, step,
+    queue_wait, prep, shed}}``. Latency blocks carry
+    count/mean/p50/p95/p99/min/max in microseconds."""
     from .endpoint import _ENDPOINTS, _REG_LOCK
     with _REG_LOCK:
         eps = list(_ENDPOINTS.values())

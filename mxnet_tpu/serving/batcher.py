@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as onp
@@ -30,17 +30,21 @@ __all__ = ["Request", "EndpointQueue", "resolve", "fail"]
 
 
 def resolve(fut: Future, value):
-    """set_result that tolerates a client having cancelled the future."""
+    """set_result that tolerates the future already being settled (client
+    cancelled it, or a racing stop() failed it first). ONLY the Future's own
+    ``InvalidStateError`` is swallowed — anything else (a broken result
+    object, a poisoned Future subclass) is a real bug and must surface."""
     try:
         fut.set_result(value)
-    except Exception:
+    except InvalidStateError:
         pass
 
 
 def fail(fut: Future, exc: Exception):
+    """set_exception with the same narrow tolerance as :func:`resolve`."""
     try:
         fut.set_exception(exc)
-    except Exception:
+    except InvalidStateError:
         pass
 
 
@@ -115,6 +119,14 @@ class EndpointQueue:
             return None
         return self._pending[0].enqueue_us + self.batch_timeout_us
 
+    def head_enqueue_us(self) -> int:
+        """Enqueue time of the head request (queue must be non-empty)."""
+        return self._pending[0].enqueue_us
+
+    def head_deadline_us(self) -> Optional[int]:
+        """Explicit deadline of the head request, when the client set one."""
+        return self._pending[0].deadline_us
+
     # -- assembly (caller holds the server lock) ----------------------------
     def take_batch(self, now_us: int) -> List[Request]:
         """Pop a FIFO prefix of requests that fits max_batch_size rows,
@@ -137,6 +149,9 @@ class EndpointQueue:
                 break
             self._pending.popleft()
             self.pending_rows -= head.rows
+            # queue wait ends at assembly: submit -> picked for a batch. The
+            # remaining latency is prep + device step, charged separately.
+            ep.stats.record_queue_wait(max(now_us - head.enqueue_us, 0))
             batch.append(head)
             rows += head.rows
         ep.stats.set_queue_depth(self.pending_rows)

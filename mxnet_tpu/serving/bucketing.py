@@ -17,7 +17,40 @@ import numpy as onp
 
 from ..base import MXNetError
 
-__all__ = ["pow2_buckets", "bucket_for", "pad_rows"]
+__all__ = ["pow2_buckets", "bucket_for", "pad_rows", "validate_buckets"]
+
+
+def validate_buckets(buckets: Sequence[int], max_batch_size: int
+                     ) -> Tuple[int, ...]:
+    """Validate a user-supplied bucket ladder at endpoint construction.
+
+    The executable cache is keyed by bucket, so a malformed ladder is a
+    config error worth failing loudly on: buckets must be integers >= 1,
+    strictly ascending (which also rules out duplicates — a duplicate is a
+    second compile of the same shape), and the largest must equal
+    ``max_batch_size`` (otherwise some admissible request fits no bucket, or
+    rows beyond the largest bucket can never be served). Returns the ladder
+    as a tuple; raises MXNetError with the offending ladder otherwise."""
+    ladder = tuple(buckets)
+    if not ladder:
+        raise MXNetError("bucket list must be non-empty")
+    prev = 0
+    for b in ladder:
+        ib = int(b)
+        if ib != b or ib < 1:
+            raise MXNetError(
+                f"buckets must be integers >= 1, got {b!r} in {ladder}")
+        if ib <= prev:
+            raise MXNetError(
+                "buckets must be strictly ascending with no duplicates "
+                f"(got {ladder}: {ib} after {prev})")
+        prev = ib
+    ladder = tuple(int(b) for b in ladder)
+    if ladder[-1] != max_batch_size:
+        raise MXNetError("largest bucket must equal max_batch_size "
+                         f"(got buckets={ladder}, "
+                         f"max_batch_size={max_batch_size})")
+    return ladder
 
 
 def pow2_buckets(max_batch_size: int) -> Tuple[int, ...]:

@@ -25,6 +25,7 @@ import numpy as onp
 from ..base import Context, DTypes, MXNetError, current_context
 from ..ndarray.ndarray import NDArray
 from . import bucketing
+from .router import StepCostEWMA
 from .stats import EndpointStats
 
 __all__ = ["ModelEndpoint"]
@@ -75,11 +76,8 @@ class ModelEndpoint:
             raise MXNetError("max_batch_size must be >= 1")
         if buckets is None:
             buckets = bucketing.pow2_buckets(self.max_batch_size)
-        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
-        if not self.buckets or self.buckets[-1] != self.max_batch_size:
-            raise MXNetError("largest bucket must equal max_batch_size "
-                             f"(got buckets={self.buckets}, "
-                             f"max_batch_size={self.max_batch_size})")
+        self.buckets = bucketing.validate_buckets(buckets,
+                                                  self.max_batch_size)
 
         if input_shapes and isinstance(input_shapes[0], int):
             input_shapes = (input_shapes,)
@@ -95,10 +93,14 @@ class ModelEndpoint:
         self.np_dtypes = tuple(onp.dtype(d) for d in self._jnp_dtypes)
 
         self.stats = EndpointStats(name)
+        self.step_cost = StepCostEWMA()       # per-bucket step-time model
         self._lock = threading.Lock()
         self._execs: Dict[int, object] = {}   # bucket -> compiled executable
         self._jfn = None
         self._params = None                   # ordered Parameter list
+        # double-buffer parity slots: the pipeline's prep stage writes the
+        # input-buffer set for parity p while the executable reads parity 1-p
+        self._parity_bufs: list = [None, None]
         self._probe()
 
         with _REG_LOCK:
@@ -154,6 +156,15 @@ class ModelEndpoint:
                     f"can be sliced back out; got output shape {getattr(o, 'shape', None)}")
         self._params = list(self.block.collect_params().values())
 
+    def _donate_inputs(self) -> bool:
+        """Donate input buffers to the executable on backends that implement
+        buffer donation (TPU/GPU): the double-buffered pipeline then recycles
+        each parity set's memory instead of allocating per step. CPU ignores
+        donation (with a warning), so keep it off there. Decided once, before
+        the first compile, so every bucket shares one executable signature —
+        the compiled-once-per-bucket property is preserved."""
+        return self.ctx.jax_device().platform in ("tpu", "gpu")
+
     def _infer_fn(self):
         if self._jfn is None:
             import jax
@@ -165,7 +176,9 @@ class ModelEndpoint:
                                         None, training=False)
                 return outs
 
-            self._jfn = jax.jit(infer)
+            donate = tuple(range(1, 1 + len(self.input_shapes))) \
+                if self._donate_inputs() else ()
+            self._jfn = jax.jit(infer, donate_argnums=donate)
         return self._jfn
 
     def _param_datas(self):
@@ -206,7 +219,9 @@ class ModelEndpoint:
     def warmup(self, execute: bool = True):
         """Compile (and by default execute once) every bucket, so serving
         traffic never hits a compile — first-request latency is steady-state
-        latency. Returns the number of buckets compiled."""
+        latency. Each warmup execution is timed into ``step_cost``, seeding
+        the scheduler's per-bucket EWMA before the first real request.
+        Returns the number of buckets compiled."""
         import jax
         n = 0
         for b in self.buckets:
@@ -216,34 +231,70 @@ class ModelEndpoint:
                 n += 1
                 if execute:
                     ins = tuple(a.data for a in self._zeros_batch(b))
+                    t0 = _now_us()
                     jax.block_until_ready(comp(self._param_datas(), *ins))
+                    self.step_cost.observe(b, _now_us() - t0)
         return n
 
     # ------------------------------------------------------------------
-    # execution
+    # execution: prepare (host half) / execute (device half)
     # ------------------------------------------------------------------
-    def run_batch(self, host_inputs: Sequence[onp.ndarray], rows: int):
-        """Run one padded device step over pre-concatenated host inputs.
+    def prepare(self, host_inputs: Sequence[onp.ndarray], rows: int,
+                parity: int = 0):
+        """Host half of one batch step: pad pre-concatenated host inputs to
+        the shape bucket and transfer them into the ``parity`` input-buffer
+        set. Safe to run on the pipeline's prep thread while the worker
+        executes the other parity — it never touches a compiled executable.
 
-        host_inputs: one ndarray per model input, each with ``rows`` real rows.
-        Returns (outputs, bucket): outputs is a tuple of device arrays with
-        ``bucket`` rows each; callers slice [0:rows] back out per request."""
+        Returns ``(device_inputs, bucket, padded_host)``; ``padded_host`` is
+        kept with the prepared batch so a retry can rebuild donated buffers.
+        """
         import jax
-        from .. import telemetry
         bucket = bucketing.bucket_for(rows, self.buckets)
         padded = tuple(bucketing.pad_rows(a, bucket) for a in host_inputs)
         dev = self.ctx.jax_device()
         ins = tuple(jax.device_put(a, dev) for a in padded)
+        self._parity_bufs[parity % 2] = (bucket, ins)
+        return ins, bucket, padded
+
+    def execute(self, device_inputs, bucket: int, rows: int,
+                padded_host: Optional[Sequence[onp.ndarray]] = None):
+        """Device half: run the bucket's cached executable over prepared
+        input buffers. Worker-thread only (the single-dispatcher rule).
+        Returns a tuple of device output arrays with ``bucket`` rows each;
+        callers slice [0:rows] back out per request."""
+        import jax
+        from .. import telemetry
         comp = self._get_executable(bucket)
+        # a donated executable consumed these buffers on a previous (failed)
+        # attempt: rebuild them from the retained padded host copy
+        if padded_host is not None and any(
+                getattr(a, "is_deleted", lambda: False)()
+                for a in device_inputs):
+            dev = self.ctx.jax_device()
+            device_inputs = tuple(jax.device_put(a, dev) for a in padded_host)
         # child of the caller's serving.batch span (same thread): the trace
         # id stamped at submit reaches the compiled device step
         with telemetry.span("serving.device_step", endpoint=self.name,
                             bucket=bucket, rows=rows):
-            outs = comp(self._param_datas(), *ins)
+            t0 = _now_us()
+            outs = comp(self._param_datas(), *device_inputs)
             jax.block_until_ready(outs)
+            self.step_cost.observe(bucket, _now_us() - t0)
         self.stats.bump("batches")
         self.stats.bump("real_rows", rows)
         self.stats.bump("padded_rows", bucket - rows)
+        return outs
+
+    def run_batch(self, host_inputs: Sequence[onp.ndarray], rows: int):
+        """Serial prepare-then-step over pre-concatenated host inputs (the
+        pre-pipeline dispatch path; kept for direct callers and as the
+        bitwise reference the pipelined path is tested against).
+
+        Returns (outputs, bucket) exactly as before the prepare/execute
+        split."""
+        ins, bucket, padded = self.prepare(host_inputs, rows)
+        outs = self.execute(ins, bucket, rows, padded_host=padded)
         return outs, bucket
 
     def __repr__(self):

@@ -1,0 +1,152 @@
+"""Double-buffered host pipeline: batch k+1 prep overlaps device step k.
+
+The serial dispatch loop pays ``concat + pad + device_put`` on the critical
+path of every batch: the device idles while the host prepares, and the host
+idles while the device executes. This module is the overlap half of the
+rebuilt dispatch path (the host/device overlap discipline of TensorFlow's
+dataflow executor, PAPERS.md): a dedicated *prep stage* assembles the next
+batch — concatenating request rows, padding to the shape bucket, and
+``device_put``-ing into the input-buffer set for the next *parity* — while
+the worker thread executes the current one. Host time disappears from the
+critical path once steady state is reached.
+
+Parity (the double buffer): prepared batches alternate between two
+input-buffer sets (parity 0 / parity 1, tracked per endpoint). Because the
+handoff queue holds at most one prepared batch while one executes, the set
+being written by prep is never the set the in-flight executable is reading —
+the same two-slot discipline a hardware DMA double buffer uses. On
+donation-capable backends the executable consumes (donates) its input set,
+so each parity slot's memory is recycled by XLA rather than re-allocated.
+
+:class:`OverlapTracker` measures the win honestly: it integrates device-busy
+time and charges each prep window only the portion that truly overlapped a
+device step. The exported gauge ``mxtpu_serving_prep_overlap_ratio`` is
+cumulative overlapped-prep / total-prep (1.0 = all host prep hidden).
+
+Single-dispatcher discipline: the prep stage touches JAX only for host→device
+transfer (``device_put``); compiled executables are invoked by the worker
+thread alone. Handoff happens under the server's shared condition as a
+fully-built :class:`PreparedBatch` — the worker never blocks on host work.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from .. import telemetry as _telemetry
+from ..resilience import faults as _faults
+from .batcher import Request, concat_inputs
+from .stats import set_prep_overlap_ratio
+
+__all__ = ["PreparedBatch", "OverlapTracker", "prepare_batch"]
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class PreparedBatch:
+    """One fully-prepared dispatch unit: device input buffers plus the
+    requests whose rows they carry. Built by the prep stage, executed by the
+    worker. ``padded_host`` is retained so a retry after a failed step can
+    rebuild consumed (donated) device buffers without re-assembly."""
+
+    __slots__ = ("tenant", "requests", "rows", "bucket", "inputs",
+                 "padded_host", "parity", "deadline_us", "prep_us")
+
+    def __init__(self, tenant, requests: Sequence[Request], rows: int,
+                 bucket: int, inputs: Tuple, padded_host: Tuple,
+                 parity: int, deadline_us: Optional[int], prep_us: float):
+        self.tenant = tenant
+        self.requests = list(requests)
+        self.rows = rows
+        self.bucket = bucket
+        self.inputs = inputs
+        self.padded_host = padded_host
+        self.parity = parity
+        self.deadline_us = deadline_us
+        self.prep_us = prep_us
+
+
+class OverlapTracker:
+    """Cumulative prep/step overlap accounting.
+
+    The worker brackets every device step with ``step_begin()``/
+    ``step_end()``; the prep stage reports each prep window via
+    ``prep_window(t0, t1)``. Overlap is computed exactly as the device-busy
+    time elapsed between the two endpoints of the prep window (an integral
+    over the busy indicator, not a sample), so a prep that straddles a step
+    boundary is credited only for the covered part.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._busy_accum_us = 0.0       # total device-busy time ever
+        self._busy_since: Optional[int] = None
+        self.prep_us = 0.0
+        self.overlap_us = 0.0
+        self.steps = 0
+
+    def _busy_at(self, t_us: int) -> float:  # mxlint: disable=CONC200
+        # caller holds self._lock
+        busy = self._busy_accum_us
+        if self._busy_since is not None and t_us > self._busy_since:
+            busy += t_us - self._busy_since
+        return busy
+
+    def step_begin(self):
+        with self._lock:
+            self._busy_since = _now_us()
+
+    def step_end(self):
+        with self._lock:
+            if self._busy_since is not None:
+                self._busy_accum_us += _now_us() - self._busy_since
+                self._busy_since = None
+            self.steps += 1
+
+    def prep_window(self, t0_us: int, t1_us: int) -> float:
+        """Record one prep window; returns the overlapped microseconds."""
+        with self._lock:
+            overlap = max(0.0, self._busy_at(t1_us) - self._busy_at(t0_us))
+            self.prep_us += max(0, t1_us - t0_us)
+            self.overlap_us += overlap
+            ratio = (self.overlap_us / self.prep_us) if self.prep_us else 0.0
+        set_prep_overlap_ratio(ratio)
+        return overlap
+
+    def ratio(self) -> float:
+        with self._lock:
+            return (self.overlap_us / self.prep_us) if self.prep_us else 0.0
+
+
+def prepare_batch(tenant, requests: List[Request], parity: int,
+                  tracker: OverlapTracker, retry) -> PreparedBatch:
+    """The host half of one dispatch: concat request rows, pad to the shape
+    bucket, transfer into the ``parity`` input-buffer set. Runs on the prep
+    thread (pipelined) or inline on the worker (serial mode); either way the
+    server lock is NOT held. Raises on unrecoverable prep failure — the
+    caller fails the batch's futures and records the tenant breaker."""
+    ep = tenant.endpoint
+    rows = sum(r.rows for r in requests)
+    deadlines = [r.deadline_us for r in requests if r.deadline_us is not None]
+    deadline_us = min(deadlines) if deadlines else None
+
+    def run_prep():
+        _faults.check("serving_prep")
+        host_inputs = concat_inputs(requests, len(ep.input_shapes))
+        return ep.prepare(host_inputs, rows, parity=parity)
+
+    t0 = _now_us()
+    # adopt the oldest request's trace id: the prep span joins the same
+    # end-to-end trace the batch/device_step spans continue on the worker
+    with _telemetry.span("serving.prep", trace_id=requests[0].trace_id,
+                         endpoint=ep.name, rows=rows, parity=parity):
+        inputs, bucket, padded_host = retry.run(
+            run_prep, site="serving_prep", deadline_us=deadline_us)
+    t1 = _now_us()
+    tracker.prep_window(t0, t1)
+    ep.stats.record_prep(t1 - t0)
+    return PreparedBatch(tenant, requests, rows, bucket, inputs, padded_host,
+                         parity, deadline_us, t1 - t0)

@@ -1,39 +1,48 @@
 """InferenceServer: the request -> batch -> device -> response loop.
 
-One worker thread owns all device work (the single-dispatcher discipline the
-reference gets from its engine thread): client threads only validate, cast to
-host numpy, and enqueue under the shared condition — so arbitrary client
-concurrency never races JAX dispatch. The worker waits until some endpoint
-queue is ready (full batch, batch timeout, or drain), assembles a batch with
-expired requests dropped, runs the padded bucket step, slices per-request
-rows back out, and resolves futures AFTER the device result is ready — so the
-recorded request latency is honest end-to-end time.
+r6 rebuilt this from a one-endpoint-at-a-time, prepare-then-step loop into a
+pipelined multi-tenant scheduler. Three coupled pieces:
 
-Shutdown is graceful by default: ``stop(drain=True)`` flushes every admitted
-request through the device before the thread exits, while new submissions are
-already being refused; the drain is *bounded* — past ``drain_timeout_s`` the
-remaining requests are abandoned (failed with ServerClosedError, counted in
-``mxtpu_drain_abandoned_total``) so a wedged endpoint can never hang shutdown
-forever. ``drain=False`` fails pending futures immediately.
+**Router** (router.py): many ModelEndpoints (tenants) multiplex over the one
+device-owning dispatch path. The next batch is picked earliest-deadline-first
+across tenants, priced by each bucket's measured step-time EWMA, with
+shortest-job-first among already-late tenants — a long batch cannot convoy
+short requests — plus an anti-starvation escalation. Batches assemble at the
+last moment (continuous batching): rows arriving during device step k join
+the assembly for step k+1 instead of waiting out the in-flight step.
 
-Fault tolerance (mxnet_tpu.resilience): each device batch step runs under a
-RetryPolicy — transient failures (device OOM, UNAVAILABLE) are retried with
-backoff as long as the batch's earliest request deadline allows; a Watchdog
-flags batch steps that hang past the stall threshold; and a CircuitBreaker
-aggregates dispatch outcomes into HEALTHY → DEGRADED (admission tightens to
-half the queue bound) → OPEN (every submit shed with ServerOverloadError) →
-HALF_OPEN (bounded probes) → HEALTHY, surfaced via :meth:`health`.
+**Double-buffered host pipeline** (pipeline.py): a prep thread assembles and
+``device_put``s batch k+1 into the next parity's input-buffer set while the
+worker executes batch k, handing fully-built device buffers to the worker
+under the shared condition — host time leaves the critical path (the
+host/device overlap discipline of TensorFlow's dataflow executor). The
+dispatch discipline stays single-owner: only the worker thread invokes
+compiled executables; the prep thread touches JAX for host->device transfer
+alone; client threads only validate, cast to host numpy, and enqueue.
+``pipeline=False`` keeps the serial prepare-then-step path (same scheduler,
+same executables — the bitwise reference for the pipelined path).
 
-When the profiler is running, every device step is recorded through the same
-``_dispatch_profiled`` sink ops and CachedOp use, so serving steps land in the
-chrome trace / aggregate table alongside per-op events.
+**Per-tenant shedding**: each tenant gets its own CircuitBreaker (unless the
+server was built with an explicit shared ``breaker`` — the legacy
+single-tenant contract), so one tenant's failures or stalls tighten *that
+tenant's* admission (DEGRADED: half its queue bound; OPEN: shed all) while
+the others keep serving. ``health()`` reports the worst circuit across
+tenants plus per-tenant states.
+
+Everything the serial server guaranteed still holds: bounded-queue
+backpressure (ServerOverloadError at admission), per-request deadlines
+enforced at assembly (expired work never occupies device rows), graceful
+*bounded* drain (``stop(drain=True)`` flushes admitted work, abandons past
+``drain_timeout_s`` — counted in ``mxtpu_drain_abandoned_total``), bitwise
+per-request outputs (same executables, same padding), and per-batch
+RetryPolicy + Watchdog + profiler integration on every device step.
 """
 from __future__ import annotations
 
 import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as onp
 
@@ -43,15 +52,20 @@ from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
 from ..resilience.retry import RetryPolicy
-from ..resilience.watchdog import CircuitBreaker, Watchdog, DEGRADED
-from .batcher import (EndpointQueue, Request, concat_inputs, fail,
-                      resolve)
+from ..resilience.watchdog import (CircuitBreaker, Watchdog, DEGRADED,
+                                   HALF_OPEN, HEALTHY, OPEN)
+from .batcher import EndpointQueue, Request, fail, resolve
 from .endpoint import ModelEndpoint
 from .errors import ServerClosedError, ServerOverloadError
+from .pipeline import OverlapTracker, PreparedBatch, prepare_batch
+from .router import Router, Tenant
 
 __all__ = ["InferenceServer"]
 
 _RUNNING, _DRAINING, _STOPPED = "running", "draining", "stopped"
+
+#: how bad is a circuit state, for the worst-of health aggregation
+_CIRCUIT_SEVERITY = {HEALTHY: 0, DEGRADED: 1, HALF_OPEN: 2, OPEN: 3}
 
 _DRAIN_ABANDONED = _telemetry.counter(
     "mxtpu_drain_abandoned_total",
@@ -64,7 +78,8 @@ def _now_us() -> int:
 
 
 class InferenceServer:
-    """Dynamic-batching inference front-end over registered ModelEndpoints.
+    """Pipelined, multi-tenant dynamic-batching front-end over registered
+    ModelEndpoints.
 
     Parameters
     ----------
@@ -72,40 +87,57 @@ class InferenceServer:
         Max time the oldest queued request waits before a partial batch is
         dispatched anyway (the latency half of the batching trade-off).
     max_queue : int
-        Admission-control bound, in rows, per endpoint. Submissions beyond it
-        raise ServerOverloadError instead of growing the queue.
+        Default admission-control bound, in rows, per endpoint (override
+        per tenant at :meth:`register`). Submissions beyond it raise
+        ServerOverloadError instead of growing the queue.
     retry_policy : resilience.RetryPolicy, optional
         Per-batch device-step retry (default: MXNET_RETRY_* config).
     breaker : resilience.CircuitBreaker, optional
-        Graceful-degradation state machine (default: MXNET_CIRCUIT_* config,
-        scope "serving").
+        When given, ALL tenants share this breaker (the legacy single-tenant
+        contract). When omitted, each tenant gets its own
+        ``CircuitBreaker(scope="serving:<name>")`` — per-tenant shedding.
     watchdog_stall_s : float, optional
         Hang threshold for one device batch step (default
-        MXNET_WATCHDOG_STALL_S). A stall degrades the circuit breaker.
+        MXNET_WATCHDOG_STALL_S). A stall degrades the stalled tenant's
+        circuit breaker.
     drain_timeout_s : float, optional
         Bound on stop(drain=True) (default MXNET_SERVING_DRAIN_TIMEOUT_S).
+    pipeline : bool
+        True (default): double-buffered host pipeline — a prep thread
+        overlaps batch k+1's concat/pad/device_put with device step k.
+        False: serial prepare-then-step in the worker thread (bitwise
+        reference path; same scheduler, same executables).
     """
+
+    #: prepared batches allowed to wait for the worker (1 + the in-flight
+    #: batch = the two parities of the double buffer)
+    _PIPELINE_DEPTH = 1
 
     def __init__(self, batch_timeout_ms: float = 2.0, max_queue: int = 256,
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog_stall_s: Optional[float] = None,
-                 drain_timeout_s: Optional[float] = None):
+                 drain_timeout_s: Optional[float] = None,
+                 pipeline: bool = True):
         self._batch_timeout_us = int(batch_timeout_ms * 1000)
         self._max_queue_rows = int(max_queue)
-        self._queues: Dict[str, EndpointQueue] = {}
+        self._pipeline = bool(pipeline)
+        self._router = Router(self._batch_timeout_us)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._state = _STOPPED
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None       # worker
+        self._prep_thread: Optional[threading.Thread] = None  # prep stage
+        self._prep_done = True
+        self._prepared: "list[PreparedBatch]" = []
+        self._overlap = OverlapTracker()
         self._retry = retry_policy if retry_policy is not None \
             else RetryPolicy.from_config()
+        self._shared_breaker = breaker          # None => per-tenant breakers
         self._breaker = breaker if breaker is not None \
             else CircuitBreaker(scope="serving")
-        self._watchdog = Watchdog(
-            stall_s=watchdog_stall_s,
-            on_stall=lambda name, dt: self._breaker.force_degraded(
-                f"stall {name} {dt:.1f}s"))
+        self._watchdog = Watchdog(stall_s=watchdog_stall_s,
+                                  on_stall=self._on_stall)
         self._drain_timeout_s = float(
             drain_timeout_s if drain_timeout_s is not None
             else _config.get("MXNET_SERVING_DRAIN_TIMEOUT_S"))
@@ -113,22 +145,48 @@ class InferenceServer:
     # ------------------------------------------------------------------
     # endpoint management
     # ------------------------------------------------------------------
-    def register(self, endpoint: ModelEndpoint, warmup: bool = True
-                 ) -> ModelEndpoint:
-        """Attach an endpoint; by default compiles every shape bucket now so
-        no request ever pays first-compile latency."""
+    def register(self, endpoint: ModelEndpoint, warmup: bool = True,
+                 max_queue: Optional[int] = None,
+                 slo_ms: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None) -> ModelEndpoint:
+        """Attach an endpoint as a tenant; by default compiles every shape
+        bucket now so no request ever pays first-compile latency (warmup also
+        seeds the scheduler's per-bucket step-cost EWMA).
+
+        ``max_queue`` overrides the server default queue bound (the tenant's
+        row quota); ``slo_ms`` sets the tenant's scheduling SLO — requests
+        without an explicit deadline are scheduled as if due ``slo_ms`` after
+        submit; ``breaker`` overrides the tenant's circuit breaker."""
         with self._cond:
-            if endpoint.name in self._queues:
+            if endpoint.name in self._router:
                 raise MXNetError(f"endpoint {endpoint.name!r} already registered")
-            self._queues[endpoint.name] = EndpointQueue(
-                endpoint, self._max_queue_rows, self._batch_timeout_us)
+            q = EndpointQueue(
+                endpoint,
+                int(max_queue) if max_queue is not None
+                else self._max_queue_rows,
+                self._batch_timeout_us)
+            if breaker is None:
+                breaker = self._shared_breaker if self._shared_breaker \
+                    is not None else CircuitBreaker(
+                        scope=f"serving:{endpoint.name}")
+            self._router.add(Tenant(
+                endpoint.name, endpoint, q, breaker,
+                slo_us=int(slo_ms * 1000) if slo_ms is not None else None))
         if warmup:
             endpoint.warmup()
         return endpoint
 
     def endpoints(self):
         with self._cond:
-            return sorted(self._queues)
+            return self._router.names()
+
+    def breaker_for(self, name: str) -> CircuitBreaker:
+        """The named tenant's circuit breaker (per-tenant shedding state)."""
+        with self._cond:
+            if name not in self._router:
+                raise MXNetError(f"unknown endpoint {name!r}; registered: "
+                                 f"{self._router.names()}")
+            return self._router.get(name).breaker
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -137,13 +195,25 @@ class InferenceServer:
         with self._cond:
             if self._state != _STOPPED:
                 raise MXNetError(f"server is {self._state}")
-            if self._thread is not None and self._thread.is_alive():
-                raise MXNetError(
-                    "a previous worker is still wedged in a device call "
-                    "(abandoned drain); this server cannot be restarted")
+            for t in (self._thread, self._prep_thread):
+                if t is not None and t.is_alive():
+                    raise MXNetError(
+                        "a previous worker is still wedged in a device call "
+                        "(abandoned drain); this server cannot be restarted")
             self._state = _RUNNING
+            self._prepared.clear()
+            self._prep_done = not self._pipeline
             self._thread = threading.Thread(
-                target=self._loop, name="mxtpu-serving-worker", daemon=True)
+                target=self._loop_exec if self._pipeline
+                else self._loop_serial,
+                name="mxtpu-serving-worker", daemon=True)
+            if self._pipeline:
+                self._prep_thread = threading.Thread(
+                    target=self._loop_prep, name="mxtpu-serving-prep",
+                    daemon=True)
+                self._prep_thread.start()
+            else:
+                self._prep_thread = None
             self._thread.start()
         return self
 
@@ -156,48 +226,53 @@ class InferenceServer:
         hang shutdown forever. ``drain=False`` fails them immediately."""
         timeout = self._drain_timeout_s if timeout is None else float(timeout)
         with self._cond:
-            if self._state == _STOPPED and self._thread is None:
+            if self._state == _STOPPED and self._thread is None and \
+                    self._prep_thread is None:
                 return
-            # snapshot the worker handle under the lock: a concurrent stop()
-            # (or a start() after abandon) must never see a half-cleared
-            # self._thread, so all joining below works on the local
-            thread = self._thread
+            # snapshot the thread handles under the lock: a concurrent stop()
+            # (or a start() after abandon) must never see half-cleared
+            # handles, so all joining below works on the locals
+            worker, prep = self._thread, self._prep_thread
             if drain:
                 self._state = _DRAINING
             else:
                 self._state = _STOPPED
                 exc = ServerClosedError("server stopped without drain")
-                for q in self._queues.values():
-                    q.fail_all(exc)
+                self._router.fail_all(exc)
+                self._fail_prepared(exc)
             self._cond.notify_all()
-        if thread is not None:
-            thread.join(timeout)
-            if thread.is_alive():
-                # drain wedged (hung device step / endpoint queue): abandon.
-                # The daemon worker may eventually finish its in-flight call;
-                # it will find the state _STOPPED and exit, and resolve() on
-                # already-failed futures is a no-op.
-                abandoned = 0
-                with self._cond:
-                    self._state = _STOPPED
-                    exc = ServerClosedError(
-                        f"drain abandoned after {timeout:.1f}s "
-                        "(worker wedged)")
-                    for q in self._queues.values():
-                        abandoned += len(q)
-                        q.fail_all(exc)
-                    self._cond.notify_all()
-                if abandoned:
-                    _DRAIN_ABANDONED.inc(abandoned)
-                thread.join(1.0)
-                if thread.is_alive():
-                    # keep the handle: start() must refuse to run a second
-                    # worker beside a wedged one
-                    self._watchdog.stop()
-                    return
+        deadline = time.monotonic() + timeout
+        for t in (prep, worker):
+            if t is not None:
+                t.join(max(deadline - time.monotonic(), 0.0))
+        if any(t is not None and t.is_alive() for t in (prep, worker)):
+            # drain wedged (hung device step / endpoint queue): abandon.
+            # The daemon threads may eventually finish their in-flight call;
+            # they will find the state _STOPPED and exit, and resolve() on
+            # already-failed futures is a no-op.
             with self._cond:
-                if self._thread is thread:
-                    self._thread = None
+                self._state = _STOPPED
+                exc = ServerClosedError(
+                    f"drain abandoned after {timeout:.1f}s "
+                    "(worker wedged)")
+                abandoned = self._router.fail_all(exc)
+                abandoned += self._fail_prepared(exc)
+                self._cond.notify_all()
+            if abandoned:
+                _DRAIN_ABANDONED.inc(abandoned)
+            for t in (prep, worker):
+                if t is not None:
+                    t.join(1.0)
+            if any(t is not None and t.is_alive() for t in (prep, worker)):
+                # keep the handles: start() must refuse to run a second
+                # worker beside a wedged one
+                self._watchdog.stop()
+                return
+        with self._cond:
+            if self._thread is worker:
+                self._thread = None
+            if self._prep_thread is prep:
+                self._prep_thread = None
         self._watchdog.stop()
 
     @property
@@ -205,18 +280,32 @@ class InferenceServer:
         return self._state
 
     def health(self) -> dict:
-        """Operator health snapshot: server lifecycle state, circuit-breaker
-        state machine (HEALTHY/DEGRADED/OPEN/HALF_OPEN + recent transitions),
-        per-endpoint queue depth, and watchdog stall count."""
+        """Operator health snapshot: server lifecycle state, the worst
+        circuit-breaker state across tenants (plus each tenant's own state
+        and recent transitions), per-endpoint queue depth, and watchdog
+        stall count."""
         with self._cond:
             state = self._state
-            endpoints = {name: {"pending_requests": len(q),
-                                "pending_rows": q.pending_rows}
-                         for name, q in self._queues.items()}
+            tenants = self._router.tenants()
+        breakers = [self._breaker]
+        endpoints = {}
+        for t in tenants:
+            if all(t.breaker is not b for b in breakers):
+                breakers.append(t.breaker)
+            endpoints[t.name] = {
+                "pending_requests": len(t.queue),
+                "pending_rows": t.queue.pending_rows,
+                "circuit": t.breaker.state(),
+                "slo_ms": t.slo_us / 1000.0 if t.slo_us else None,
+            }
+        worst = max((b.state() for b in breakers),
+                    key=lambda s: _CIRCUIT_SEVERITY[s])
         return {"state": state,
-                "circuit": self._breaker.state(),
+                "circuit": worst,
                 "breaker": self._breaker.snapshot(),
+                "tenants": {t.name: t.breaker.snapshot() for t in tenants},
                 "endpoints": endpoints,
+                "prep_overlap_ratio": self._overlap.ratio(),
                 "watchdog_stalls": self._watchdog.stalls}
 
     def __enter__(self):
@@ -236,37 +325,43 @@ class InferenceServer:
         example (no batch axis) resolves without a batch axis; a batch of n
         rows resolves to n-row outputs.
 
-        Raises ServerOverloadError when the bounded queue is full or the
-        circuit breaker is shedding load (OPEN: everything; HALF_OPEN:
-        beyond the probe budget; DEGRADED: beyond half the queue bound) and
-        ServerClosedError when the server is not accepting work."""
+        Raises ServerOverloadError when the tenant's bounded queue is full
+        or its circuit breaker is shedding load (OPEN: everything;
+        HALF_OPEN: beyond the probe budget; DEGRADED: beyond half the queue
+        bound) and ServerClosedError when the server is not accepting
+        work."""
         with self._cond:
-            if name not in self._queues:
+            if name not in self._router:
                 raise MXNetError(f"unknown endpoint {name!r}; registered: "
-                                 f"{sorted(self._queues)}")
-            q = self._queues[name]
-        if not self._breaker.allow():
+                                 f"{self._router.names()}")
+            tenant = self._router.get(name)
+        q = tenant.queue
+        if not tenant.breaker.allow():
             q.endpoint.stats.bump("rejected")
+            q.endpoint.stats.record_shed(f"circuit_{tenant.breaker.state()}")
             raise ServerOverloadError(
-                f"circuit {self._breaker.state()}: shedding load until the "
-                "device recovers; retry with backoff")
+                f"endpoint {name!r} circuit {tenant.breaker.state()}: "
+                "shedding load until the device recovers; retry with backoff")
         req = self._make_request(q.endpoint, inputs, deadline_ms)
         with self._cond:
             if self._state != _RUNNING:
                 raise ServerClosedError(f"server is {self._state}")
             # graceful degradation: while DEGRADED admit only up to half the
-            # queue bound, so a struggling device sees less queued latency
-            if self._breaker.state() == DEGRADED and \
+            # tenant's queue bound, so a struggling device sees less queued
+            # latency — per-tenant: other tenants keep their full bound
+            if tenant.breaker.state() == DEGRADED and \
                     q.pending_rows + req.rows > q.max_queue_rows // 2:
                 q.endpoint.stats.bump("rejected")
+                q.endpoint.stats.record_shed("degraded")
                 raise ServerOverloadError(
                     f"endpoint {name!r} degraded: admission tightened to "
                     f"{q.max_queue_rows // 2} rows; retry with backoff")
             if not q.offer(req):
+                q.endpoint.stats.record_shed("queue_full")
                 raise ServerOverloadError(
                     f"endpoint {name!r} queue full "
                     f"({q.pending_rows} rows >= {q.max_queue_rows}); retry with backoff")
-            self._cond.notify()
+            self._cond.notify_all()
         return req.future
 
     def predict(self, name: str, inputs, deadline_ms: Optional[float] = None,
@@ -313,84 +408,191 @@ class InferenceServer:
         return Request(tuple(host), rows, squeeze, deadline_ms)
 
     # ------------------------------------------------------------------
-    # worker loop
+    # shared scheduling helpers (caller holds the condition lock)
     # ------------------------------------------------------------------
-    def _loop(self):
-        while True:
-            with self._cond:
-                batch, q = self._wait_for_batch()
-                if batch is None:
-                    self._state = _STOPPED
-                    return
-            if batch:
-                self._dispatch(q, batch)
-
-    def _wait_for_batch(self):
-        """Block (holding the lock) until some queue is ready, a drain can
-        finish, or the server stops. Returns (requests, queue); requests may
-        be [] when all ready work had expired, and (None, None) on exit."""
+    def _next_assembly(self):  # mxlint: disable=CONC200
+        """Block (holding the lock) until the Router yields a tenant whose
+        batch should assemble now, a drain can finish, or the server stops.
+        Returns (tenant, requests); requests may be [] when all ready work
+        had expired, and None on exit (stopped, or drain complete)."""
         while True:
             if self._state == _STOPPED:
-                return None, None
+                return None
             now = _now_us()
             flush = self._state == _DRAINING
-            ready = [q for q in self._queues.values() if q.ready(now, flush)]
-            if ready:
-                # oldest head request first: closest to its latency budget
-                q = min(ready, key=lambda q: q._pending[0].enqueue_us)
-                return q.take_batch(now), q
-            if flush:                      # draining and nothing pending
-                return None, None
-            wakeups = [t for q in self._queues.values()
-                       for t in (q.next_wakeup_us(),) if t is not None]
-            timeout = (max(min(wakeups) - now, 0) / 1e6) if wakeups else None
+            if len(self._prepared) >= self._PIPELINE_DEPTH:
+                # handoff slot occupied: nothing to do until the worker pops
+                # it (notify_all) — do NOT wake on batch deadlines, assembly
+                # cannot proceed anyway (bounded wait in case the worker
+                # dies mid-batch; stop() notifies too)
+                self._cond.wait(timeout=0.25)
+                continue
+            tenant = self._router.select(now, flush)
+            if tenant is not None:
+                return tenant, tenant.queue.take_batch(now)
+            if flush:
+                # slot free + nothing ready under flush => queues are empty
+                return None
+            wakeup = self._router.next_wakeup_us()
+            timeout = (max(wakeup - now, 0) / 1e6) if wakeup is not None \
+                else None
             self._cond.wait(timeout=timeout)
 
-    def _dispatch(self, q: EndpointQueue, batch):
+    def _fail_prepared(self, exc: Exception) -> int:  # mxlint: disable=CONC200
+        """Fail every prepared-but-unexecuted batch (caller holds the lock);
+        returns the number of requests failed."""
+        n = 0
+        while self._prepared:
+            pb = self._prepared.pop(0)
+            for r in pb.requests:
+                pb.tenant.endpoint.stats.bump("cancelled")
+                fail(r.future, exc)
+                n += 1
+        return n
+
+    def _on_stall(self, name: str, dt: float):
+        """Watchdog hook: a stalled device step degrades the *stalled
+        tenant's* circuit (falling back to the server breaker when the watch
+        name is not a tenant's)."""
+        ep_name = name.partition("[")[2].rstrip("]")
+        tenant = self._router.find(ep_name)
+        br = tenant.breaker if tenant is not None else self._breaker
+        br.force_degraded(f"stall {name} {dt:.1f}s")
+
+    # ------------------------------------------------------------------
+    # serial worker (pipeline=False): assemble -> prepare -> execute inline
+    # ------------------------------------------------------------------
+    def _loop_serial(self):
+        while True:
+            with self._cond:
+                item = self._next_assembly()
+                if item is None:
+                    self._state = _STOPPED
+                    self._cond.notify_all()
+                    return
+            tenant, batch = item
+            if not batch:
+                continue
+            pb = self._prepare(tenant, batch, 0)
+            if pb is not None:
+                self._execute(pb)
+
+    # ------------------------------------------------------------------
+    # pipelined prep stage: assemble + device_put batch k+1 during step k
+    # ------------------------------------------------------------------
+    def _loop_prep(self):
+        parity = 0
+        while True:
+            with self._cond:
+                item = self._next_assembly()
+                if item is None:
+                    self._prep_done = True
+                    self._cond.notify_all()
+                    return
+            tenant, batch = item
+            if not batch:
+                continue
+            pb = self._prepare(tenant, batch, parity)
+            if pb is None:
+                continue                  # prep failed; futures already failed
+            parity ^= 1                   # flip the double-buffer parity
+            with self._cond:
+                if self._state == _STOPPED:
+                    exc = ServerClosedError("server stopped")
+                    for r in pb.requests:
+                        tenant.endpoint.stats.bump("cancelled")
+                        fail(r.future, exc)
+                    continue
+                self._prepared.append(pb)
+                self._cond.notify_all()
+
+    def _prepare(self, tenant: Tenant, batch, parity: int
+                 ) -> Optional[PreparedBatch]:
+        """Run the host prep for one assembled batch (lock NOT held); on
+        failure fail the batch's futures against the tenant's breaker."""
+        try:
+            return prepare_batch(tenant, batch, parity, self._overlap,
+                                 self._retry)
+        except Exception as e:
+            tenant.breaker.record_failure()
+            for r in batch:
+                fail(r.future, e)
+            return None
+
+    # ------------------------------------------------------------------
+    # pipelined worker: execute prepared batches (the only executable caller)
+    # ------------------------------------------------------------------
+    def _loop_exec(self):
+        while True:
+            with self._cond:
+                pb = self._next_prepared()
+                if pb is None:
+                    self._state = _STOPPED
+                    self._cond.notify_all()
+                    return
+            self._execute(pb)
+
+    def _next_prepared(self) -> Optional[PreparedBatch]:  # mxlint: disable=CONC200
+        """Block (holding the lock) for the next prepared batch; None on
+        stop, or when a drain has flushed everything through."""
+        while True:
+            if self._state == _STOPPED:
+                return None
+            if self._prepared:
+                pb = self._prepared.pop(0)
+                self._cond.notify_all()    # the handoff slot is free again
+                return pb
+            if self._state == _DRAINING and self._prep_done:
+                return None
+            self._cond.wait()
+
+    # ------------------------------------------------------------------
+    # device dispatch (worker thread only)
+    # ------------------------------------------------------------------
+    def _execute(self, pb: PreparedBatch):
         from .. import telemetry
-        ep = q.endpoint
-        rows = sum(r.rows for r in batch)
-        host_inputs = concat_inputs(batch, len(ep.input_shapes))
+        ep = pb.tenant.endpoint
         from ..ops.registry import _profiler_running
         profiling = _profiler_running()
         t0 = _now_us()
-        # retries must respect what clients asked for: never back off past
-        # the earliest request deadline in the batch
-        deadlines = [r.deadline_us for r in batch if r.deadline_us is not None]
-        deadline_us = min(deadlines) if deadlines else None
 
         def run_step():
             _faults.check("serving_dispatch")
+            step = lambda: ep.execute(pb.inputs, pb.bucket, pb.rows,
+                                      padded_host=pb.padded_host)
             if profiling:
                 from .. import profiler
                 return profiler._dispatch_profiled(
-                    f"serving[{ep.name}]b{rows}",
-                    lambda: ep.run_batch(host_inputs, rows), cat="serving")
-            return ep.run_batch(host_inputs, rows)
+                    f"serving[{ep.name}]b{pb.rows}", step, cat="serving")
+            return step()
 
+        self._overlap.step_begin()
         try:
             # adopt the oldest request's trace id for the whole batch step:
             # its end-to-end trace (submit -> batch -> device) is the one
             # closest to the latency budget, and the span records how many
             # requests/rows rode along
-            with telemetry.span("serving.batch", trace_id=batch[0].trace_id,
-                                endpoint=ep.name, rows=rows,
-                                requests=len(batch)):
+            with telemetry.span("serving.batch",
+                                trace_id=pb.requests[0].trace_id,
+                                endpoint=ep.name, rows=pb.rows,
+                                requests=len(pb.requests)):
                 with self._watchdog.watch(f"serving[{ep.name}]"):
-                    outs, bucket = self._retry.run(
-                        run_step, site="serving_dispatch",
-                        deadline_us=deadline_us)
+                    # retries must respect what clients asked for: never back
+                    # off past the earliest request deadline in the batch
+                    outs = self._retry.run(run_step, site="serving_dispatch",
+                                           deadline_us=pb.deadline_us)
         except Exception as e:  # retries exhausted / fatal: fail the batch
-            self._breaker.record_failure()
-            for r in batch:
+            pb.tenant.breaker.record_failure()
+            for r in pb.requests:
                 fail(r.future, e)
             return
-        self._breaker.record_success()
-        step_us = _now_us() - t0
-        ep.stats.record_step(step_us)
+        finally:
+            self._overlap.step_end()
+        pb.tenant.breaker.record_success()
+        ep.stats.record_step(_now_us() - t0)
         off = 0
         done = _now_us()
-        for r in batch:
+        for r in pb.requests:
             sliced = tuple(
                 NDArray(o[off] if r.squeeze else o[off:off + r.rows], ctx=ep.ctx)
                 for o in outs)

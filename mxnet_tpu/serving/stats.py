@@ -70,6 +70,31 @@ _COMPILE_SECONDS = _telemetry.counter(
     "mxtpu_serving_compile_seconds_total",
     "Cumulative wall seconds spent compiling bucket executables; growth "
     "after warmup is a recompile storm.", labelnames=("endpoint",))
+_QUEUE_WAIT = _telemetry.histogram(
+    "mxtpu_serving_queue_wait_us",
+    "Time a request waits admitted-but-unscheduled: submit -> picked for a "
+    "batch assembly (microseconds). The scheduling share of latency — at "
+    "saturation this, not step time, is where p99 lives.",
+    labelnames=("endpoint",))
+_PREP_LATENCY = _telemetry.histogram(
+    "mxtpu_serving_prep_latency_us",
+    "Host prep time per batch: concat + pad + device transfer "
+    "(microseconds). Pipelined serving overlaps this with the device step.",
+    labelnames=("endpoint",))
+_SHED = _telemetry.counter(
+    "mxtpu_serving_shed_total",
+    "Requests shed at admission by endpoint and reason: queue_full, "
+    "degraded (tightened admission), circuit_open, circuit_half_open.",
+    labelnames=("endpoint", "reason"))
+_PREP_OVERLAP = _telemetry.gauge(
+    "mxtpu_serving_prep_overlap_ratio",
+    "Cumulative fraction of host batch-prep time hidden under a concurrent "
+    "device step (0..1); ~0 means prep rides the critical path.")
+
+
+def set_prep_overlap_ratio(ratio: float):
+    """Pipeline hook for the process-wide prep/step overlap gauge."""
+    _PREP_OVERLAP.set(ratio)
 
 # EndpointStats counter key -> (family, extra label values before/after)
 _EVENT_NAMES = {"submitted": "submitted", "completed": "completed",
@@ -159,7 +184,10 @@ class EndpointStats:
         self.queue_depth = 0          # rows currently admitted and waiting
         self.queue_peak = 0
         self.latency = LatencyHistogram()     # submit -> result ready
-        self.step = LatencyHistogram()        # device step (pad+run+slice)
+        self.step = LatencyHistogram()        # device step (run+slice)
+        self.queue_wait = LatencyHistogram()  # submit -> batch assembly
+        self.prep = LatencyHistogram()        # concat+pad+device transfer
+        self.shed_reasons: Dict[str, int] = {}
         self.compile_us = 0.0                 # total time in bucket compiles
         self._qd_counter = None               # lazy profiler.Counter
         # pre-bound shared-registry children (one bump, no lookup, hot path)
@@ -173,6 +201,8 @@ class EndpointStats:
         self._m_occupancy = _OCCUPANCY.labels(name)
         self._m_latency = _LATENCY.labels(name)
         self._m_step = _STEP.labels(name)
+        self._m_queue_wait = _QUEUE_WAIT.labels(name)
+        self._m_prep = _PREP_LATENCY.labels(name)
         self._m_hits = _CACHE_HITS.labels(name)
         self._m_misses = _CACHE_MISSES.labels(name)
         self._m_compile_s = _COMPILE_SECONDS.labels(name)
@@ -223,6 +253,23 @@ class EndpointStats:
             self.step.record(dur_us)
         self._m_step.observe(dur_us)
 
+    def record_queue_wait(self, dur_us: float):
+        with self._lock:
+            self.queue_wait.record(dur_us)
+        self._m_queue_wait.observe(dur_us)
+
+    def record_prep(self, dur_us: float):
+        with self._lock:
+            self.prep.record(dur_us)
+        self._m_prep.observe(dur_us)
+
+    def record_shed(self, reason: str):
+        """One admission-control shed, by reason (the caller also bumps the
+        legacy ``rejected`` lifecycle counter where applicable)."""
+        with self._lock:
+            self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+        _SHED.labels(self.name, reason).inc()
+
     def record_compile(self, dur_us: float):
         with self._lock:
             self.counters["compiles"] += 1
@@ -242,5 +289,8 @@ class EndpointStats:
                 "batch_occupancy": (c["real_rows"] / occ_den) if occ_den else 0.0,
                 "latency": self.latency.snapshot(),
                 "step": self.step.snapshot(),
+                "queue_wait": self.queue_wait.snapshot(),
+                "prep": self.prep.snapshot(),
+                "shed": dict(self.shed_reasons),
                 "compile_ms_total": self.compile_us / 1e3,
             }
