@@ -179,6 +179,20 @@ register("MXNET_CKPT_ASYNC", False, bool,
          "CheckpointManager default: snapshot synchronously but write/fsync "
          "in a background thread, overlapping checkpoint IO with compute "
          "(wait() joins and surfaces write errors).")
+register("MXNET_CKPT_WAIT_TIMEOUT_S", 120.0, float,
+         "CheckpointManager.wait()/save() bound on joining an outstanding "
+         "async checkpoint write; past it wait() raises instead of hanging "
+         "shutdown behind a wedged writer (<= 0 = unbounded).")
+register("MXNET_PREEMPT_DEADLINE_S", 30.0, float,
+         "PreemptionGuard grace budget: the preemption force-flush (join "
+         "async checkpoint writes + final save + marker) is measured "
+         "against this; a flush that cannot beat it is recorded as "
+         "deadline_exceeded in PREEMPTED.json and "
+         "mxtpu_preemptions_total.")
+register("MXNET_SUPERVISOR_POLL_S", 0.05, float,
+         "PoolSupervisor liveness-poll interval: how often the serving "
+         "worker/prep threads are checked for death or a wedged in-flight "
+         "batch (stall detection itself rides the Watchdog).")
 register("MXNET_CKPT_FSYNC", True, bool,
          "CheckpointManager: fsync every checkpoint file and directory "
          "rename (the crash-consistency barrier). Disable only for "

@@ -68,6 +68,17 @@ class DeviceMesh:
     def replicated(self):
         return self.sharding()
 
+    def device_positions(self, addressable_only: bool = True):
+        """{device: ordinal} over the mesh's flattened device grid — the
+        stable writer ids of a sharded checkpoint (shard-00003.npz is the
+        shard set of mesh device #3). ``addressable_only`` keeps just this
+        process's devices: each host of a multi-host job names only the
+        shard files it is responsible for writing."""
+        import jax
+        pidx = jax.process_index()
+        return {d: i for i, d in enumerate(self._mesh.devices.flat)
+                if not addressable_only or d.process_index == pidx}
+
     def __enter__(self):
         stack = getattr(_current, "stack", None)
         if stack is None:

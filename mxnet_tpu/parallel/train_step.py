@@ -626,6 +626,37 @@ class ParallelTrainStep:
                 "param_names": ",".join(p.name for p in self._plist),
                 "params": params, "opt": opt}
 
+    def shard_state_dict(self) -> Dict:
+        """Sharded twin of :meth:`state_dict`: every on-mesh leaf is captured
+        as its per-device shards (``resilience.sharding.ShardedLeaf``) instead
+        of a gathered host array — this process snapshots only the shards its
+        own devices hold, so no host ever materializes the full state. The
+        CheckpointManager writes these as per-device shard files;
+        :meth:`load_state_dict` consumes the re-assembled restore unchanged
+        (the assembled tree is layout-independent), re-sharding onto THIS
+        step's mesh — which may be a different device count or shape than
+        the mesh that saved (elastic restore)."""
+        from ..resilience.sharding import ShardedLeaf
+        devpos = self._mesh.device_positions()
+
+        def leafcap(a):
+            if hasattr(a, "addressable_shards"):
+                return ShardedLeaf.from_array(a, devpos)
+            return onp.asarray(a)
+
+        import jax
+        params = {f"p{i}": leafcap(a) for i, a in enumerate(self._params)}
+        opt = {}
+        for j, st in enumerate(self._opt_states):
+            leaves = jax.tree_util.tree_leaves(st)
+            opt[f"s{j}"] = {f"l{k}": leafcap(leaf)
+                            for k, leaf in enumerate(leaves)}
+        return {"kind": "ParallelTrainStep", "version": 1, "t": int(self._t),
+                "n_params": len(self._params),
+                "param_names": ",".join(p.name for p in self._plist),
+                "mesh_devices": int(self._mesh.size),
+                "params": params, "opt": opt}
+
     def load_state_dict(self, state: Dict):
         """Restore a :meth:`state_dict` snapshot into this step (same model
         topology/optimizer required). Carried state is re-placed onto the

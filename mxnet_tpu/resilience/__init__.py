@@ -26,9 +26,17 @@ This package is that story for this stack, four composable pieces:
 
   ``faults`` (``faults.py``)
       Deterministic, seedable fault injection at the train-step / compile /
-      serving-dispatch / checkpoint-write boundaries, so every recovery path
-      above has a driveable tier-1 test (and ``tools/chaos_check.py`` a
-      randomized-but-replayable harness).
+      serving-dispatch / serving-prep / checkpoint-write / preemption
+      boundaries, so every recovery path above has a driveable tier-1 test
+      (and ``tools/chaos_check.py`` a randomized-but-replayable harness).
+
+  ``sharding`` + :class:`PreemptionGuard` (``sharding.py``/``preemption.py``)
+      The elastic half (r12): sharded per-device checkpoint layout whose
+      restore re-shards onto a different device count or mesh shape, and
+      the preemption harness that catches SIGTERM/maintenance notices,
+      force-flushes a sharded checkpoint within a bounded deadline, and
+      exits with a resumable marker. Serving-side elasticity (weight
+      hot-swap, worker failover) lives in ``mxnet_tpu.serving``.
 
 The acceptance bar (tests/test_resilience.py): under injected device OOM
 every 3rd step plus a simulated crash + restore, a 20-step training run ends
@@ -39,13 +47,17 @@ besides deadline/overload.
 from __future__ import annotations
 
 from . import faults
-from .checkpoint import CheckpointManager, capture_state, apply_state
+from . import sharding
+from .checkpoint import (CheckpointManager, capture_state, apply_state,
+                         verify_checkpoint_dir)
+from .preemption import PreemptionGuard
 from .retry import RetryPolicy, classify_error
 from .watchdog import (CircuitBreaker, Watchdog,
                        HEALTHY, DEGRADED, OPEN, HALF_OPEN)
 
 __all__ = [
-    "faults", "CheckpointManager", "capture_state", "apply_state",
+    "faults", "sharding", "CheckpointManager", "capture_state", "apply_state",
+    "verify_checkpoint_dir", "PreemptionGuard",
     "RetryPolicy", "classify_error", "CircuitBreaker", "Watchdog",
     "HEALTHY", "DEGRADED", "OPEN", "HALF_OPEN",
 ]

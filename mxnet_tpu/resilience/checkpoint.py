@@ -26,11 +26,27 @@ bar tests/test_resilience.py holds it to).
 
 ``async_save=True`` snapshots to host numpy synchronously (cheap) and writes
 in a background thread, overlapping serialization/fsync with the next compute
-steps; ``wait()`` joins outstanding writes and surfaces their errors.
+steps; ``wait(timeout=)`` joins outstanding writes (bounded by
+``MXNET_CKPT_WAIT_TIMEOUT_S`` so a wedged writer cannot hang shutdown) and
+surfaces their errors — as does the next ``save()``.
 
 State dicts are nested ``{str: ...}`` dicts whose leaves are numpy arrays or
 JSON scalars; arrays land in one ``state.npz`` (no pickle), scalars in
 ``meta.json``.
+
+**Sharded layout** (``save(step, train_step=ts, sharded=True)``): leaves that
+arrive as :class:`~.sharding.ShardedLeaf` (the on-mesh state of a
+ParallelTrainStep captured per device) are written as per-device
+``shard-NNNNN.npz`` files — each host writes only the shards its own devices
+hold — with the placement recorded in ``meta.json``'s ``layout`` map and
+every shard file checksummed in the MANIFEST (still written last). Restore
+re-assembles the global arrays from the layout and re-shards them onto the
+*restoring* topology, so a job saved on 8 chips resumes bitwise-correct on
+4 (or 1, or a different mesh shape) — elastic restore.
+
+A preemption marker (``PREEMPTED.json``, written by the PreemptionGuard) is
+an atomic side-file recording the final force-flushed step; it never shadows
+or alters a checkpoint directory.
 """
 from __future__ import annotations
 
@@ -50,8 +66,10 @@ from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
 from . import faults as _faults
+from .sharding import ShardedLeaf, assemble as _assemble
 
-__all__ = ["CheckpointManager", "capture_state", "apply_state"]
+__all__ = ["CheckpointManager", "capture_state", "apply_state",
+           "verify_checkpoint_dir"]
 
 log = logging.getLogger("mxnet_tpu.resilience.checkpoint")
 
@@ -72,22 +90,30 @@ _LAST_STEP = _telemetry.gauge(
     "mxtpu_checkpoint_last_step", "Step of the newest durable checkpoint.")
 
 _DATA, _META, _MANIFEST = "state.npz", "meta.json", "MANIFEST.json"
+_PREEMPT_MARKER = "PREEMPTED.json"
 _PREFIX, _TMP_PREFIX = "ckpt-", ".tmp-"
 _FORMAT = 1
+
+
+def _shard_name(writer: int) -> str:
+    return f"shard-{int(writer):05d}.npz"
 
 
 # ---------------------------------------------------------------------------
 # state-tree (de)serialization: nested str-keyed dicts, array or scalar leaves
 # ---------------------------------------------------------------------------
-def _flatten(tree: Dict, prefix: str = "", arrays=None, scalars=None):
+def _flatten(tree: Dict, prefix: str = "", arrays=None, scalars=None,
+             sharded=None):
     if arrays is None:
-        arrays, scalars = {}, {}
+        arrays, scalars, sharded = {}, {}, {}
     for k, v in tree.items():
         if not isinstance(k, str) or "/" in k:
             raise MXNetError(f"state keys must be '/'-free strings, got {k!r}")
         key = f"{prefix}{k}"
         if isinstance(v, dict):
-            _flatten(v, key + "/", arrays, scalars)
+            _flatten(v, key + "/", arrays, scalars, sharded)
+        elif isinstance(v, ShardedLeaf):
+            sharded[key] = v
         elif isinstance(v, onp.ndarray):
             arrays[key] = v
         elif isinstance(v, (onp.generic,)):
@@ -98,7 +124,7 @@ def _flatten(tree: Dict, prefix: str = "", arrays=None, scalars=None):
             raise MXNetError(
                 f"unsupported checkpoint leaf at {key!r}: {type(v).__name__} "
                 "(use numpy arrays, JSON scalars, or nested dicts)")
-    return arrays, scalars
+    return arrays, scalars, sharded
 
 
 def _unflatten(arrays: Dict, scalars: Dict) -> Dict:
@@ -155,6 +181,7 @@ class CheckpointManager:
         self._worker = None
         self._pending: list = []
         self._lock = threading.Lock()
+        self._writing: set = set()      # steps with a write in flight
         self.last_save_bytes = 0
 
     # ------------------------------------------------------------------
@@ -181,9 +208,14 @@ class CheckpointManager:
     def save(self, step: int, state: Optional[Dict] = None, **objs) -> str:
         """Write checkpoint ``step``. Either pass an explicit ``state`` tree
         or capture keyword objects (``train_step=``, ``trainer=``,
-        ``block=``, ``dataloader=``, ``extra=``, ``include_rng=``) via
+        ``block=``, ``dataloader=``, ``extra=``, ``include_rng=``, and
+        ``sharded=True`` for the per-device layout) via
         :func:`capture_state`. Returns the final checkpoint path (for async
-        saves: the path it *will* occupy; ``wait()`` to confirm)."""
+        saves: the path it *will* occupy; ``wait()`` to confirm).
+
+        An async save first waits for the previous one (surfacing any
+        background-writer failure here, on the caller thread) — there is at
+        most one overlapped write in flight and saves land in call order."""
         if state is None:
             state = capture_state(**objs)
         elif objs:
@@ -192,34 +224,60 @@ class CheckpointManager:
         final = self._path(step)
         if self.async_save:
             self.wait()           # one overlapped save in flight; keep order
+            # the writer holds its record directly: a failure is stored even
+            # if a racing wait() already popped the pending list (searching
+            # self._pending from the writer lost exceptions to that race)
+            rec: list = [None, None]
             t = threading.Thread(target=self._save_guarded,
-                                 args=(step, state),
+                                 args=(step, state, rec),
                                  name="mxtpu-ckpt-writer", daemon=True)
+            rec[0] = t
             with self._lock:
-                self._pending.append([t, None])
+                self._writing.add(int(step))
+                self._pending.append(rec)
             t.start()
             return final
+        with self._lock:
+            self._writing.add(int(step))
         self._save_sync(step, state)
         return final
 
-    def _save_guarded(self, step: int, state: Dict):
+    def _save_guarded(self, step: int, state: Dict, rec: list):
         try:
             self._save_sync(step, state)
-        except BaseException as e:   # surfaced on wait()
-            with self._lock:
-                for rec in self._pending:
-                    if rec[0] is threading.current_thread():
-                        rec[1] = e
+        except BaseException as e:   # surfaced on the next wait()/save()
+            rec[1] = e
 
-    def wait(self):
-        """Join outstanding async saves; re-raise the first failure."""
+    def wait(self, timeout: Optional[float] = None):
+        """Join outstanding async saves; re-raise the first failure.
+
+        ``timeout`` (seconds; default ``MXNET_CKPT_WAIT_TIMEOUT_S``, <= 0 =
+        unbounded) bounds the join: a wedged background writer — hung fsync
+        on a dying remote FS — raises MXNetError here instead of hanging
+        shutdown forever. The wedged record is retained, so a later
+        ``wait()``/``save()`` surfaces its eventual error."""
+        if timeout is None:
+            timeout = float(_config.get("MXNET_CKPT_WAIT_TIMEOUT_S"))
+        deadline = (time.monotonic() + timeout) if timeout > 0 else None
         with self._lock:
             pending, self._pending = self._pending, []
-        err = None
-        for t, exc in pending:
-            t.join()
+        stuck, err = [], None
         for rec in pending:
-            err = err or rec[1]
+            t = rec[0]
+            t.join(None if deadline is None
+                   else max(deadline - time.monotonic(), 0.0))
+            if t.is_alive():
+                stuck.append(rec)
+            else:
+                err = err or rec[1]
+        if stuck:
+            with self._lock:
+                self._pending.extend(stuck)
+            raise MXNetError(
+                f"checkpoint writer still running after {timeout:.1f}s "
+                "(MXNET_CKPT_WAIT_TIMEOUT_S); the write may yet complete — "
+                "wait() again to re-check, but do not trust this step until "
+                "it does")
         if err is not None:
             raise err
 
@@ -260,20 +318,41 @@ class CheckpointManager:
                            f"{_TMP_PREFIX}{_PREFIX}{int(step):08d}-{os.getpid()}")
         try:
             with _telemetry.span("checkpoint.save", step=int(step)):
-                arrays, scalars = _flatten(state)
-                buf = io.BytesIO()
-                onp.savez(buf, **arrays)
+                arrays, scalars, sharded = _flatten(state)
                 meta = {"format": _FORMAT, "step": int(step),
                         "scalars": scalars, "wall_time": time.time()}
+                # sharded leaves: group per owning-device ordinal into
+                # shard-NNNNN.npz payloads, placement into meta["layout"]
+                per_writer: Dict[int, Dict[str, onp.ndarray]] = {}
+                if sharded:
+                    layout = {}
+                    for key, leaf in sorted(sharded.items()):
+                        entry = {"shape": list(leaf.shape),
+                                 "dtype": str(leaf.dtype), "shards": []}
+                        for writer, index, data in leaf.shards:
+                            entry["shards"].append(
+                                {"file": writer, "index": index})
+                            per_writer.setdefault(writer, {})[key] = data
+                        layout[key] = entry
+                    meta["layout"] = layout
+                    meta["shard_files"] = sorted(per_writer)
+                buf = io.BytesIO()
+                onp.savez(buf, **arrays)
                 shutil.rmtree(tmp, ignore_errors=True)
                 os.makedirs(tmp)
                 nbytes = self._write_file(os.path.join(tmp, _DATA),
                                           buf.getvalue())
+                for writer, leaves in sorted(per_writer.items()):
+                    sbuf = io.BytesIO()
+                    onp.savez(sbuf, **leaves)
+                    nbytes += self._write_file(
+                        os.path.join(tmp, _shard_name(writer)),
+                        sbuf.getvalue())
                 nbytes += self._write_file(
                     os.path.join(tmp, _META),
                     json.dumps(meta, sort_keys=True).encode())
                 manifest = {"format": _FORMAT, "step": int(step), "files": {}}
-                for name in (_DATA, _META):
+                for name in sorted(os.listdir(tmp)):
                     p = os.path.join(tmp, name)
                     manifest["files"][name] = {
                         "sha256": _sha256(p), "bytes": os.path.getsize(p)}
@@ -286,6 +365,8 @@ class CheckpointManager:
                 os.replace(tmp, final)
                 self._fsync_dir(self.directory)
         except BaseException:
+            with self._lock:
+                self._writing.discard(int(step))
             _SAVES.labels("failed").inc()
             raise
         self.last_save_bytes = nbytes
@@ -293,15 +374,24 @@ class CheckpointManager:
         _BYTES.inc(nbytes)
         _LAST_STEP.set(int(step))
         _SAVE_DUR.observe((time.perf_counter_ns() - t0) // 1000)
+        with self._lock:
+            self._writing.discard(int(step))
         self._rotate(exclude=int(step))
         self._sweep_tmp()
 
     def _rotate(self, exclude: int):
+        """keep=N sweep. Never deletes: the checkpoint just written
+        (``exclude``), any step with a write currently in flight (an async
+        writer racing the sweep must not have its landing spot deleted), or
+        the newest on-disk checkpoint (the restore fallback anchor)."""
         if self.keep <= 0:
             return
+        with self._lock:
+            writing = set(self._writing)
         steps = self.steps()
+        newest = steps[-1] if steps else None
         for s in steps[:-self.keep]:
-            if s == exclude:
+            if s == exclude or s == newest or s in writing:
                 continue
             shutil.rmtree(self._path(s), ignore_errors=True)
 
@@ -316,30 +406,7 @@ class CheckpointManager:
     # restore
     # ------------------------------------------------------------------
     def _verify(self, path: str) -> Dict:
-        """Load + checksum-verify one checkpoint dir; raises on any defect."""
-        mpath = os.path.join(path, _MANIFEST)
-        with open(mpath) as f:
-            manifest = json.load(f)
-        if manifest.get("format") != _FORMAT:
-            raise MXNetError(f"unknown checkpoint format "
-                             f"{manifest.get('format')!r}")
-        for name, rec in manifest["files"].items():
-            p = os.path.join(path, name)
-            if not os.path.exists(p):
-                raise MXNetError(f"missing checkpoint file {name}")
-            if os.path.getsize(p) != rec["bytes"]:
-                raise MXNetError(f"checkpoint file {name} truncated "
-                                 f"({os.path.getsize(p)} != {rec['bytes']} "
-                                 "bytes)")
-            if _sha256(p) != rec["sha256"]:
-                raise MXNetError(f"checkpoint file {name} checksum mismatch")
-        with open(os.path.join(path, _META)) as f:
-            meta = json.load(f)
-        with onp.load(os.path.join(path, _DATA), allow_pickle=False) as z:
-            arrays = {k: z[k] for k in z.files}
-        state = _unflatten(arrays, meta.get("scalars", {}))
-        state.setdefault("meta", {})["step"] = int(manifest["step"])
-        return state
+        return verify_checkpoint_dir(path)
 
     def restore(self, step: int, **objs):
         """Verify + load checkpoint ``step`` and apply it to the given
@@ -373,12 +440,96 @@ class CheckpointManager:
         _RESTORES.labels("none").inc()
         return None
 
+    # ------------------------------------------------------------------
+    # preemption marker (written by PreemptionGuard's force-flush)
+    # ------------------------------------------------------------------
+    def write_preemption_marker(self, info: Dict):
+        """Atomically write PREEMPTED.json (tmp + rename) beside the
+        checkpoints: the resumable marker a restarted job reads to learn it
+        was preempted, at which step, and whether the final flush landed."""
+        final = os.path.join(self.directory, _PREEMPT_MARKER)
+        tmp = final + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(info, sort_keys=True))
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, final)
+        self._fsync_dir(self.directory)
+
+    def preemption_marker(self) -> Optional[Dict]:
+        """The preemption marker's contents, or None when the last exit was
+        not a preemption (or the marker was already consumed)."""
+        path = os.path.join(self.directory, _PREEMPT_MARKER)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear_preemption_marker(self):
+        """Consume the marker (call after a successful resume)."""
+        try:
+            os.remove(os.path.join(self.directory, _PREEMPT_MARKER))
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# verification + assembly (module-level: hot-swap validates checkpoints too)
+# ---------------------------------------------------------------------------
+def verify_checkpoint_dir(path: str) -> Dict:
+    """Load + checksum-verify one checkpoint dir; raises on any defect.
+
+    Every manifest-listed file (state.npz, meta.json, and any shard-NNNNN.npz
+    of a sharded save) is size- and sha256-checked before a byte of it is
+    trusted. Sharded leaves are re-assembled into full host arrays from the
+    recorded layout, so the returned state tree is layout-independent — the
+    caller re-shards it onto whatever topology it is restoring onto."""
+    mpath = os.path.join(path, _MANIFEST)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != _FORMAT:
+        raise MXNetError(f"unknown checkpoint format "
+                         f"{manifest.get('format')!r}")
+    for name, rec in manifest["files"].items():
+        p = os.path.join(path, name)
+        if not os.path.exists(p):
+            raise MXNetError(f"missing checkpoint file {name}")
+        if os.path.getsize(p) != rec["bytes"]:
+            raise MXNetError(f"checkpoint file {name} truncated "
+                             f"({os.path.getsize(p)} != {rec['bytes']} "
+                             "bytes)")
+        if _sha256(p) != rec["sha256"]:
+            raise MXNetError(f"checkpoint file {name} checksum mismatch")
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    with onp.load(os.path.join(path, _DATA), allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    layout = meta.get("layout")
+    if layout:
+        shard_files = {}
+        try:
+            for writer in meta.get("shard_files", ()):
+                shard_files[int(writer)] = onp.load(
+                    os.path.join(path, _shard_name(int(writer))),
+                    allow_pickle=False)
+            for key, entry in layout.items():
+                arrays[key] = _assemble(entry, shard_files, key)
+        finally:
+            for zf in shard_files.values():
+                zf.close()
+    state = _unflatten(arrays, meta.get("scalars", {}))
+    state.setdefault("meta", {})["step"] = int(manifest["step"])
+    return state
+
 
 # ---------------------------------------------------------------------------
 # capture/apply glue: what a training checkpoint is made of
 # ---------------------------------------------------------------------------
 def capture_state(*, train_step=None, trainer=None, block=None,
                   dataloader=None, include_rng: bool = True,
+                  sharded: bool = False,
                   extra: Optional[Dict] = None) -> Dict:
     """Snapshot training state into a checkpointable tree (host numpy only —
     safe to write from a background thread while the devices keep stepping).
@@ -388,11 +539,16 @@ def capture_state(*, train_step=None, trainer=None, block=None,
     gluon.Trainer (optimizer slots + update counts); ``block`` — a Block
     whose parameters are saved by name; ``dataloader`` — a DataLoader
     (epoch/position/shuffle RNG); ``include_rng`` — the global
-    ``mxnet_tpu.random`` key chain.
+    ``mxnet_tpu.random`` key chain. ``sharded=True`` captures the
+    train_step's on-mesh state as per-device :class:`~.sharding.ShardedLeaf`
+    shards (each host snapshots only its own devices' shards) — the save
+    then writes the sharded on-disk layout and restore re-shards onto the
+    restoring topology.
     """
     state: Dict = {"meta": {"format": _FORMAT}}
     if train_step is not None:
-        state["train_step"] = train_step.state_dict()
+        state["train_step"] = (train_step.shard_state_dict() if sharded
+                               else train_step.state_dict())
     if trainer is not None:
         state["trainer"] = trainer.state_dict()
     if block is not None:

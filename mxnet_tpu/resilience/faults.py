@@ -9,7 +9,9 @@ named boundaries —
     ``train_step``        ParallelTrainStep, immediately before the compiled call
     ``compile``           executable builds (train-step jit, serving bucket AOT)
     ``serving_dispatch``  InferenceServer worker, before the device batch step
+    ``serving_prep``      the host pipeline's prep stage, before concat/pad/put
     ``checkpoint_write``  CheckpointManager, between file write and fsync
+    ``preemption``        PreemptionGuard's poll point, once per guarded step
 
 — and tests scope injections with the :func:`inject` context manager::
 
@@ -39,11 +41,12 @@ from typing import Optional, Sequence, Tuple
 from ..base import MXNetError
 from .. import telemetry as _telemetry
 
-__all__ = ["FaultInjected", "SimulatedCrash", "inject", "check",
-           "active_kinds", "SITES"]
+__all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
+           "WorkerKilled", "inject", "check", "active_kinds", "SITES"]
 
 #: boundaries where production code calls :func:`check`
-SITES = ("train_step", "compile", "serving_dispatch", "checkpoint_write")
+SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
+         "checkpoint_write", "preemption")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -68,6 +71,28 @@ class SimulatedCrash(FaultInjected):
     """A simulated process death (checkpoint writer killed mid-write)."""
 
 
+class PreemptionNotice(FaultInjected):
+    """A simulated maintenance/preemption notice. Raised at the
+    ``preemption`` poll site; the PreemptionGuard converts it into a
+    requested preemption instead of letting it propagate."""
+
+
+class WorkerKilled(BaseException):
+    """A simulated serving-worker thread death. Deliberately derives from
+    ``BaseException`` so it sails past every ``except Exception`` recovery
+    layer (retry loop, batch-failure handler) and kills the thread itself —
+    exactly what a segfaulting device runtime or an uncatchable interpreter
+    error does. The PoolSupervisor is the only recovery layer for it."""
+
+    def __init__(self, kind: str, site: str, count: int, retryable: bool,
+                 message: str):
+        super().__init__(message)
+        self.kind = kind
+        self.site = site
+        self.count = count
+        self.retryable = retryable
+
+
 # kind -> (default sites, retryable, message template). The message carries
 # the marker a real failure of that kind would carry, so message-based
 # classification agrees with the structured FaultInjected flag.
@@ -88,7 +113,17 @@ _KINDS = {
               "simulated crash: writer killed "
               "(injected {kind} #{count} at {site})"),
     "hang": (("train_step", "serving_dispatch"), True, ""),
+    "preempt": (("preemption",), False,
+                "maintenance notice: instance scheduled for preemption "
+                "(injected {kind} #{count} at {site})"),
+    "worker_kill": (("serving_dispatch", "serving_prep"), False,
+                    "simulated worker death: thread killed "
+                    "(injected {kind} #{count} at {site})"),
 }
+
+#: kinds that raise a dedicated exception class instead of FaultInjected
+_KIND_CLS = {"crash": SimulatedCrash, "preempt": PreemptionNotice,
+             "worker_kill": WorkerKilled}
 
 _LOCK = threading.Lock()
 _ACTIVE: list = []          # the hot-path gate: empty list == harness off
@@ -144,7 +179,7 @@ class _Injection:
             return self._exc_factory(self.kind, site, count)
         _, _, tmpl = _KINDS[self.kind]
         msg = tmpl.format(kind=self.kind, count=count, site=site)
-        cls = SimulatedCrash if self.kind == "crash" else FaultInjected
+        cls = _KIND_CLS.get(self.kind, FaultInjected)
         return cls(self.kind, site, count, self.retryable, msg)
 
 
@@ -159,7 +194,11 @@ def inject(kind: str, site=None, every_n: Optional[int] = None,
     ----------
     kind : str
         One of ``device_oom | compile_error | unavailable | shape_mismatch |
-        crash | hang``. Picks the default sites, retryability and message.
+        crash | hang | preempt | worker_kill``. Picks the default sites,
+        retryability and message. ``preempt`` raises a PreemptionNotice the
+        PreemptionGuard consumes; ``worker_kill`` raises a
+        BaseException-derived WorkerKilled that kills the serving worker
+        thread itself (the PoolSupervisor's failover drill).
     site : str | sequence of str, optional
         Restrict to specific :func:`check` sites (default: the kind's sites).
     every_n : int, optional

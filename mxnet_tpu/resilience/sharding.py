@@ -1,0 +1,121 @@
+"""Sharded checkpoint layout: per-device shard capture and elastic assembly.
+
+A pod-scale checkpoint cannot funnel every parameter through one host —
+each host must write only the shards its own devices hold, and a restore
+must be able to re-shard onto a *different* device count or mesh shape than
+the one that saved (a job preempted on 8 chips resumes on 4). This module is
+the layout half of that contract; CheckpointManager owns the files.
+
+The representation is deliberately dumb and exact:
+
+  - a :class:`ShardedLeaf` captures one on-mesh array as its unique shards —
+    ``addressable_shards`` filtered to ``replica_id == 0``, so a replicated
+    array is written exactly once and a sharded array once per owning
+    device — each shard a host-numpy copy plus its global index (a
+    ``[start, stop)`` pair per dimension);
+  - the writer groups shards by owning-device ordinal into
+    ``shard-NNNNN.npz`` files (one per device that owns anything) and
+    records the placement in a JSON ``layout`` map:
+    ``{leaf_key: {shape, dtype, shards: [{file, index}, ...]}}``;
+  - :func:`assemble` inverts it: allocate the global array, paste every
+    shard into its index. No mesh, no device, no jax — re-sharding onto the
+    restoring topology is a plain ``device_put`` of the assembled host array
+    under the *target* sharding, which is exact (pure data movement).
+
+Bitwise contract: save → assemble is lossless for any source layout, and
+placing the assembled array onto any target layout is lossless again — so a
+re-sharded restore continues bitwise-identically to a run handed the same
+state in-memory on the target mesh. (Continuing on a *different* mesh shape
+is bitwise-faithful to the restored state, but XLA may order cross-device
+reductions differently than the source topology did — a property of the
+compiler, not of the checkpoint; see RESILIENCE.md.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["ShardedLeaf", "capture_sharded", "assemble"]
+
+
+def _norm_index(index: Tuple, shape: Tuple[int, ...]) -> List[List[int]]:
+    """Normalize a shard's index (tuple of slices) to [start, stop) pairs."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise MXNetError(f"non-unit-stride shard index {sl} unsupported")
+        out.append([int(start), int(stop)])
+    return out
+
+
+class ShardedLeaf:
+    """One on-mesh array captured as its unique host shards.
+
+    ``shards`` is ``[(writer, index, data), ...]`` where ``writer`` is the
+    owning device's ordinal in the mesh device list, ``index`` the
+    normalized [start, stop) pairs, and ``data`` a host numpy copy.
+    """
+
+    __slots__ = ("shape", "dtype", "shards")
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = onp.dtype(dtype)
+        self.shards = shards
+
+    @classmethod
+    def from_array(cls, arr, device_pos: Dict) -> "ShardedLeaf":
+        """Capture a jax array's addressable, replica-0 shards. Only shards
+        this process can address are captured — in a multi-host job each
+        host's manager writes its own shard files and no others."""
+        shards = []
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                continue            # a replica of a shard another device owns
+            writer = device_pos.get(sh.device)
+            if writer is None:      # device outside the mesh (cannot happen
+                continue            # for on-mesh state; defensive)
+            shards.append((int(writer), _norm_index(sh.index, arr.shape),
+                           onp.asarray(sh.data)))
+        return cls(arr.shape, arr.dtype, shards)
+
+
+def capture_sharded(tree, device_pos: Dict):
+    """Map every jax-array leaf of a nested dict tree to a ShardedLeaf
+    (leaves that are already host scalars/arrays pass through)."""
+    if isinstance(tree, dict):
+        return {k: capture_sharded(v, device_pos) for k, v in tree.items()}
+    if hasattr(tree, "addressable_shards"):
+        return ShardedLeaf.from_array(tree, device_pos)
+    return tree
+
+
+def assemble(entry: Dict, shard_files: Dict[int, object], key: str
+             ) -> onp.ndarray:
+    """Rebuild one global array from a layout entry + opened shard files.
+
+    ``entry`` is the layout record ``{shape, dtype, shards}``; covering is
+    verified — a layout whose shards do not tile the full array (a lost
+    shard file would already have failed the manifest check; this guards a
+    corrupt layout) raises instead of returning silently-stale memory."""
+    shape = tuple(entry["shape"])
+    arr = onp.empty(shape, dtype=onp.dtype(entry["dtype"]))
+    covered = 0
+    for rec in entry["shards"]:
+        zf = shard_files.get(int(rec["file"]))
+        if zf is None:
+            raise MXNetError(f"layout references missing shard file "
+                             f"{rec['file']} for {key!r}")
+        idx = tuple(slice(a, b) for a, b in rec["index"])
+        piece = zf[key]
+        arr[idx] = piece
+        covered += int(piece.size)
+    if covered != arr.size:
+        raise MXNetError(
+            f"sharded leaf {key!r}: shards cover {covered} of {arr.size} "
+            "elements (corrupt or non-tiling layout)")
+    return arr

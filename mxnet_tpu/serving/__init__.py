@@ -25,6 +25,15 @@ double-buffered host pipeline:
 - **Per-tenant shedding**: each endpoint gets its own CircuitBreaker, so one
   tenant's overload tightens that tenant's admission, not the whole server.
 
+r7 adds the elastic layer: **zero-downtime weight hot-swap**
+(``server.hot_swap(name, ckpt)`` verifies + stages off the serving path,
+probe-validates bitwise against recorded outputs, cuts over on the worker
+at a batch boundary, rolls back on failure) and **worker failover**
+(``PoolSupervisor`` declares a dead or watchdog-wedged worker, requeues its
+batches front-of-queue with deadlines intact, trips only the affected
+tenant's breaker, restarts the worker generation). See RESILIENCE.md's
+"Preemption & hot-swap runbook".
+
     from mxnet_tpu import serving
 
     ep = serving.ModelEndpoint("resnet50", net, input_shapes=(3, 224, 224),
@@ -65,16 +74,17 @@ occupancy (real vs padded rows) and executable-cache hit/compile counters.
 from __future__ import annotations
 
 from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
-from .errors import (RequestTimeoutError, ServerClosedError,
+from .errors import (HotSwapError, RequestTimeoutError, ServerClosedError,
                      ServerOverloadError, ServingError)
 from .router import Router, StepCostEWMA, Tenant
 from .server import InferenceServer
+from .supervisor import PoolSupervisor
 from . import bucketing
 
-__all__ = ["ModelEndpoint", "InferenceServer", "stats", "get_endpoint",
-           "list_endpoints", "unregister", "ServingError",
+__all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
+           "get_endpoint", "list_endpoints", "unregister", "ServingError",
            "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
-           "Router", "StepCostEWMA", "Tenant", "bucketing"]
+           "HotSwapError", "Router", "StepCostEWMA", "Tenant", "bucketing"]
 
 
 def stats():

@@ -157,6 +157,17 @@ class EndpointQueue:
         ep.stats.set_queue_depth(self.pending_rows)
         return batch
 
+    def requeue_front(self, requests: Sequence[Request]):
+        """Push already-admitted requests back at the HEAD of the queue in
+        their original order (worker failover: batches a dead/wedged worker
+        never finished re-enter scheduling). Deliberately ignores the row
+        bound — these rows were admitted once and still hold their original
+        ``enqueue_us``/deadline, so expiry at re-assembly still applies."""
+        for r in reversed(list(requests)):
+            self._pending.appendleft(r)
+            self.pending_rows += r.rows
+        self.endpoint.stats.set_queue_depth(self.pending_rows)
+
     def fail_all(self, exc: Exception, counter: str = "cancelled"):
         """Drain the queue, failing every pending future (non-drain stop)."""
         while self._pending:

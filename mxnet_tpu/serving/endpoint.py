@@ -23,12 +23,21 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as onp
 
 from ..base import Context, DTypes, MXNetError, current_context
+from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from . import bucketing
+from .errors import HotSwapError
 from .router import StepCostEWMA
 from .stats import EndpointStats
 
 __all__ = ["ModelEndpoint"]
+
+_HOT_SWAPS = _telemetry.counter(
+    "mxtpu_serving_hot_swaps_total",
+    "Weight hot-swap attempts by outcome: ok (staged, probe-validated, "
+    "committed) / rolled_back (probe validation failed; old weights kept) / "
+    "rejected (corrupt or mismatched checkpoint, refused before staging).",
+    labelnames=("outcome",))
 
 # name -> endpoint; the registry behind mxnet_tpu.serving.stats()
 _ENDPOINTS: Dict[str, "ModelEndpoint"] = {}
@@ -98,6 +107,12 @@ class ModelEndpoint:
         self._execs: Dict[int, object] = {}   # bucket -> compiled executable
         self._jfn = None
         self._params = None                   # ordered Parameter list
+        # hot-swap state: once a swap commits, _active_params (device
+        # arrays) is the weight set executables run with; the reference is
+        # swapped atomically at a batch boundary by the dispatching thread,
+        # so no batch ever sees a half-loaded model
+        self._active_params: Optional[Tuple] = None
+        self._weights_epoch = 0
         # double-buffer parity slots: the pipeline's prep stage writes the
         # input-buffer set for parity p while the executable reads parity 1-p
         self._parity_bufs: list = [None, None]
@@ -182,7 +197,14 @@ class ModelEndpoint:
         return self._jfn
 
     def _param_datas(self):
+        if self._active_params is not None:
+            return self._active_params
         return tuple(p.data(self.ctx).data for p in self._params)
+
+    @property
+    def weights_epoch(self) -> int:
+        """Monotonic hot-swap generation of the weights currently served."""
+        return self._weights_epoch
 
     # ------------------------------------------------------------------
     # the shape-bucketed executable cache
@@ -296,6 +318,198 @@ class ModelEndpoint:
         ins, bucket, padded = self.prepare(host_inputs, rows)
         outs = self.execute(ins, bucket, rows, padded_host=padded)
         return outs, bucket
+
+    # ------------------------------------------------------------------
+    # zero-downtime weight hot-swap
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, manager, step: int, probe_seed: int = 0):
+        """Producer-side half of hot-swap: write this endpoint's weights as
+        an atomic, checksummed serving checkpoint (CheckpointManager layout)
+        *plus a recorded probe*: a seeded random smallest-bucket batch and
+        the outputs these exact weights produce for it. A consumer's
+        ``hot_swap`` replays the probe against the staged weights and
+        requires bitwise-equal outputs before cutting over — corrupt bytes,
+        a mixed-up param file, or a wrong-architecture checkpoint all fail
+        validation instead of reaching clients.
+
+        Call this from the training/export job (or a stopped endpoint) —
+        it invokes a compiled executable, so inside a live server it belongs
+        to the worker thread only."""
+        from ..resilience.checkpoint import capture_state
+        bucket = self.buckets[0]
+        rng = onp.random.RandomState(probe_seed & 0x7FFFFFFF)
+        probe_in = tuple(
+            rng.standard_normal((bucket,) + s).astype(dt)
+            for s, dt in zip(self.input_shapes, self.np_dtypes))
+        import jax
+        comp = self._get_executable(bucket)
+        dev = self.ctx.jax_device()
+        ins = tuple(jax.device_put(a, dev) for a in probe_in)
+        outs = comp(self._param_datas(), *ins)
+        jax.block_until_ready(outs)
+        state = capture_state(block=self.block, include_rng=False)
+        state["serving"] = {
+            "bucket": int(bucket), "probe_seed": int(probe_seed),
+            "probe": {f"i{i}": a for i, a in enumerate(probe_in)},
+            "expected": {f"o{i}": onp.asarray(jax.device_get(o))
+                         for i, o in enumerate(outs)},
+        }
+        return manager.save(step, state=state)
+
+    def load_swap_source(self, source):
+        """Resolve a hot-swap source into ``(host_params, probe, label)``
+        WITHOUT touching the served weights. ``source`` may be a checkpoint
+        directory (a single ``ckpt-*`` dir or a CheckpointManager root, in
+        which case the newest intact checkpoint is used — every file is
+        checksum-verified first), or an explicit state tree as written by
+        :meth:`save_checkpoint` / ``capture_state(block=...)``. Raises
+        HotSwapError on corruption or model mismatch — the caller never
+        stages bad weights."""
+        import os
+        from ..resilience.checkpoint import verify_checkpoint_dir
+        label = "<state>"
+        state = None
+        if isinstance(source, str):
+            label = source
+            try:
+                if os.path.isfile(os.path.join(source, "MANIFEST.json")):
+                    state = verify_checkpoint_dir(source)
+                else:
+                    names = sorted(n for n in os.listdir(source)
+                                   if n.startswith("ckpt-"))
+                    for name in reversed(names):
+                        try:
+                            state = verify_checkpoint_dir(
+                                os.path.join(source, name))
+                            label = os.path.join(source, name)
+                            break
+                        except Exception:
+                            continue
+            except OSError as e:
+                raise HotSwapError(f"cannot read swap source {source!r}: {e}")
+            if state is None:
+                _HOT_SWAPS.labels("rejected").inc()
+                raise HotSwapError(
+                    f"no intact checkpoint under {source!r}: every candidate "
+                    "failed checksum verification")
+        elif isinstance(source, dict):
+            state = source
+        else:
+            raise HotSwapError(
+                f"unsupported hot_swap source {type(source).__name__}; pass "
+                "a checkpoint directory or a state tree")
+        mod = state.get("model")
+        if mod is None:
+            _HOT_SWAPS.labels("rejected").inc()
+            raise HotSwapError(
+                f"swap source {label} has no 'model' component "
+                f"(holds {sorted(state)})")
+        try:
+            n = int(mod["n_params"])
+            if n != len(self._params):
+                raise HotSwapError(
+                    f"checkpoint holds {n} params, endpoint {self.name!r} "
+                    f"serves {len(self._params)} ({mod.get('param_names')})")
+            host = []
+            for i, p in enumerate(self._params):
+                arr = onp.asarray(mod["params"][f"p{i}"])
+                if tuple(arr.shape) != tuple(p.shape):
+                    raise HotSwapError(
+                        f"checkpoint param {i} shape {arr.shape} != endpoint "
+                        f"param shape {tuple(p.shape)}")
+                host.append(arr)
+        except (KeyError, TypeError, ValueError) as e:
+            _HOT_SWAPS.labels("rejected").inc()
+            raise HotSwapError(f"malformed swap source {label}: {e!r}")
+        except HotSwapError:
+            _HOT_SWAPS.labels("rejected").inc()
+            raise
+        probe = state.get("serving")
+        return host, probe, label
+
+    def stage_weights(self, host_params):
+        """Transfer new weights into fresh device buffers (the off-parity
+        set: in-flight steps keep reading the old arrays untouched). Host
+        work only — safe off the worker thread."""
+        import jax
+        dev = self.ctx.jax_device()
+        return tuple(
+            jax.device_put(a.astype(p.data(self.ctx).data.dtype, copy=False)
+                           if onp.dtype(a.dtype) != p.data(self.ctx).data.dtype
+                           else a, dev)
+            for a, p in zip(host_params, self._params))
+
+    def validate_and_commit(self, staged, probe=None) -> dict:
+        """Dispatcher-thread half of a hot-swap: run the validation probe
+        against the STAGED weights (the serving weights are untouched), then
+        cut over atomically. With a recorded probe (``save_checkpoint``),
+        the staged outputs must be bitwise-equal to the recorded ones;
+        without one, outputs must at least be finite. Any validation failure
+        raises HotSwapError with nothing committed — automatic rollback."""
+        import jax
+        if probe is not None:
+            bucket = int(probe["bucket"])
+            ins_h = [onp.asarray(probe["probe"][f"i{i}"])
+                     for i in range(len(self.input_shapes))]
+            expected = [onp.asarray(probe["expected"][f"o{i}"])
+                        for i in range(self.num_outputs)]
+        else:
+            bucket = self.buckets[0]
+            ins_h = [onp.zeros((bucket,) + s, dt)
+                     for s, dt in zip(self.input_shapes, self.np_dtypes)]
+            expected = None
+        comp = self._get_executable(bucket)
+        dev = self.ctx.jax_device()
+        ins = tuple(jax.device_put(a, dev) for a in ins_h)
+        try:
+            outs = comp(staged, *ins)
+            jax.block_until_ready(outs)
+            outs_h = [onp.asarray(jax.device_get(o)) for o in outs]
+        except Exception as e:
+            _HOT_SWAPS.labels("rolled_back").inc()
+            raise HotSwapError(
+                f"staged weights failed the probe execution: {e}") from e
+        if expected is not None:
+            for i, (got, want) in enumerate(zip(outs_h, expected)):
+                if not onp.array_equal(got, want):
+                    _HOT_SWAPS.labels("rolled_back").inc()
+                    raise HotSwapError(
+                        f"probe output {i} does not match the recorded "
+                        "outputs of the checkpointed weights; rolled back "
+                        "(old weights keep serving)")
+        else:
+            for i, got in enumerate(outs_h):
+                if not onp.all(onp.isfinite(got)):
+                    _HOT_SWAPS.labels("rolled_back").inc()
+                    raise HotSwapError(
+                        f"probe output {i} contains non-finite values; "
+                        "rolled back (old weights keep serving)")
+        # commit: one reference assignment — the next batch's _param_datas()
+        # sees the full new weight set, the in-flight one kept the old
+        self._active_params = staged
+        self._weights_epoch += 1
+        # keep the block's Parameters in sync so direct block(...) forwards
+        # and later save_checkpoint calls reflect the served weights
+        for p, a in zip(self._params, staged):
+            p.set_data(NDArray(onp.asarray(jax.device_get(a))))
+        self.stats.bump("hot_swaps")
+        _HOT_SWAPS.labels("ok").inc()
+        return {"endpoint": self.name, "weights_epoch": self._weights_epoch,
+                "probe": "recorded" if probe is not None else "finite",
+                "bucket": bucket}
+
+    def hot_swap(self, source) -> dict:
+        """Inline hot-swap for a *stopped* (or never-served) endpoint: load +
+        verify ``source``, stage, probe-validate, cut over; HotSwapError
+        rolls back to the old weights. Inside a running InferenceServer use
+        ``server.hot_swap(name, source)`` instead — it routes the validation
+        and cutover through the worker thread at a batch boundary, so no
+        request is ever dropped or served from a half-loaded model."""
+        host, probe, label = self.load_swap_source(source)
+        staged = self.stage_weights(host)
+        report = self.validate_and_commit(staged, probe)
+        report["source"] = label
+        return report
 
     def __repr__(self):
         return (f"ModelEndpoint({self.name!r}, inputs={self.input_shapes}, "

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "ServerOverloadError", "RequestTimeoutError",
-           "ServerClosedError"]
+           "ServerClosedError", "HotSwapError"]
 
 
 class ServingError(MXNetError):
@@ -29,3 +29,9 @@ class RequestTimeoutError(ServingError):
 
 class ServerClosedError(ServingError):
     """The server is stopped or draining and no longer admits new work."""
+
+
+class HotSwapError(ServingError):
+    """A weight hot-swap was refused (corrupt/mismatched checkpoint) or its
+    probe validation failed. The endpoint rolled back and keeps serving the
+    previous weights — the swap never became client-visible."""

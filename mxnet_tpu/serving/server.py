@@ -56,7 +56,8 @@ from ..resilience.watchdog import (CircuitBreaker, Watchdog, DEGRADED,
                                    HALF_OPEN, HEALTHY, OPEN)
 from .batcher import EndpointQueue, Request, fail, resolve
 from .endpoint import ModelEndpoint
-from .errors import ServerClosedError, ServerOverloadError
+from .errors import (HotSwapError, RequestTimeoutError, ServerClosedError,
+                     ServerOverloadError)
 from .pipeline import OverlapTracker, PreparedBatch, prepare_batch
 from .router import Router, Tenant
 
@@ -64,13 +65,44 @@ __all__ = ["InferenceServer"]
 
 _RUNNING, _DRAINING, _STOPPED = "running", "draining", "stopped"
 
+#: returned by the wait loops to a worker/prep thread whose epoch was
+#: superseded by a failover: exit silently, a replacement is already running
+_SUPERSEDED = object()
+
 #: how bad is a circuit state, for the worst-of health aggregation
 _CIRCUIT_SEVERITY = {HEALTHY: 0, DEGRADED: 1, HALF_OPEN: 2, OPEN: 3}
 
 _DRAIN_ABANDONED = _telemetry.counter(
     "mxtpu_drain_abandoned_total",
     "Requests abandoned because stop(drain=True) hit its timeout with the "
-    "worker wedged; each one was failed with ServerClosedError.")
+    "worker wedged: queued-never-batched ones failed with ServerClosedError, "
+    "ones already inside a prepared/in-flight batch with "
+    "RequestTimeoutError — never left to hang a waiting client.")
+
+_FAILOVERS = _telemetry.counter(
+    "mxtpu_serving_failovers_total",
+    "Worker failovers performed, by reason: worker_dead (thread crashed) / "
+    "worker_wedged (in-flight batch outlived the watchdog stall threshold) "
+    "/ prep_dead (prep thread crashed).", labelnames=("reason",))
+_FAILOVER_REQUEUED = _telemetry.counter(
+    "mxtpu_serving_failover_requeued_total",
+    "Requests returned to the front of their tenant queues by a failover "
+    "(from prepared / in-flight batches of the dead worker); deadlines are "
+    "re-checked at re-assembly.")
+
+
+class _SwapRequest:
+    """One routed hot-swap: host-staged weights + probe riding the worker's
+    command path, applied between batches (the batch-boundary cutover)."""
+
+    __slots__ = ("tenant", "host_params", "probe", "label", "future")
+
+    def __init__(self, tenant, host_params, probe, label):
+        self.tenant = tenant
+        self.host_params = host_params
+        self.probe = probe
+        self.label = label
+        self.future = Future()
 
 
 def _now_us() -> int:
@@ -130,6 +162,15 @@ class InferenceServer:
         self._prep_thread: Optional[threading.Thread] = None  # prep stage
         self._prep_done = True
         self._prepared: "list[PreparedBatch]" = []
+        # failover bookkeeping: which thread generation is current (stale
+        # workers exit when superseded), what each stage is holding right now
+        # (so a failover can requeue it), and pending hot-swap commands
+        self._epoch = 0
+        self._inflight: Optional[PreparedBatch] = None
+        self._preparing = None          # (tenant, [requests]) during prep
+        self._swaps: "list[_SwapRequest]" = []
+        self._stall_listeners: list = []
+        self.failovers = 0
         self._overlap = OverlapTracker()
         self._retry = retry_policy if retry_policy is not None \
             else RetryPolicy.from_config()
@@ -189,6 +230,59 @@ class InferenceServer:
             return self._router.get(name).breaker
 
     # ------------------------------------------------------------------
+    # zero-downtime weight hot-swap (routed through the worker)
+    # ------------------------------------------------------------------
+    def hot_swap(self, name: str, source, timeout: Optional[float] = None
+                 ) -> dict:
+        """Swap the named endpoint's weights to ``source`` (a checkpoint
+        directory or state tree) WITHOUT dropping a request.
+
+        The heavy host work happens here on the caller's thread: the
+        checkpoint is checksum-verified, shape-checked against the serving
+        model, and staged into fresh device buffers (the in-flight batch
+        keeps reading the old ones). The validation probe + cutover then
+        ride the worker's command path and run *between* batches: every
+        batch executes against either the complete old weights or the
+        complete new ones, never a mixture, and the queue keeps flowing —
+        the swap costs one probe step, not a drain.
+
+        Validation failure (probe outputs differ from the ones recorded
+        with the checkpoint, or are non-finite) rolls back: the old weights
+        keep serving and HotSwapError is raised here. A corrupt checkpoint
+        is refused before anything is staged. Blocks for the swap outcome
+        (bounded by ``timeout`` seconds; None = wait)."""
+        with self._cond:
+            if name not in self._router:
+                raise MXNetError(f"unknown endpoint {name!r}; registered: "
+                                 f"{self._router.names()}")
+            tenant = self._router.get(name)
+        # verify + shape-check + stage on the caller's thread (host work
+        # plus device_put — never a compiled executable)
+        host_params, probe, label = tenant.endpoint.load_swap_source(source)
+        req = _SwapRequest(tenant, host_params, probe, label)
+        with self._cond:
+            if self._state != _RUNNING:
+                raise ServerClosedError(
+                    f"server is {self._state}; hot_swap needs a running "
+                    "worker (use endpoint.hot_swap() on a stopped one)")
+            self._swaps.append(req)
+            self._cond.notify_all()
+        return req.future.result(timeout=timeout)
+
+    def _apply_swap(self, req: _SwapRequest):
+        """Worker-thread half of a routed hot-swap (between batches)."""
+        ep = req.tenant.endpoint
+        try:
+            staged = ep.stage_weights(req.host_params)
+            report = ep.validate_and_commit(staged, req.probe)
+            report["source"] = req.label
+            resolve(req.future, report)
+        except Exception as e:
+            exc = e if isinstance(e, HotSwapError) else HotSwapError(
+                f"hot swap of {ep.name!r} failed validation: {e}")
+            fail(req.future, exc)
+
+    # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
     def start(self) -> "InferenceServer":
@@ -202,28 +296,41 @@ class InferenceServer:
                         "(abandoned drain); this server cannot be restarted")
             self._state = _RUNNING
             self._prepared.clear()
-            self._prep_done = not self._pipeline
-            self._thread = threading.Thread(
-                target=self._loop_exec if self._pipeline
-                else self._loop_serial,
-                name="mxtpu-serving-worker", daemon=True)
-            if self._pipeline:
-                self._prep_thread = threading.Thread(
-                    target=self._loop_prep, name="mxtpu-serving-prep",
-                    daemon=True)
-                self._prep_thread.start()
-            else:
-                self._prep_thread = None
-            self._thread.start()
+            self._spawn_threads()
         return self
+
+    def _spawn_threads(self):  # mxlint: disable=CONC200
+        """Start a fresh worker (+prep) generation (caller holds the lock):
+        used by start() and by failover(), which bumps the epoch first so
+        any surviving stale thread exits at its next loop turn."""
+        epoch = self._epoch
+        self._prep_done = not self._pipeline
+        self._inflight = None
+        self._preparing = None
+        self._thread = threading.Thread(
+            target=self._loop_exec if self._pipeline
+            else self._loop_serial, args=(epoch,),
+            name=f"mxtpu-serving-worker-e{epoch}", daemon=True)
+        if self._pipeline:
+            self._prep_thread = threading.Thread(
+                target=self._loop_prep, args=(epoch,),
+                name=f"mxtpu-serving-prep-e{epoch}", daemon=True)
+            self._prep_thread.start()
+        else:
+            self._prep_thread = None
+        self._thread.start()
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop serving. ``drain=True`` (default) processes every admitted
         request before returning, but never waits longer than ``timeout``
         seconds (default ``drain_timeout_s``): past it the remaining requests
-        are abandoned — failed with ServerClosedError and counted in
-        ``mxtpu_drain_abandoned_total`` — so a wedged endpoint queue cannot
-        hang shutdown forever. ``drain=False`` fails them immediately."""
+        are abandoned and counted in ``mxtpu_drain_abandoned_total`` —
+        queued-never-batched ones fail with ServerClosedError, requests
+        already inside a prepared or in-flight batch with
+        RequestTimeoutError (their latency budget died with the wedged
+        worker) — so neither a wedged endpoint queue nor a hung device call
+        can hang shutdown or leave a client waiting forever. ``drain=False``
+        fails everything immediately."""
         timeout = self._drain_timeout_s if timeout is None else float(timeout)
         with self._cond:
             if self._state == _STOPPED and self._thread is None and \
@@ -240,6 +347,8 @@ class InferenceServer:
                 exc = ServerClosedError("server stopped without drain")
                 self._router.fail_all(exc)
                 self._fail_prepared(exc)
+                self._fail_swaps(ServerClosedError(
+                    "server stopped without drain"))
             self._cond.notify_all()
         deadline = time.monotonic() + timeout
         for t in (prep, worker):
@@ -252,11 +361,15 @@ class InferenceServer:
             # already-failed futures is a no-op.
             with self._cond:
                 self._state = _STOPPED
-                exc = ServerClosedError(
-                    f"drain abandoned after {timeout:.1f}s "
-                    "(worker wedged)")
-                abandoned = self._router.fail_all(exc)
-                abandoned += self._fail_prepared(exc)
+                abandoned = self._router.fail_all(ServerClosedError(
+                    f"drain abandoned after {timeout:.1f}s (worker wedged)"))
+                timed_out = RequestTimeoutError(
+                    f"request abandoned inside a batch after the drain "
+                    f"timeout ({timeout:.1f}s) with the worker wedged")
+                abandoned += self._fail_prepared(timed_out)
+                abandoned += self._fail_in_stage(timed_out)
+                self._fail_swaps(ServerClosedError(
+                    "drain abandoned (worker wedged)"))
                 self._cond.notify_all()
             if abandoned:
                 _DRAIN_ABANDONED.inc(abandoned)
@@ -297,6 +410,7 @@ class InferenceServer:
                 "pending_rows": t.queue.pending_rows,
                 "circuit": t.breaker.state(),
                 "slo_ms": t.slo_us / 1000.0 if t.slo_us else None,
+                "weights_epoch": t.endpoint.weights_epoch,
             }
         worst = max((b.state() for b in breakers),
                     key=lambda s: _CIRCUIT_SEVERITY[s])
@@ -306,7 +420,9 @@ class InferenceServer:
                 "tenants": {t.name: t.breaker.snapshot() for t in tenants},
                 "endpoints": endpoints,
                 "prep_overlap_ratio": self._overlap.ratio(),
-                "watchdog_stalls": self._watchdog.stalls}
+                "watchdog_stalls": self._watchdog.stalls,
+                "worker_epoch": self._epoch,
+                "failovers": self.failovers}
 
     def __enter__(self):
         return self.start()
@@ -410,14 +526,22 @@ class InferenceServer:
     # ------------------------------------------------------------------
     # shared scheduling helpers (caller holds the condition lock)
     # ------------------------------------------------------------------
-    def _next_assembly(self):  # mxlint: disable=CONC200
+    def _next_assembly(self, epoch: int, take_swaps: bool = False):  # mxlint: disable=CONC200
         """Block (holding the lock) until the Router yields a tenant whose
         batch should assemble now, a drain can finish, or the server stops.
         Returns (tenant, requests); requests may be [] when all ready work
-        had expired, and None on exit (stopped, or drain complete)."""
+        had expired, None on exit (stopped, or drain complete), and
+        _SUPERSEDED when a failover replaced this thread's generation.
+        ``take_swaps`` (the serial worker, which is its own dispatcher)
+        additionally returns pending _SwapRequests — ahead of batch
+        assembly, so a swap lands at the next batch boundary."""
         while True:
             if self._state == _STOPPED:
                 return None
+            if self._epoch != epoch:
+                return _SUPERSEDED
+            if take_swaps and self._swaps:
+                return self._swaps.pop(0)
             now = _now_us()
             flush = self._state == _DRAINING
             if len(self._prepared) >= self._PIPELINE_DEPTH:
@@ -450,41 +574,153 @@ class InferenceServer:
                 n += 1
         return n
 
+    def _fail_in_stage(self, exc: Exception) -> int:  # mxlint: disable=CONC200
+        """Fail the requests held by the in-flight device step and the prep
+        stage (caller holds the lock). The wedged daemon thread may
+        eventually finish and try to resolve them; resolve() on a settled
+        future is a no-op, the client already got this error."""
+        n = 0
+        for holder in (self._inflight, self._preparing):
+            if holder is None:
+                continue
+            tenant, requests = (holder.tenant, holder.requests) \
+                if isinstance(holder, PreparedBatch) else holder
+            for r in requests:
+                tenant.endpoint.stats.bump("cancelled")
+                fail(r.future, exc)
+                n += 1
+        self._inflight = None
+        self._preparing = None
+        return n
+
+    def _fail_swaps(self, exc: Exception):  # mxlint: disable=CONC200
+        """Fail pending hot-swap commands (caller holds the lock)."""
+        while self._swaps:
+            fail(self._swaps.pop(0).future, exc)
+
     def _on_stall(self, name: str, dt: float):
         """Watchdog hook: a stalled device step degrades the *stalled
         tenant's* circuit (falling back to the server breaker when the watch
-        name is not a tenant's)."""
+        name is not a tenant's), then notifies registered stall listeners
+        (the PoolSupervisor confirms the wedge and fails the worker over)."""
         ep_name = name.partition("[")[2].rstrip("]")
         tenant = self._router.find(ep_name)
         br = tenant.breaker if tenant is not None else self._breaker
         br.force_degraded(f"stall {name} {dt:.1f}s")
+        for cb in list(self._stall_listeners):
+            try:
+                cb(name, dt)
+            except Exception:
+                pass            # a broken listener must not kill the monitor
+
+    def add_stall_listener(self, cb):
+        """Subscribe to watchdog stall events: ``cb(watch_name, elapsed_s)``
+        runs on the watchdog monitor thread and must not block."""
+        self._stall_listeners.append(cb)
+
+    def remove_stall_listener(self, cb):
+        try:
+            self._stall_listeners.remove(cb)
+        except ValueError:
+            pass
+
+    # ------------------------------------------------------------------
+    # worker failover (driven by the PoolSupervisor)
+    # ------------------------------------------------------------------
+    def failover(self, reason: str, tenant_name: Optional[str] = None
+                 ) -> Optional[dict]:
+        """Replace a dead or wedged worker generation without restarting the
+        server: requeue every batch the old generation held (prepared
+        batches, the prep stage's in-hand assembly, and the in-flight device
+        batch) at the FRONT of their tenant queues — original order and
+        deadlines preserved, so expired requests still fail with
+        RequestTimeoutError at re-assembly instead of silently re-running —
+        trip only the affected tenant's circuit breaker, bump the thread
+        epoch (a surviving zombie exits at its next loop turn; its late
+        future resolutions are no-ops), and start fresh worker/prep threads.
+
+        Returns a report dict, or None when the server was not running (a
+        racing stop() wins). Other tenants' queues, breakers and SLOs are
+        untouched — one tenant's wedged batch is that tenant's problem."""
+        with self._cond:
+            if self._state != _RUNNING:
+                return None
+            self._epoch += 1
+            requeued = 0
+            # newest-assembled first, so the oldest work ends up at the head
+            for pb in reversed(self._prepared):
+                pb.tenant.queue.requeue_front(pb.requests)
+                requeued += len(pb.requests)
+            self._prepared.clear()
+            if self._preparing is not None:
+                tenant, batch = self._preparing
+                tenant.queue.requeue_front(batch)
+                requeued += len(batch)
+                self._preparing = None
+            inflight = self._inflight
+            if inflight is not None:
+                inflight.tenant.queue.requeue_front(inflight.requests)
+                requeued += len(inflight.requests)
+                if tenant_name is None:
+                    tenant_name = inflight.tenant.name
+                self._inflight = None
+            affected = self._router.find(tenant_name) \
+                if tenant_name is not None else None
+            if affected is not None:
+                affected.breaker.record_failure()
+            self.failovers += 1
+            epoch = self._epoch
+            self._spawn_threads()
+            self._cond.notify_all()
+        _FAILOVERS.labels(reason).inc()
+        if requeued:
+            _FAILOVER_REQUEUED.inc(requeued)
+        return {"reason": reason, "epoch": epoch, "requeued": requeued,
+                "tenant": tenant_name}
 
     # ------------------------------------------------------------------
     # serial worker (pipeline=False): assemble -> prepare -> execute inline
     # ------------------------------------------------------------------
-    def _loop_serial(self):
+    def _loop_serial(self, epoch: int):
         while True:
             with self._cond:
-                item = self._next_assembly()
+                item = self._next_assembly(epoch, take_swaps=True)
+                if item is _SUPERSEDED:
+                    return                 # a failover replaced this worker
                 if item is None:
                     self._state = _STOPPED
+                    self._fail_swaps(ServerClosedError("server stopped"))
                     self._cond.notify_all()
                     return
+            if isinstance(item, _SwapRequest):
+                self._apply_swap(item)     # batch boundary by construction
+                continue
             tenant, batch = item
             if not batch:
                 continue
+            with self._cond:
+                self._preparing = (tenant, batch)
+            # no finally: if a thread-killing BaseException escapes
+            # _prepare, the _preparing record survives for failover to
+            # requeue; ordinary prep failures return None (futures failed)
             pb = self._prepare(tenant, batch, 0)
+            with self._cond:
+                if self._preparing is not None and \
+                        self._preparing[1] is batch:
+                    self._preparing = None
             if pb is not None:
                 self._execute(pb)
 
     # ------------------------------------------------------------------
     # pipelined prep stage: assemble + device_put batch k+1 during step k
     # ------------------------------------------------------------------
-    def _loop_prep(self):
+    def _loop_prep(self, epoch: int):
         parity = 0
         while True:
             with self._cond:
-                item = self._next_assembly()
+                item = self._next_assembly(epoch)
+                if item is _SUPERSEDED:
+                    return                 # a failover replaced this stage
                 if item is None:
                     self._prep_done = True
                     self._cond.notify_all()
@@ -492,11 +728,25 @@ class InferenceServer:
             tenant, batch = item
             if not batch:
                 continue
+            with self._cond:
+                self._preparing = (tenant, batch)
+            # no finally: see _loop_serial — a killed prep thread leaves the
+            # _preparing record for failover to requeue
             pb = self._prepare(tenant, batch, parity)
+            with self._cond:
+                if self._preparing is not None and \
+                        self._preparing[1] is batch:
+                    self._preparing = None
             if pb is None:
                 continue                  # prep failed; futures already failed
             parity ^= 1                   # flip the double-buffer parity
             with self._cond:
+                if self._epoch != epoch:
+                    # superseded mid-prepare: hand the rows back to their
+                    # queue — the replacement generation re-assembles them
+                    tenant.queue.requeue_front(pb.requests)
+                    self._cond.notify_all()
+                    return
                 if self._state == _STOPPED:
                     exc = ServerClosedError("server stopped")
                     for r in pb.requests:
@@ -522,22 +772,33 @@ class InferenceServer:
     # ------------------------------------------------------------------
     # pipelined worker: execute prepared batches (the only executable caller)
     # ------------------------------------------------------------------
-    def _loop_exec(self):
+    def _loop_exec(self, epoch: int):
         while True:
             with self._cond:
-                pb = self._next_prepared()
-                if pb is None:
+                item = self._next_prepared(epoch)
+                if item is _SUPERSEDED:
+                    return                 # a failover replaced this worker
+                if item is None:
                     self._state = _STOPPED
+                    self._fail_swaps(ServerClosedError("server stopped"))
                     self._cond.notify_all()
                     return
-            self._execute(pb)
+            if isinstance(item, _SwapRequest):
+                self._apply_swap(item)     # between batches: the boundary
+                continue
+            self._execute(item)
 
-    def _next_prepared(self) -> Optional[PreparedBatch]:  # mxlint: disable=CONC200
-        """Block (holding the lock) for the next prepared batch; None on
-        stop, or when a drain has flushed everything through."""
+    def _next_prepared(self, epoch: int):  # mxlint: disable=CONC200
+        """Block (holding the lock) for the next prepared batch or hot-swap
+        command (commands first: they cut over at the batch boundary);
+        None on stop or a fully-flushed drain, _SUPERSEDED on failover."""
         while True:
             if self._state == _STOPPED:
                 return None
+            if self._epoch != epoch:
+                return _SUPERSEDED
+            if self._swaps:
+                return self._swaps.pop(0)
             if self._prepared:
                 pb = self._prepared.pop(0)
                 self._cond.notify_all()    # the handoff slot is free again
@@ -566,6 +827,13 @@ class InferenceServer:
                     f"serving[{ep.name}]b{pb.rows}", step, cat="serving")
             return step()
 
+        with self._cond:
+            self._inflight = pb
+        # `killed` guards the in-flight record: a thread-killing
+        # BaseException (worker_kill drill, interpreter death) must leave it
+        # set so failover can requeue the orphaned batch; every caught path
+        # clears it below
+        killed = True
         self._overlap.step_begin()
         try:
             # adopt the oldest request's trace id for the whole batch step:
@@ -581,13 +849,21 @@ class InferenceServer:
                     # off past the earliest request deadline in the batch
                     outs = self._retry.run(run_step, site="serving_dispatch",
                                            deadline_us=pb.deadline_us)
+            killed = False
         except Exception as e:  # retries exhausted / fatal: fail the batch
+            killed = False
             pb.tenant.breaker.record_failure()
             for r in pb.requests:
                 fail(r.future, e)
             return
         finally:
             self._overlap.step_end()
+            if not killed:
+                with self._cond:
+                    # guarded: after a failover this slot belongs to the
+                    # replacement worker's batch, not to this zombie
+                    if self._inflight is pb:
+                        self._inflight = None
         pb.tenant.breaker.record_success()
         ep.stats.record_step(_now_us() - t0)
         off = 0

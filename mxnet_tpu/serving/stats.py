@@ -180,6 +180,7 @@ class EndpointStats:
             "submitted": 0, "completed": 0, "rejected": 0,
             "deadline_drops": 0, "cancelled": 0, "batches": 0,
             "real_rows": 0, "padded_rows": 0, "compiles": 0, "cache_hits": 0,
+            "hot_swaps": 0,
         }
         self.queue_depth = 0          # rows currently admitted and waiting
         self.queue_peak = 0

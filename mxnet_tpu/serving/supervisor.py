@@ -1,0 +1,167 @@
+"""PoolSupervisor: declare a dead/wedged serving worker and fail it over.
+
+The InferenceServer's recovery layers handle failures that *return*: a
+raising device step retries, then fails its batch and feeds the tenant's
+circuit breaker. Two failure shapes escape all of that:
+
+  - the worker (or prep) **thread dies** — an uncatchable error tears it
+    down mid-batch; the server object looks healthy but nothing dispatches
+    ever again, queues grow until every client times out;
+  - the worker **wedges** — a device call hangs forever; the Watchdog flags
+    the stall and degrades the tenant's breaker, but the batch's requests
+    and every queued request behind them are stuck regardless.
+
+The supervisor is the recovery layer for both. It watches the server's
+worker/prep threads — liveness by polling ``Thread.is_alive`` every
+``MXNET_SUPERVISOR_POLL_S``, wedges via the server's existing Watchdog
+(stall events subscribed through ``add_stall_listener``, then confirmed
+against the still-in-flight batch so a slow-but-finishing step is never
+killed) — and on either verdict drives ``InferenceServer.failover()``:
+
+  - every batch the dead generation held (prepared, mid-prep, in-flight)
+    is requeued at the FRONT of its tenant queue with original order and
+    deadlines — expired requests fail with RequestTimeoutError at
+    re-assembly, live ones simply run on the replacement worker;
+  - only the affected tenant's circuit breaker is tripped — the other
+    tenants' admission, SLOs and stats never notice;
+  - a fresh worker/prep generation starts immediately (the thread epoch
+    fences out zombies), counted in ``mxtpu_serving_failovers_total``.
+
+Deterministic drill: the ``worker_kill`` fault kind raises a
+BaseException-derived error that sails past retry and batch-failure
+handling and kills the thread itself — exactly the failure this module
+exists for::
+
+    with PoolSupervisor(server):
+        with faults.inject("worker_kill", site="serving_dispatch", times=1):
+            ...                      # supervisor restarts the worker
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..base import MXNetError
+from .. import config as _config
+from .server import InferenceServer, _RUNNING
+
+__all__ = ["PoolSupervisor"]
+
+
+class PoolSupervisor:
+    """Liveness/wedge monitor + failover driver for one InferenceServer.
+
+    Parameters
+    ----------
+    server : InferenceServer
+        The server whose worker/prep threads are supervised.
+    poll_s : float, optional
+        Liveness poll interval (default ``MXNET_SUPERVISOR_POLL_S``).
+    """
+
+    def __init__(self, server: InferenceServer, poll_s: Optional[float] = None):
+        self._server = server
+        self.poll_s = float(poll_s if poll_s is not None
+                            else _config.get("MXNET_SUPERVISOR_POLL_S"))
+        if self.poll_s <= 0:
+            raise MXNetError("poll_s must be > 0")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._stalled = None        # in-flight batch flagged by the watchdog
+        self.reports: list = []     # failover report dicts, newest last
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "PoolSupervisor":
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._server.add_stall_listener(self._on_stall)
+            self._thread = threading.Thread(
+                target=self._run, name="mxtpu-pool-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        self._server.remove_stall_listener(self._on_stall)
+        if t is not None:
+            t.join(timeout=self.poll_s * 4 + 1.0)
+
+    def __enter__(self) -> "PoolSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    # evidence intake
+    # ------------------------------------------------------------------
+    def _on_stall(self, name: str, dt: float):
+        """Watchdog listener (monitor thread; must not block): remember
+        which in-flight batch stalled — the poll loop confirms it is STILL
+        in flight before declaring the worker wedged, so a step that merely
+        ran long but finished is never failed over."""
+        srv = self._server
+        ep_name = name.partition("[")[2].rstrip("]")
+        with srv._cond:
+            pb = srv._inflight
+        if pb is not None and pb.tenant.name == ep_name:
+            with self._lock:
+                self._stalled = pb
+
+    # ------------------------------------------------------------------
+    # the verdict loop
+    # ------------------------------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self._check()
+            except Exception:
+                pass        # supervision must outlive any single bad poll
+
+    def _check(self):
+        srv = self._server
+        with srv._cond:
+            if srv._state != _RUNNING:
+                with self._lock:
+                    self._stalled = None
+                return
+            worker, prep = srv._thread, srv._prep_thread
+            inflight, preparing = srv._inflight, srv._preparing
+            pipeline = srv._pipeline
+        if worker is None:
+            return
+        with self._lock:
+            stalled = self._stalled
+        report = None
+        if not worker.is_alive():
+            name = inflight.tenant.name if inflight is not None else \
+                (preparing[0].name if preparing is not None else None)
+            report = srv.failover("worker_dead", tenant_name=name)
+        elif pipeline and prep is not None and not prep.is_alive():
+            name = preparing[0].name if preparing is not None else None
+            report = srv.failover("prep_dead", tenant_name=name)
+        elif stalled is not None:
+            if stalled is inflight:
+                report = srv.failover("worker_wedged",
+                                      tenant_name=stalled.tenant.name)
+            else:
+                with self._lock:    # the stalled step finished after all
+                    if self._stalled is stalled:
+                        self._stalled = None
+        if report is not None:
+            with self._lock:
+                self._stalled = None
+                self.reports.append(report)
+
+    @property
+    def failovers(self) -> int:
+        with self._lock:
+            return len(self.reports)

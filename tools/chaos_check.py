@@ -17,9 +17,20 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      forward — zero client-visible errors (no deadlines are set, so none are
      permitted).
 
+  3. ELASTIC SCENARIOS (``--scenario {preempt,worker_kill,hot_swap}``,
+     repeatable) — the r12 resilience drills: a preemption notice mid-run
+     force-flushes a sharded checkpoint that restores onto HALF the devices
+     (bitwise vs an in-memory-handoff oracle); a killed worker thread fails
+     over via the PoolSupervisor with every request completing or failing
+     classified and the other tenant untouched; >=3 weight hot-swaps under
+     continuous load with zero client errors plus a corrupt-checkpoint
+     rollback.
+
 Every run prints its seed; a failing seed is a deterministic repro::
 
     python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
+    python tools/chaos_check.py --seed 7 --scenario preempt \
+        --scenario worker_kill --scenario hot_swap
 
 Prints one JSON line per phase and a final summary; exit 0 iff both phases
 hold their invariant.
@@ -153,8 +164,319 @@ def check_serving(seed, requests, p, in_dim=8, hidden=16, out_dim=4):
             "circuit": health["circuit"], "ok": bitwise}
 
 
+def _build_elastic(seed, width, in_dim=8, hidden=16, out_dim=8):
+    """fsdp-sharded trainer on a ``width``-device mesh (dims divisible by 8
+    so the same net re-shards onto 8/4/1 devices)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, in_dim), "float32")))
+    for p_ in net.collect_params().values():
+        p_.shard(("fsdp",))
+    mesh = parallel.make_mesh({"fsdp": width}, devices=jax.devices()[:width])
+    step = parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=0.05), mesh,
+        data_spec=(), label_spec=())
+    return net, step
+
+
+def _gather(step):
+    import jax
+    return [onp.asarray(jax.device_get(a)) for a in step.params]
+
+
+def check_preempt(seed, steps=8, p=0.0, ckpt_dir=None, in_dim=8, out_dim=8):
+    """SCENARIO preempt: an 8-way fsdp run catches an injected preemption
+    notice mid-run, force-flushes a SHARDED checkpoint + marker within the
+    deadline, and the job resumes on a 4-way mesh (elastic restore). Final
+    gathered train state must be bitwise-equal to an oracle that continued
+    on 4-way from the same state handed over in-memory — the checkpoint
+    round-trip and re-shard add zero numeric perturbation."""
+    from mxnet_tpu.resilience import (CheckpointManager, PreemptionGuard,
+                                      faults)
+
+    rng = onp.random.RandomState(seed)
+    X = rng.randn(steps, 16, in_dim).astype("float32")
+    Y = rng.randn(steps, 16, out_dim).astype("float32")
+    preempt_at = max(2, steps // 2)
+    ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="chaos-preempt-")
+    cm = CheckpointManager(ckpt_dir, keep=2, async_save=True, fsync=False)
+
+    # the preempted run: 8-way until the notice, then rebuilt 4-way
+    net_a, step_a = _build_elastic(seed, 8, in_dim=in_dim, out_dim=out_dim)
+    guard = PreemptionGuard(cm, capture=dict(train_step=step_a),
+                            sharded=True, deadline_s=30.0)
+    stopped_at = None
+    with guard, faults.inject("preempt", at=(preempt_at,)) as inj:
+        for i in range(steps):
+            step_a(X[i], Y[i])
+            if guard.should_stop(i + 1):
+                stopped_at = i + 1
+                break
+    marker = PreemptionGuard.resume_info(cm)
+    state_at_stop = step_a.state_dict()
+    # resume on HALF the devices
+    net_b, step_b = _build_elastic(seed + 999, 4, in_dim=in_dim,
+                                   out_dim=out_dim)
+    restored = cm.restore_latest(train_step=step_b)
+    restore_ok = restored is not None and restored[0] == stopped_at
+    fidelity = all(onp.array_equal(a, b) for a, b in zip(
+        _gather(step_a), _gather(step_b)))
+    for i in range(stopped_at, steps):
+        step_b(X[i], Y[i])
+
+    # oracle: 4-way continuation from the same state, no disk involved
+    net_o, step_o = _build_elastic(seed + 777, 4, in_dim=in_dim,
+                                   out_dim=out_dim)
+    step_o.load_state_dict(state_at_stop)
+    for i in range(stopped_at, steps):
+        step_o(X[i], Y[i])
+    bitwise = all(onp.array_equal(a, b) for a, b in zip(
+        _gather(step_b), _gather(step_o)))
+
+    ok = (stopped_at == preempt_at and marker is not None and
+          marker.get("saved") and marker.get("within_deadline") and
+          restore_ok and fidelity and bitwise)
+    return {"phase": "preempt", "seed": seed, "steps": steps,
+            "preempt_at": preempt_at, "stopped_at": stopped_at,
+            "marker": marker, "faults_fired": inj.fires,
+            "restore_ok": restore_ok, "restore_bitwise_fidelity": fidelity,
+            "state_bitwise_equal": bitwise, "ok": bool(ok)}
+
+
+def check_worker_kill(seed, requests=24, p=0.0, in_dim=8, out_dim=4):
+    """SCENARIO worker_kill: a BaseException kills the serving worker thread
+    mid-stream; the PoolSupervisor declares it dead, requeues its batches
+    and restarts. Every request on the victim tenant must complete
+    bitwise-correct or fail with a classified ServingError within its
+    deadline; the OTHER tenant must see zero errors."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import RetryPolicy, faults
+
+    def mlp(s):
+        onp.random.seed(s)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    net_v, net_o = mlp(seed), mlp(seed + 1)
+    vname, oname = f"chaos_fo_{seed}", f"chaos_fo_other_{seed}"
+    ep_v = serving.ModelEndpoint(vname, net_v, input_shapes=(in_dim,),
+                                 max_batch_size=4)
+    ep_o = serving.ModelEndpoint(oname, net_o, input_shapes=(in_dim,),
+                                 max_batch_size=4)
+    srv = serving.InferenceServer(
+        batch_timeout_ms=1.0, max_queue=max(64, requests * 2),
+        retry_policy=RetryPolicy(max_attempts=4, base_ms=1.0, seed=seed))
+    srv.register(ep_v)
+    srv.register(ep_o)
+    srv.start()
+    sup = serving.PoolSupervisor(srv, poll_s=0.02).start()
+    xs = onp.random.RandomState(seed + 2).randn(
+        requests, in_dim).astype("float32")
+    victim_err, victim_unclassified, other_err = [], [], 0
+    completed = {"victim": 0, "other": 0}
+    outs = [None] * requests
+    try:
+        with faults.inject("worker_kill", site="serving_dispatch",
+                           at=(2, 5), times=2) as inj:
+            futs_v = [srv.submit(vname, xs[i], deadline_ms=60_000)
+                      for i in range(requests)]
+            futs_o = [srv.submit(oname, xs[i]) for i in range(requests)]
+            for i, f in enumerate(futs_v):
+                try:
+                    outs[i] = f.result(timeout=120).asnumpy()
+                    completed["victim"] += 1
+                except serving.ServingError as e:
+                    victim_err.append(type(e).__name__)
+                except Exception as e:      # unclassified = a real bug
+                    victim_unclassified.append(repr(e))
+            for f in futs_o:
+                try:
+                    f.result(timeout=120)
+                    completed["other"] += 1
+                except Exception:
+                    other_err += 1
+        fires = inj.fires
+    finally:
+        sup.stop()
+        srv.stop()
+        serving.unregister(vname)
+        serving.unregister(oname)
+    direct = net_v(nd.array(xs)).asnumpy()
+    bitwise = all(o is None or onp.array_equal(o, direct[i])
+                  for i, o in enumerate(outs))
+    ok = (fires >= 1 and sup.failovers >= 1 and not victim_unclassified and
+          other_err == 0 and bitwise and
+          completed["victim"] + len(victim_err) == requests)
+    return {"phase": "worker_kill", "seed": seed, "requests": requests,
+            "faults_fired": fires, "failovers": sup.failovers,
+            "completed": completed, "victim_classified_errors": victim_err,
+            "victim_unclassified_errors": victim_unclassified,
+            "other_tenant_errors": other_err,
+            "outputs_bitwise_equal": bitwise, "ok": bool(ok)}
+
+
+def check_hot_swap(seed, requests=30, p=0.0, cycles=3, in_dim=8, out_dim=4):
+    """SCENARIO hot_swap: under continuous two-tenant load, cycle the victim
+    endpoint's weights >= ``cycles`` times between two checkpointed weight
+    sets, plus one corrupt-checkpoint swap that must roll back. Zero client
+    errors, zero dropped requests; post-swap outputs bitwise-equal to a
+    fresh endpoint loaded from the same checkpoint."""
+    import shutil
+    import threading
+    import time as _time
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import CheckpointManager
+
+    def mlp(s):
+        onp.random.seed(s)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    name = f"chaos_hs_{seed}"
+    oname = f"chaos_hs_other_{seed}"
+    ep = serving.ModelEndpoint(name, mlp(seed), input_shapes=(in_dim,),
+                               max_batch_size=4)
+    ep_o = serving.ModelEndpoint(oname, mlp(seed + 5),
+                                 input_shapes=(in_dim,), max_batch_size=4)
+    # producer side: two serving checkpoints with recorded probes
+    dirs = []
+    for k in (1, 2):
+        d = tempfile.mkdtemp(prefix=f"chaos-hs-{k}-")
+        src = serving.ModelEndpoint(f"{name}_src{k}", mlp(seed + k),
+                                    input_shapes=(in_dim,), max_batch_size=4)
+        src.save_checkpoint(CheckpointManager(d, fsync=False), k,
+                            probe_seed=seed + k)
+        serving.unregister(f"{name}_src{k}")
+        dirs.append(d)
+    # a corrupt copy of checkpoint 1
+    corrupt = tempfile.mkdtemp(prefix="chaos-hs-bad-")
+    shutil.copytree(os.path.join(dirs[0], "ckpt-00000001"),
+                    os.path.join(corrupt, "ckpt-00000001"))
+    bad = os.path.join(corrupt, "ckpt-00000001", "state.npz")
+    raw = bytearray(open(bad, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(bad, "wb").write(bytes(raw))
+
+    srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                  max_queue=max(128, requests * 4))
+    srv.register(ep)
+    srv.register(ep_o)
+    srv.start()
+    xs = onp.random.RandomState(seed + 3).randn(
+        requests, in_dim).astype("float32")
+    stop_flag = threading.Event()
+    client_errors = []
+    served = {"n": 0}
+
+    def load(tenant):
+        i = 0
+        while not stop_flag.is_set():
+            try:
+                srv.predict(tenant, xs[i % requests], timeout=60)
+                served["n"] += 1
+            except Exception as e:
+                client_errors.append(repr(e))
+            i += 1
+
+    threads = [threading.Thread(target=load, args=(n,))
+               for n in (name, oname)]
+    for t in threads:
+        t.start()
+    swaps, rollback_ok = 0, False
+    try:
+        for c in range(cycles):
+            srv.hot_swap(name, dirs[c % 2], timeout=60)
+            swaps += 1
+            _time.sleep(0.02)
+        try:
+            srv.hot_swap(name, corrupt, timeout=60)
+        except serving.HotSwapError:
+            rollback_ok = True
+        epoch_after = ep.weights_epoch
+        _time.sleep(0.05)
+    finally:
+        stop_flag.set()
+        for t in threads:
+            t.join()
+        srv.stop()
+    # post-swap weights = dirs[(cycles-1) % 2]; compare to a fresh endpoint
+    # loaded from that checkpoint
+    fresh = serving.ModelEndpoint(f"{name}_fresh", mlp(seed + 9),
+                                  input_shapes=(in_dim,), max_batch_size=4)
+    fresh.hot_swap(dirs[(cycles - 1) % 2])
+    srv2 = serving.InferenceServer(batch_timeout_ms=1.0)
+    srv2.register(fresh, warmup=False)
+    srv2.register(ep, warmup=False)
+    srv2.start()
+    try:
+        want = srv2.predict(f"{name}_fresh", xs[0], timeout=60).asnumpy()
+        got = srv2.predict(name, xs[0], timeout=60).asnumpy()
+    finally:
+        srv2.stop()
+        serving.unregister(f"{name}_fresh")
+        serving.unregister(name)
+        serving.unregister(oname)
+    bitwise = onp.array_equal(got, want)
+    ok = (swaps >= cycles and rollback_ok and not client_errors and
+          bitwise and epoch_after == swaps and served["n"] > 0)
+    return {"phase": "hot_swap", "seed": seed, "swap_cycles": swaps,
+            "corrupt_swap_rolled_back": rollback_ok,
+            "requests_served": served["n"],
+            "client_errors": client_errors[:5],
+            "post_swap_bitwise_equal": bitwise,
+            "weights_epoch": epoch_after, "ok": bool(ok)}
+
+
+SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
+             "hot_swap": check_hot_swap}
+
+
 def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
-              out=sys.stdout):
+              scenarios=None, out=sys.stdout):
+    """Legacy train+serving sweep (scenarios=None), or the elastic scenario
+    matrix (scenarios=['preempt', ...])."""
+    if scenarios:
+        results = {}
+        ok = True
+        for name in scenarios:
+            if name == "preempt":
+                res = check_preempt(seed, steps=max(4, steps // 2),
+                                    ckpt_dir=ckpt_dir)
+            elif name == "worker_kill":
+                res = check_worker_kill(seed, requests=requests)
+            elif name == "hot_swap":
+                res = check_hot_swap(seed, requests=requests)
+            else:
+                raise SystemExit(f"unknown scenario {name!r}; known: "
+                                 f"{sorted(SCENARIOS)}")
+            print(json.dumps(res, default=str), file=out)
+            results[name] = res
+            ok = ok and res["ok"]
+        summary = {"phase": "summary", "seed": seed, "ok": bool(ok)}
+        print(json.dumps(summary), file=out)
+        results["ok"] = bool(ok)
+        return results
     train = check_train(seed, steps, p, ckpt_dir=ckpt_dir)
     print(json.dumps(train), file=out)
     serve = check_serving(seed, requests, p)
@@ -175,10 +497,15 @@ def main(argv=None):
     ap.add_argument("--p", type=float, default=0.3,
                     help="per-boundary fault probability")
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--scenario", action="append", default=None,
+                    choices=sorted(SCENARIOS),
+                    help="run this elastic-resilience scenario instead of "
+                         "the legacy train+serving sweep (repeatable: "
+                         "--scenario preempt --scenario hot_swap)")
     args = ap.parse_args(argv)
     result = run_chaos(seed=args.seed, steps=args.steps,
                        requests=args.requests, p=args.p,
-                       ckpt_dir=args.ckpt_dir)
+                       ckpt_dir=args.ckpt_dir, scenarios=args.scenario)
     return 0 if result["ok"] else 1
 
 
