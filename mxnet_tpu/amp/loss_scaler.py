@@ -2,8 +2,45 @@
 the all_finite op, src/operator/contrib/all_finite.cc).
 
 On TPU with bf16 the dynamic range matches fp32 so scaling is rarely needed; the
-scaler is provided for fp16 parity and for gradient-overflow detection."""
+scaler is provided for fp16 parity and for gradient-overflow detection.
+
+The overflow check is **fused and asynchronous** (ISSUE r13): one compiled
+reduction over every gradient leaf produces a single on-device finite flag —
+``launch_check_overflow`` only *launches* it, and the host reads the scalar in
+``wait_and_update``, after the device has moved on. The previous form
+(``bool(jnp.all(jnp.isfinite(g)))`` per parameter) forced a device round-trip
+per parameter per step — exactly the host-sync pattern mxlint rule TPU100
+exists to catch. A :class:`~..resilience.numerics.NumericsGuard` computes the
+same flag inside the train step itself; :meth:`observe_finite_flag` lets the
+scaler reuse it instead of launching its own reduction.
+
+Dynamic-scale state (the scale and the good-step counter) is a checkpoint
+surface: ``CheckpointManager.save(..., loss_scaler=scaler)`` captures it, so a
+crash mid-backoff resumes with the same scale instead of silently resetting
+to ``init_scale``.
+"""
 from __future__ import annotations
+
+from ..base import MXNetError
+
+_FINITE_FN = None      # lazily-built fused all-finite executable
+
+
+def _fused_all_finite(leaves):
+    """One compiled reduction: all leaves finite -> a single device bool."""
+    global _FINITE_FN
+    import jax
+    if _FINITE_FN is None:
+        import jax.numpy as jnp
+
+        def all_finite(xs):
+            flag = jnp.bool_(True)
+            for a in xs:
+                flag = jnp.logical_and(flag, jnp.all(jnp.isfinite(a)))
+            return flag
+
+        _FINITE_FN = jax.jit(all_finite)
+    return _FINITE_FN(list(leaves))
 
 
 class LossScaler:
@@ -12,25 +49,42 @@ class LossScaler:
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        self._overflow = False
+        self._pending = None       # unread on-device finite flag
 
     def launch_check_overflow(self, params):
-        """Check all grads finite; returns True if overflow detected."""
-        import jax.numpy as jnp
-        self._overflow = False
+        """Launch the fused on-device finiteness check over all grads.
+
+        Returns the on-device flag WITHOUT reading it — the deferred read
+        happens in :meth:`wait_and_update` (or :meth:`has_overflow`), by
+        which point the reduction has long finished and the fetch is a
+        scalar D2H copy instead of a per-parameter pipeline stall."""
+        leaves = []
         for p in params:
             g = p.grad() if hasattr(p, "grad") and callable(p.grad) else p
-            data = g.data if hasattr(g, "data") else g
-            if not bool(jnp.all(jnp.isfinite(data))):
-                self._overflow = True
-                break
-        return self._overflow
+            leaves.append(g.data if hasattr(g, "data") else g)
+        self._pending = _fused_all_finite(leaves) if leaves else None
+        return self._pending
+
+    def observe_finite_flag(self, flag):
+        """Adopt an already-computed on-device finite flag (the
+        NumericsGuard fuses one into the train step — no second reduction
+        needed)."""
+        self._pending = flag
+
+    def _resolve(self):
+        if self._pending is not None:
+            self._overflow = not bool(self._pending)   # the one deferred read
+            self._pending = None
 
     def wait_and_update(self):
-        """Update scale based on overflow status; returns True if step should be
-        skipped."""
+        """Resolve the pending flag and update the scale; returns True if the
+        step should be skipped."""
+        self._resolve()
         if self._overflow:
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
             self._unskipped = 0
+            self._overflow = False
             return True
         self._unskipped += 1
         if self._unskipped == self._scale_window:
@@ -39,4 +93,30 @@ class LossScaler:
         return False
 
     def has_overflow(self, params):
-        return self.launch_check_overflow(params)
+        """Synchronous convenience: launch + read in one call (still one
+        fused reduction instead of a sync per parameter)."""
+        self.launch_check_overflow(params)
+        self._resolve()
+        return self._overflow
+
+    # ------------------------------------------------------------------
+    # checkpoint surface (resilience.CheckpointManager)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """Dynamic-scale state: the current scale and the good-step counter
+        (mid-backoff position in the scale window)."""
+        return {"kind": "LossScaler", "version": 1,
+                "loss_scale": float(self.loss_scale),
+                "scale_factor": float(self._scale_factor),
+                "scale_window": int(self._scale_window),
+                "unskipped": int(self._unskipped)}
+
+    def load_state_dict(self, state):
+        if state.get("kind") != "LossScaler":
+            raise MXNetError(f"not a LossScaler state: {state.get('kind')!r}")
+        self.loss_scale = float(state["loss_scale"])
+        self._scale_factor = float(state["scale_factor"])
+        self._scale_window = int(state["scale_window"])
+        self._unskipped = int(state["unskipped"])
+        self._overflow = False
+        self._pending = None

@@ -33,7 +33,7 @@ from typing import Dict, Optional
 
 __all__ = ["AnalysisCache", "content_sha"]
 
-CACHE_VERSION = 2
+CACHE_VERSION = 3     # v3: origin dataflow learned for-loop target binding
 
 
 def content_sha(text: str) -> str:

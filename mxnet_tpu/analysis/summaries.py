@@ -266,6 +266,26 @@ def build_origin_map(fn: ast.FunctionDef,
                     omap[node.target.id] = \
                         omap.get(node.target.id, set()) | org
                     changed = True
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # iterating a traced value (or a *args-style container of
+                # traced values) binds traced elements to the loop target:
+                # `for g in grads: bool(g)` is the classic per-parameter
+                # host-sync loop (the pre-r13 LossScaler overflow check)
+                it = node.iter
+                if isinstance(it, ast.Name) and it.id in seqs:
+                    idx = space.index(it.id)
+                    org = {idx} if idx is not None else set()
+                else:
+                    org = origins_of(it, omap, seqs, space)
+                if not org:
+                    continue
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name) and \
+                            isinstance(n.ctx, ast.Store) and \
+                            n.id not in seqs and \
+                            not org <= omap.get(n.id, set()):
+                        omap[n.id] = omap.get(n.id, set()) | org
+                        changed = True
     return omap, seqs
 
 

@@ -226,6 +226,52 @@ register("MXNET_CIRCUIT_OPEN_AFTER", 6, int,
          "admissions shed with ServerOverloadError until cooldown).")
 register("MXNET_CIRCUIT_COOLDOWN_S", 5.0, float,
          "CircuitBreaker: seconds OPEN before HALF_OPEN probing begins.")
+register("MXNET_NUMERICS_CHECK_EVERY_N", 10, int,
+         "NumericsGuard: steps between boundary reads of the retained "
+         "on-device health scalars (loss / global grad norm / all-finite "
+         "flag). Detection lags by up to this many steps; the read is a "
+         "scalar D2H fetch of long-completed values, never a pipeline "
+         "stall — lower it for tighter detection, raise it for less host "
+         "chatter.")
+register("MXNET_NUMERICS_POLICY", "auto", str,
+         "NumericsGuard recovery policy: skip (rewind to the last clean "
+         "boundary snapshot and replay the window minus the offending "
+         "batch — bitwise-equal to never having trained on it) | "
+         "quarantine (skip + fingerprint/dump the batch and positionally "
+         "exclude it from the DataLoader forever) | rewind (restore the "
+         "last good checkpoint and fast-forward the loader past the "
+         "poisoned window) | auto (skip first offenders, quarantine a "
+         "fingerprint's second offense, rewind when exclusion cannot "
+         "repair the window).")
+register("MXNET_NUMERICS_SPIKE_ZSCORE", 8.0, float,
+         "NumericsGuard: EWMA z-score above which a loss/grad-norm reading "
+         "counts as a spike (one-sided; falling loss never flags).")
+register("MXNET_NUMERICS_WARMUP_STEPS", 20, int,
+         "NumericsGuard: accepted readings before the spike detector arms "
+         "(early-training loss is legitimately wild).")
+register("MXNET_NUMERICS_EWMA_ALPHA", 0.05, float,
+         "NumericsGuard: EWMA smoothing factor for the loss/grad-norm "
+         "mean/variance band.")
+register("MXNET_NUMERICS_MAX_RECOVERIES", 4, int,
+         "NumericsGuard: exclusion-replay attempts per window before the "
+         "guard gives up (raises NumericsError, or rewinds under "
+         "policy=auto with a CheckpointManager attached).")
+register("MXNET_NUMERICS_QUARANTINE_DIR", "", str,
+         "NumericsGuard: directory where quarantined batches are dumped "
+         "(npz + json fingerprint/position metadata) for postmortem; empty "
+         "disables the dump (positional exclusion still happens).")
+register("MXNET_SDC_CHECK_EVERY_N", 0, int,
+         "NumericsGuard SDC screening: steps between window re-executions "
+         "(restore snapshot, replay retained batches with their exact RNG "
+         "keys, compare parameter digests — deterministic XLA makes any "
+         "mismatch a silent-data-corruption suspect). 0 disables; the "
+         "effective cadence rounds up to a multiple of "
+         "MXNET_NUMERICS_CHECK_EVERY_N. Screening cost is one extra "
+         "window of compute per cadence.")
+register("MXNET_SDC_BUNDLE_DIR", "", str,
+         "NumericsGuard: directory where SDC repro bundles land (pre-state "
+         "+ batches + RNG keys + both digests; tools/replay_step.py "
+         "re-executes them). Empty skips bundle writing.")
 register("MXNET_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
          "InferenceServer.stop(drain=True): max seconds to wait for the "
          "drain; past it pending requests are abandoned (failed with "

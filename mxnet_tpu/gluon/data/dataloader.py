@@ -129,6 +129,11 @@ class DataLoader:
         self._pos = 0
         self._resume_pos = 0
         self._epoch_rng = None
+        # bad-batch quarantine (resilience.numerics): positional (epoch,
+        # batch index) pairs that iteration consumes from the sampler —
+        # keeping every other batch's position stable — but never yields;
+        # part of state_dict, so a restored/rewound run excludes them too
+        self._quarantined = set()
 
     def _fetch_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
@@ -184,6 +189,8 @@ class DataLoader:
             # count BEFORE yield: once the consumer holds the batch it is
             # consumed — a state_dict taken right after must not replay it
             self._pos += 1
+            if (self._epoch, self._pos - 1) in self._quarantined:
+                continue
             yield batch
         self._epoch += 1
         self._pos = 0
@@ -198,13 +205,29 @@ class DataLoader:
     # ------------------------------------------------------------------
     # checkpoint surface (resilience.CheckpointManager)
     # ------------------------------------------------------------------
+    def quarantine_batch(self, epoch: int, pos: int):
+        """Positionally exclude one batch: the batch that iteration of
+        ``epoch`` yields at 0-based index ``pos`` is consumed from the
+        sampler (so every other batch keeps its position — the rewind
+        fast-forward invariant) but never yielded again. Idempotent."""
+        self._quarantined.add((int(epoch), int(pos)))
+
+    @property
+    def quarantined(self):
+        """The positionally-excluded (epoch, batch index) pairs."""
+        return sorted(self._quarantined)
+
     def state_dict(self):
         """Snapshot the iteration position: epoch, batches consumed this
-        epoch, and the epoch-start numpy RNG state (legacy MT19937 tuple,
-        flattened to npz-friendly fields). After ``load_state_dict`` the next
-        ``iter()`` yields exactly the batches the interrupted epoch had left."""
+        epoch, the epoch-start numpy RNG state (legacy MT19937 tuple,
+        flattened to npz-friendly fields), and the quarantined batch
+        positions. After ``load_state_dict`` the next ``iter()`` yields
+        exactly the non-quarantined batches the interrupted epoch had left."""
         st = {"kind": "DataLoader", "version": 1,
               "epoch": int(self._epoch), "pos": int(self._pos)}
+        if self._quarantined:
+            st["quarantined"] = onp.asarray(sorted(self._quarantined),
+                                            dtype=onp.int64)
         if self._pos > 0 and self._epoch_rng is not None:
             name, keys, pos, has_gauss, cached = self._epoch_rng
             st.update(rng_name=str(name),
@@ -227,6 +250,9 @@ class DataLoader:
                                float(state["rng_cached"]))
         else:
             self._epoch_rng = None
+        q = state.get("quarantined")
+        self._quarantined = set() if q is None else {
+            (int(e), int(p)) for e, p in onp.asarray(q).reshape(-1, 2)}
 
     @property
     def epoch(self):

@@ -95,6 +95,10 @@ class ParallelTrainStep:
         self._step_fn = None
         self._step_n_fns: Dict[int, Callable] = {}
         self._t = 0
+        # numerics guard (resilience.numerics.NumericsGuard.attach): while
+        # attached, the compiled step also emits (grad_norm, all_finite)
+        # device scalars and every step() reports its retained inputs
+        self._guard = None
         # param_format="auto": let XLA choose the parameter/optimizer-state
         # memory layouts (AOT lower+compile with Layout.AUTO) and keep the
         # carried state in those layouts across steps — kills the per-step
@@ -161,9 +165,16 @@ class ParallelTrainStep:
         self._aux_ids_cell: List = []
 
     # ------------------------------------------------------------------
-    def _make_raw_step(self):
+    def _make_raw_step(self, with_health: bool = False):
         """The pure one-step function shared by the single-step jit and the
-        scan-based multi-step jit."""
+        scan-based multi-step jit.
+
+        ``with_health=True`` (a NumericsGuard is attached) additionally
+        returns two device scalars fused into the same XLA computation: the
+        f32 global gradient norm and an all-finite flag over the loss and
+        every gradient leaf. The update math is untouched — the health
+        outputs are extra consumers of values the step already computes, so
+        a guarded run stays bitwise-identical to an unguarded one."""
         import jax
         import jax.numpy as jnp
 
@@ -216,6 +227,19 @@ class ParallelTrainStep:
             (loss_val, aux_vals), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(list(train_params))
 
+            if with_health:
+                # one extra read of each gradient (the sum of squares the
+                # grad-norm needs anyway); finiteness falls out of it for
+                # free — any NaN/Inf in any gradient propagates into gsq,
+                # so no second isfinite pass over the gradients is needed
+                gsq = jnp.float32(0.0)
+                for g in grads:
+                    g32 = g.astype(jnp.float32)
+                    gsq = gsq + jnp.sum(g32 * g32)
+                finite = jnp.logical_and(jnp.isfinite(loss_val),
+                                         jnp.isfinite(gsq))
+                health = (jnp.sqrt(gsq), finite)
+
             new_train, new_states = [], []
             for j, i in enumerate(tidx):
                 w, g, s = train_params[j], grads[j], opt_states[j]
@@ -232,6 +256,8 @@ class ParallelTrainStep:
             for j, i in enumerate(aidx):
                 upd = pid_to_val.get(id(plist[i]))
                 new_aux.append(upd if upd is not None else aux_params[j])
+            if with_health:
+                return loss_val, new_train, new_aux, new_states, health
             return loss_val, new_train, new_aux, new_states
 
         return step
@@ -245,20 +271,22 @@ class ParallelTrainStep:
     def _build(self):
         import jax
         _faults.check("compile")
-        step = self._make_raw_step()
+        with_health = self._guard is not None
+        step = self._make_raw_step(with_health=with_health)
         t_sh, a_sh, rep = self._shardings()
         donate = (0, 1, 2) if self._donate else ()
+        out_tail = ((rep, rep),) if with_health else ()
         if self._param_format == "auto":
             self._step_fn = self._autoformat_jit(
                 step, t_sh, a_sh,
                 (self._data_sharding, self._label_sharding,
                  tuple(self._extra_shardings), rep, rep, rep, rep),
-                rep, donate)
+                rep, donate, out_tail=out_tail)
             return
         in_shardings = (t_sh, a_sh, self._state_shardings,
                         self._data_sharding, self._label_sharding,
                         tuple(self._extra_shardings), rep, rep, rep, rep)
-        out_shardings = (rep, t_sh, a_sh, self._state_shardings)
+        out_shardings = (rep, t_sh, a_sh, self._state_shardings) + out_tail
         self._step_fn = jax.jit(step, in_shardings=in_shardings,
                                 out_shardings=out_shardings,
                                 donate_argnums=donate)
@@ -323,7 +351,8 @@ class ParallelTrainStep:
         self._step_n_fns[n] = fn
         return fn
 
-    def _autoformat_jit(self, fn, t_sh, a_sh, tail_shardings, loss_sh, donate):
+    def _autoformat_jit(self, fn, t_sh, a_sh, tail_shardings, loss_sh, donate,
+                        out_tail=()):
         """AOT path for param_format='auto': compile with Layout.AUTO on the
         carried state (params/aux/opt states), re-place that state into the
         layouts XLA chose, and keep it there via donation + matching output
@@ -350,7 +379,8 @@ class ParallelTrainStep:
                       out_shardings=(loss_sh, [fmtf(s) for s in t_sh],
                                      [fmtf(s) for s in a_sh],
                                      jax.tree_util.tree_map(
-                                         fmtf, self._state_shardings)),
+                                         fmtf, self._state_shardings))
+                      + out_tail,
                       donate_argnums=donate)
         cache = self._autoformat_cache
 
@@ -429,6 +459,11 @@ class ParallelTrainStep:
         y = jax.device_put(y, self._label_sharding)
         extras = tuple(jax.device_put(e, sh)
                        for e, sh in zip(extras, self._extra_shardings))
+        injected = None
+        if self._guard is not None:
+            # the guard's input shim: consumes injected numerics faults and
+            # applies the corruption they simulate (no-op in production)
+            x, y, injected = self._guard.intercept(x, y)
         self._t += 1
         if self._optimizer.lr_scheduler is not None:
             self._optimizer.num_update = self._t
@@ -454,13 +489,23 @@ class ParallelTrainStep:
                 train, aux, self._opt_states, x, y, extras, key, lrs, wds,
                 jnp.float32(self._t))
 
-        loss, new_train, new_aux, new_states = self._retry.run(
-            attempt, site="train_step", on_retry=self._pre_retry)
+        out = self._retry.run(attempt, site="train_step",
+                              on_retry=self._pre_retry)
+        if self._guard is not None:
+            loss, new_train, new_aux, new_states, health = out
+        else:
+            loss, new_train, new_aux, new_states = out
         for j, i in enumerate(self._trainable_idx):
             self._params[i] = new_train[j]
         for j, i in enumerate(self._aux_idx):
             self._params[i] = new_aux[j]
         self._opt_states = new_states
+        if self._guard is not None:
+            # report retained DEVICE values only — the guard reads them
+            # lazily at its next boundary, never here on the hot path
+            self._guard.observe(x=x, y=y, extras=extras, key=key, lrs=lrs,
+                                wds=wds, t=self._t, loss=loss, health=health,
+                                injected=injected)
         return _mk_nd(loss)
 
     __call__ = step
@@ -497,6 +542,11 @@ class ParallelTrainStep:
     def _step_n_impl(self, xs, ys, *extras_s):
         import jax
         import jax.numpy as jnp
+        if self._guard is not None:
+            raise MXNetError(
+                "step_n() is not supported with a NumericsGuard attached: "
+                "the guard's skip/rewind recovery needs per-step batch "
+                "retention and key accounting — drive the loop with step()")
         xs = xs.data if isinstance(xs, NDArray) else jnp.asarray(xs)
         n = int(xs.shape[0])
         ys = jax.tree_util.tree_map(
@@ -578,8 +628,41 @@ class ParallelTrainStep:
         return (x, y) + extras
 
     # ------------------------------------------------------------------
-    # resilience: retry guard + checkpoint surface
+    # resilience: numerics guard + retry guard + checkpoint surface
     # ------------------------------------------------------------------
+    def _attach_numerics_guard(self, guard):
+        """Bind a resilience.numerics.NumericsGuard (use ``guard.attach``).
+        Invalidates the compiled step so the next dispatch rebuilds it with
+        the fused health outputs."""
+        self._guard = guard
+        self._step_fn = None
+        self._autoformat_cache.clear()
+
+    def replay_exact(self, x, y, extras, key, lrs, wds, t):
+        """Re-execute ONE step with explicit inputs (the retained batch, the
+        exact RNG key and schedule rows it originally consumed) and persist
+        the outputs — the SDC-screening / repro-bundle path. Unlike
+        :meth:`step` this takes no key from the global chain and does not
+        advance schedules beyond ``t``."""
+        import jax.numpy as jnp
+        if self._step_fn is None:
+            self._build()
+        train = [self._params[i] for i in self._trainable_idx]
+        aux = [self._params[i] for i in self._aux_idx]
+        out = self._step_fn(train, aux, self._opt_states, x, y,
+                            tuple(extras), key, lrs, wds, jnp.float32(t))
+        if self._guard is not None:
+            loss, new_train, new_aux, new_states, _health = out
+        else:
+            loss, new_train, new_aux, new_states = out
+        for j, i in enumerate(self._trainable_idx):
+            self._params[i] = new_train[j]
+        for j, i in enumerate(self._aux_idx):
+            self._params[i] = new_aux[j]
+        self._opt_states = new_states
+        self._t = int(t)
+        return _mk_nd(loss)
+
     def _pre_retry(self, exc, attempt, delay_s):
         """RetryPolicy hook: a retry is only sound while the carried state
         still exists — a real OOM that fired AFTER donation consumed the
@@ -697,6 +780,10 @@ class ParallelTrainStep:
         self._opt_states = new_states
         self._t = int(state["t"])
         self._autoformat_cache.pop("owner", None)
+        if self._guard is not None:
+            # retained window records predate the restored state; replaying
+            # them over it would corrupt the run — re-anchor instead
+            self._guard.reset()
 
     # ------------------------------------------------------------------
     def sync_to_block(self):

@@ -38,6 +38,16 @@ This package is that story for this stack, four composable pieces:
       exits with a resumable marker. Serving-side elasticity (weight
       hot-swap, worker failover) lives in ``mxnet_tpu.serving``.
 
+  :class:`NumericsGuard` (``numerics.py``)
+      The numerical half (r13): on-device NaN/spike detection fused into
+      the compiled train step (health scalars retained, read lazily —
+      never a sync under trace), EWMA z-score loss/grad-spike detection,
+      skip/quarantine/rewind auto-recovery whose skip path is bitwise
+      (replay from an on-device snapshot minus the offending batch),
+      bad-batch quarantine through the DataLoader's positional state, and
+      SDC screening with replayable repro bundles
+      (``tools/replay_step.py``). Runbook: RESILIENCE.md.
+
 The acceptance bar (tests/test_resilience.py): under injected device OOM
 every 3rd step plus a simulated crash + restore, a 20-step training run ends
 bitwise-equal to the uninterrupted run; serving under injected dispatch
@@ -50,6 +60,8 @@ from . import faults
 from . import sharding
 from .checkpoint import (CheckpointManager, capture_state, apply_state,
                          verify_checkpoint_dir)
+from .numerics import (NumericsGuard, NumericsError, BadBatchError,
+                       SDCSuspectError, EWMADetector, batch_fingerprint)
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy, classify_error
 from .watchdog import (CircuitBreaker, Watchdog,
@@ -58,6 +70,8 @@ from .watchdog import (CircuitBreaker, Watchdog,
 __all__ = [
     "faults", "sharding", "CheckpointManager", "capture_state", "apply_state",
     "verify_checkpoint_dir", "PreemptionGuard",
+    "NumericsGuard", "NumericsError", "BadBatchError", "SDCSuspectError",
+    "EWMADetector", "batch_fingerprint",
     "RetryPolicy", "classify_error", "CircuitBreaker", "Watchdog",
     "HEALTHY", "DEGRADED", "OPEN", "HALF_OPEN",
 ]

@@ -528,7 +528,8 @@ def verify_checkpoint_dir(path: str) -> Dict:
 # capture/apply glue: what a training checkpoint is made of
 # ---------------------------------------------------------------------------
 def capture_state(*, train_step=None, trainer=None, block=None,
-                  dataloader=None, include_rng: bool = True,
+                  dataloader=None, loss_scaler=None, numerics=None,
+                  include_rng: bool = True,
                   sharded: bool = False,
                   extra: Optional[Dict] = None) -> Dict:
     """Snapshot training state into a checkpointable tree (host numpy only —
@@ -538,7 +539,11 @@ def capture_state(*, train_step=None, trainer=None, block=None,
     params + optimizer state + step counter ``t``); ``trainer`` — a
     gluon.Trainer (optimizer slots + update counts); ``block`` — a Block
     whose parameters are saved by name; ``dataloader`` — a DataLoader
-    (epoch/position/shuffle RNG); ``include_rng`` — the global
+    (epoch/position/shuffle RNG + quarantined batch indices);
+    ``loss_scaler`` — an amp.LossScaler (dynamic scale + good-step counter,
+    so a crash mid-backoff resumes with the same scale); ``numerics`` — a
+    resilience.numerics.NumericsGuard (EWMA detector band + offense
+    ledger); ``include_rng`` — the global
     ``mxnet_tpu.random`` key chain. ``sharded=True`` captures the
     train_step's on-mesh state as per-device :class:`~.sharding.ShardedLeaf`
     shards (each host snapshots only its own devices' shards) — the save
@@ -564,6 +569,10 @@ def capture_state(*, train_step=None, trainer=None, block=None,
         }
     if dataloader is not None:
         state["dataloader"] = dataloader.state_dict()
+    if loss_scaler is not None:
+        state["loss_scaler"] = loss_scaler.state_dict()
+    if numerics is not None:
+        state["numerics"] = numerics.state_dict()
     if include_rng:
         from .. import random as _random
         state["rng"] = _random.get_state()
@@ -573,7 +582,8 @@ def capture_state(*, train_step=None, trainer=None, block=None,
 
 
 def apply_state(state: Dict, *, train_step=None, trainer=None, block=None,
-                dataloader=None, restore_rng: bool = True, **_ignored):
+                dataloader=None, loss_scaler=None, numerics=None,
+                restore_rng: bool = True, **_ignored):
     """Inverse of :func:`capture_state`: push a restored tree back into live
     objects. Missing components raise (a restore that silently skips what it
     was asked to restore is a corrupt run, not a convenience)."""
@@ -609,7 +619,20 @@ def apply_state(state: Dict, *, train_step=None, trainer=None, block=None,
     dl = _want("dataloader", dataloader)
     if dl is not None:
         dataloader.load_state_dict(dl)
+    ls = _want("loss_scaler", loss_scaler)
+    if ls is not None:
+        loss_scaler.load_state_dict(ls)
+    nm = _want("numerics", numerics)
+    if nm is not None:
+        numerics.load_state_dict(nm)
     if restore_rng and "rng" in state:
         from .. import random as _random
         _random.set_state(state["rng"])
+    guard = getattr(train_step, "_guard", None) if train_step is not None \
+        else None
+    if guard is not None:
+        # re-anchor AFTER the RNG chain restore above: the guard's snapshot
+        # captures the key-chain state, and stale retained records must
+        # never replay over restored state
+        guard.reset()
     return state

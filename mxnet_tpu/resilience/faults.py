@@ -12,6 +12,14 @@ named boundaries —
     ``serving_prep``      the host pipeline's prep stage, before concat/pad/put
     ``checkpoint_write``  CheckpointManager, between file write and fsync
     ``preemption``        PreemptionGuard's poll point, once per guarded step
+    ``numerics``          NumericsGuard's input shim, once per guarded step
+    ``sdc``               NumericsGuard's SDC re-execution, once per verify
+
+The ``numerics``/``sdc`` kinds (``nan_grad``, ``loss_spike``, ``bad_batch``,
+``sdc``) are never raised to user code: the NumericsGuard *consumes* them and
+converts them into the corruption they simulate (a NaN'd input batch, a
+scaled batch that spikes the loss, a perturbed re-execution) — the anomaly
+then flows through the real on-device detection path instead of a shortcut.
 
 — and tests scope injections with the :func:`inject` context manager::
 
@@ -46,7 +54,7 @@ __all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
 
 #: boundaries where production code calls :func:`check`
 SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
-         "checkpoint_write", "preemption")
+         "checkpoint_write", "preemption", "numerics", "sdc")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -119,6 +127,18 @@ _KINDS = {
     "worker_kill": (("serving_dispatch", "serving_prep"), False,
                     "simulated worker death: thread killed "
                     "(injected {kind} #{count} at {site})"),
+    "nan_grad": (("numerics",), False,
+                 "numerics: non-finite gradient "
+                 "(injected {kind} #{count} at {site})"),
+    "loss_spike": (("numerics",), False,
+                   "numerics: loss spike "
+                   "(injected {kind} #{count} at {site})"),
+    "bad_batch": (("numerics",), False,
+                  "numerics: poisoned input batch "
+                  "(injected {kind} #{count} at {site})"),
+    "sdc": (("sdc",), False,
+            "silent data corruption: re-executed step diverged "
+            "(injected {kind} #{count} at {site})"),
 }
 
 #: kinds that raise a dedicated exception class instead of FaultInjected

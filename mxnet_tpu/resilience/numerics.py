@@ -1,0 +1,937 @@
+"""NumericsGuard: on-device anomaly detection + skip/rewind auto-recovery.
+
+PRs 3 and 7 made this stack survive *infrastructure* failures (crashes,
+preemption, dead workers). The other production failure class is *numerical*:
+NaN/Inf gradients out of an unstable step, loss spikes from a poisoned input
+batch, and silent data corruption (SDC) from a flaky chip — the large-fleet
+failure modes TensorFlow's health-check machinery was built for (PAPERS.md,
+1605.08695). The guard's contract, in hot-path order:
+
+  1. **detection costs nothing on the hot path** — the compiled train step is
+     extended (only while a guard is attached) to also emit three device
+     scalars: the loss, the global gradient norm, and an all-finite flag
+     (derived from the norm's sum of squares, so NaN/Inf anywhere propagates
+     into it at no extra gradient pass). They are *retained*, not read: no
+     host sync is ever added under trace (mxlint TPU100 stays clean). The
+     guard double-buffers windows of ``MXNET_NUMERICS_CHECK_EVERY_N`` steps
+     and at each boundary reads only the AGED window, whose scalars are a
+     full window old — one batched D2H copy of long-completed scalars, never
+     a pipeline stall. Detection therefore lags by up to ``2 *
+     check_every_n`` steps, and recovery spans both retained windows, so
+     nothing is lost to the lag.
+  2. **an EWMA z-score detector** flags non-finite steps (``nan_grad``) and
+     statistical outliers of the loss / grad-norm series (``loss_spike`` /
+     ``grad_spike``) after a warmup.
+  3. **a policy engine** recovers:
+
+     - **skip** — restore the on-device state snapshot taken at the last
+       clean check boundary (plus the RNG key-chain snapshot), then replay
+       the retained window batches *excluding* the offending one(s). The
+       replay re-derives every update bitwise, so the run ends exactly equal
+       to a clean run trained on the same batches minus the skipped ones —
+       optimizer and data position are never lost.
+     - **quarantine** — skip, plus: fingerprint (sha256) the offending
+       batch, dump it to ``MXNET_NUMERICS_QUARANTINE_DIR`` for postmortem,
+       and exclude its positional index via ``DataLoader.quarantine_batch``
+       so rewinds/replays never serve it again.
+     - **rewind** — restore the last good checkpoint through the existing
+       :class:`~.checkpoint.CheckpointManager` and quarantine the entire
+       poisoned window so the resumed loader fast-forwards past it.
+
+  4. **SDC screening** — every ``MXNET_SDC_CHECK_EVERY_N`` steps the guard
+     re-executes the retained window from the snapshot (same batches, same
+     RNG keys, same schedules) and compares sha256 digests of the resulting
+     parameters against the live ones. XLA is deterministic, so any mismatch
+     means one of the two executions was silently corrupted:
+     ``mxtpu_sdc_suspect_total`` fires and a deterministic repro bundle
+     (pre-state + batches + keys + both digests) lands in
+     ``MXNET_SDC_BUNDLE_DIR`` for ``tools/replay_step.py`` to re-execute.
+
+Usage::
+
+    guard = NumericsGuard(check_every_n=10, policy="auto",
+                          dataloader=loader, checkpoint_manager=cm)
+    guard.attach(train_step)
+    for x, y in loader:
+        train_step(x, y)          # recovery happens inside, when needed
+    guard.finalize()              # resolve the tail window before exit
+
+The guard is single-trainer, same-thread machinery (it runs inside
+``step()``); it deliberately has no locks.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from .. import config as _config
+from .. import telemetry as _telemetry
+from . import faults as _faults
+
+__all__ = ["NumericsGuard", "NumericsError", "BadBatchError",
+           "SDCSuspectError", "EWMADetector", "batch_fingerprint"]
+
+_CHECKS = _telemetry.counter(
+    "mxtpu_numerics_checks_total",
+    "NumericsGuard boundary checks by result: clean / anomaly.",
+    labelnames=("result",))
+_ANOMALIES = _telemetry.counter(
+    "mxtpu_numerics_anomalies_total",
+    "Numerical anomalies detected, by kind: nan_grad (non-finite loss or "
+    "gradient), loss_spike / grad_spike (EWMA z-score outlier), bad_batch "
+    "(an anomaly attributed to a poisoned input batch).",
+    labelnames=("kind",))
+_RECOVERIES = _telemetry.counter(
+    "mxtpu_numerics_recoveries_total",
+    "Recovery actions executed by the policy engine: skip / quarantine / "
+    "rewind.", labelnames=("action",))
+_SKIPPED = _telemetry.counter(
+    "mxtpu_numerics_skipped_steps_total",
+    "Optimizer updates discarded by skip/quarantine recovery (the clean "
+    "run equivalent never trained on these batches).")
+_QUARANTINED = _telemetry.counter(
+    "mxtpu_numerics_quarantined_batches_total",
+    "Batches fingerprinted, dumped and positionally excluded from replays.")
+_GRAD_NORM = _telemetry.gauge(
+    "mxtpu_numerics_grad_norm",
+    "Global gradient norm at the last boundary read (lagged by up to "
+    "MXNET_NUMERICS_CHECK_EVERY_N steps; free — no extra sync).")
+_LOSS_LAST = _telemetry.gauge(
+    "mxtpu_numerics_loss",
+    "Loss at the last boundary read (lagged, free).")
+_SDC_CHECKS = _telemetry.counter(
+    "mxtpu_sdc_checks_total",
+    "SDC screening re-executions by result: match / mismatch.",
+    labelnames=("result",))
+_SDC_SUSPECT = _telemetry.counter(
+    "mxtpu_sdc_suspect_total",
+    "Window re-executions whose parameter digest diverged from the live "
+    "run — a silent-data-corruption suspect; each one writes a repro "
+    "bundle for tools/replay_step.py.")
+
+
+class NumericsError(MXNetError):
+    """A numerical anomaly the guard could not recover from (recovery budget
+    exhausted, or no snapshot/checkpoint to rewind to). **Fatal** for
+    :func:`~.retry.classify_error`: retrying a NaN step re-runs the same
+    deterministic computation and burns the retry budget for nothing."""
+
+
+class BadBatchError(NumericsError):
+    """A poisoned input batch that could not be quarantined (no DataLoader
+    position available to exclude). Fatal, never retried."""
+
+
+class SDCSuspectError(NumericsError):
+    """Raised by strict SDC screening (``sdc_raise=True``) when a window
+    re-execution diverges from the live run. Fatal, never retried."""
+
+
+# ---------------------------------------------------------------------------
+# detector
+# ---------------------------------------------------------------------------
+class EWMADetector:
+    """Exponentially-weighted mean/variance z-score spike detector for one
+    scalar series. Readings only update the statistics when *accepted* —
+    anomalous readings are excluded so one spike cannot widen the band and
+    mask the next one.
+
+    ``rel_floor`` floors the standard deviation at a fraction of the mean:
+    on a long plateau the EWMA variance collapses toward zero and ordinary
+    batch-to-batch jitter would otherwise z-score as a spike — a detector
+    that cries wolf on a converged run is worse than none. With the
+    defaults (zscore 8, rel_floor 0.1) a reading must sit at least ~80%
+    above the mean before it can ever flag."""
+
+    def __init__(self, alpha: float, zscore: float, warmup: int,
+                 rel_floor: float = 0.1):
+        self.alpha = float(alpha)
+        self.zscore = float(zscore)
+        self.warmup = int(warmup)
+        self.rel_floor = float(rel_floor)
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+
+    def is_spike(self, value: float) -> bool:
+        """True when ``value`` sits more than ``zscore`` EWMA standard
+        deviations above the mean (one-sided: falling loss is progress, not
+        an anomaly). Never flags during warmup."""
+        if not math.isfinite(value):
+            return True
+        if self.count < self.warmup:
+            return False
+        sd = max(math.sqrt(max(self.var, 0.0)),
+                 self.rel_floor * abs(self.mean), 1e-12)
+        return (value - self.mean) > self.zscore * sd
+
+    def update(self, value: float):
+        """Fold an accepted (non-anomalous) reading into the statistics."""
+        if not math.isfinite(value):
+            return
+        if self.count == 0:
+            self.mean = value
+        else:
+            d = value - self.mean
+            self.mean += self.alpha * d
+            self.var = (1.0 - self.alpha) * (self.var + self.alpha * d * d)
+        self.count += 1
+
+    def state_dict(self) -> Dict:
+        return {"mean": float(self.mean), "var": float(self.var),
+                "count": int(self.count)}
+
+    def load_state_dict(self, st: Dict):
+        self.mean = float(st["mean"])
+        self.var = float(st["var"])
+        self.count = int(st["count"])
+
+
+# ---------------------------------------------------------------------------
+# batch identity
+# ---------------------------------------------------------------------------
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def batch_fingerprint(x, y, extras=()) -> str:
+    """sha256 over the host bytes of a batch (data + labels + extras, shapes
+    included) — the content identity quarantine records and replays match
+    against."""
+    import jax
+    h = hashlib.sha256()
+    for leaf in [x] + _tree_leaves(y) + list(extras):
+        arr = onp.asarray(jax.device_get(leaf))
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _digest_arrays(arrays) -> str:
+    """sha256 over a sequence of device arrays (the update-digest used by
+    SDC screening and tools/replay_step.py — keep the two in lockstep)."""
+    import jax
+    h = hashlib.sha256()
+    for a in arrays:
+        arr = onp.asarray(jax.device_get(a))
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _serialize_key(key) -> Tuple[onp.ndarray, str, int]:
+    """(uint32 data, impl name, typed flag) for a PRNG key — mirrors
+    ``random.get_state``'s handling of typed vs raw uint32 keys."""
+    import jax
+    try:
+        typed = jax.numpy.issubdtype(key.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        typed = False
+    if typed:
+        return (onp.asarray(jax.random.key_data(key)),
+                str(jax.random.key_impl(key)), 1)
+    return onp.asarray(jax.device_get(key)), "threefry2x32", 0
+
+
+def deserialize_key(data, impl: str, typed: int):
+    """Inverse of :func:`_serialize_key` (tools/replay_step.py uses it)."""
+    import jax
+    import jax.numpy as jnp
+    arr = jnp.asarray(onp.asarray(data), dtype=jnp.uint32)
+    if int(typed):
+        return jax.random.wrap_key_data(arr, impl=str(impl))
+    return arr
+
+
+_TREE_COPY = None        # lazily-built jitted whole-tree device copy
+
+
+def _tree_copy(tree):
+    """Copy every leaf of ``tree`` into fresh device buffers with ONE
+    compiled dispatch (a leaf-by-leaf ``jnp.copy`` costs a dispatch per
+    leaf — at snapshot cadence that dominated the guard's overhead)."""
+    global _TREE_COPY
+    import jax
+    if _TREE_COPY is None:
+        import jax.numpy as jnp
+        _TREE_COPY = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t))
+    return _TREE_COPY(tree)
+
+
+class _StepRecord:
+    """Everything needed to re-derive one step bitwise: the placed device
+    batch, the RNG key it consumed, its lr/wd schedule rows, its step index,
+    plus the (unread) device health scalars it produced."""
+
+    __slots__ = ("x", "y", "extras", "key", "lrs", "wds", "t", "loss",
+                 "grad_norm", "finite", "batch_pos", "injected",
+                 "loss_v", "gnorm_v", "finite_v")
+
+    def __init__(self, *, x, y, extras, key, lrs, wds, t, loss, grad_norm,
+                 finite, batch_pos=None, injected=None):
+        self.x = x
+        self.y = y
+        self.extras = extras
+        self.key = key
+        self.lrs = lrs
+        self.wds = wds
+        self.t = int(t)
+        self.loss = loss
+        self.grad_norm = grad_norm
+        self.finite = finite
+        self.batch_pos = batch_pos
+        self.injected = injected
+        self.loss_v = None          # host values, filled at the boundary read
+        self.gnorm_v = None
+        self.finite_v = None
+
+
+class NumericsGuard:
+    """Numerical-health guard for a :class:`~..parallel.ParallelTrainStep`.
+
+    Parameters (``None`` = the ``MXNET_NUMERICS_*`` / ``MXNET_SDC_*`` knob):
+
+    check_every_n : int
+        Steps between boundary reads of the retained device health scalars.
+    policy : str
+        ``skip`` | ``quarantine`` | ``rewind`` | ``auto``. ``auto`` skips
+        first offenders, quarantines a fingerprint's second offense, and
+        rewinds when a window cannot be repaired by exclusion.
+    spike_zscore, warmup_steps, ewma_alpha : float/int/float
+        The EWMA detector's band width, warmup length and smoothing.
+    max_recoveries : int
+        Exclusion attempts per window before the guard gives up and raises
+        :class:`NumericsError` (or rewinds, under ``policy='auto'`` with a
+        checkpoint manager attached).
+    quarantine_dir : str
+        Where quarantined batches are dumped (empty = no dump, exclusion
+        still happens).
+    sdc_check_every_n : int
+        Steps between SDC re-execution screens (0 = off). Effective cadence
+        is rounded up to a multiple of ``check_every_n``.
+    sdc_bundle_dir : str
+        Where SDC repro bundles land (empty = skip writing).
+    sdc_raise : bool
+        Raise :class:`SDCSuspectError` on a digest mismatch instead of only
+        counting + bundling.
+    dataloader : DataLoader, optional
+        Supplies the positional identity (epoch, batch index) of each step's
+        batch, and receives ``quarantine_batch`` exclusions.
+    checkpoint_manager : CheckpointManager, optional
+        The rewind target.
+    repro_meta : dict, optional
+        JSON-able hints embedded in SDC bundles (model builder spec, dims)
+        so ``tools/replay_step.py`` can rebuild the step function.
+    """
+
+    def __init__(self, check_every_n: Optional[int] = None,
+                 policy: Optional[str] = None,
+                 spike_zscore: Optional[float] = None,
+                 warmup_steps: Optional[int] = None,
+                 ewma_alpha: Optional[float] = None,
+                 max_recoveries: Optional[int] = None,
+                 quarantine_dir: Optional[str] = None,
+                 sdc_check_every_n: Optional[int] = None,
+                 sdc_bundle_dir: Optional[str] = None,
+                 sdc_raise: bool = False,
+                 dataloader=None, checkpoint_manager=None,
+                 repro_meta: Optional[Dict] = None):
+        g = _config.get
+        self.check_every_n = int(check_every_n if check_every_n is not None
+                                 else g("MXNET_NUMERICS_CHECK_EVERY_N"))
+        if self.check_every_n < 1:
+            raise MXNetError("check_every_n must be >= 1")
+        self.policy = str(policy if policy is not None
+                          else g("MXNET_NUMERICS_POLICY"))
+        if self.policy not in ("skip", "quarantine", "rewind", "auto"):
+            raise MXNetError(f"unknown numerics policy {self.policy!r}; "
+                             "known: skip | quarantine | rewind | auto")
+        self.max_recoveries = int(max_recoveries if max_recoveries is not None
+                                  else g("MXNET_NUMERICS_MAX_RECOVERIES"))
+        self.quarantine_dir = str(
+            quarantine_dir if quarantine_dir is not None
+            else g("MXNET_NUMERICS_QUARANTINE_DIR"))
+        self.sdc_check_every_n = int(
+            sdc_check_every_n if sdc_check_every_n is not None
+            else g("MXNET_SDC_CHECK_EVERY_N"))
+        self.sdc_bundle_dir = str(sdc_bundle_dir if sdc_bundle_dir is not None
+                                  else g("MXNET_SDC_BUNDLE_DIR"))
+        self.sdc_raise = bool(sdc_raise)
+        za = (float(spike_zscore if spike_zscore is not None
+                    else g("MXNET_NUMERICS_SPIKE_ZSCORE")),
+              float(ewma_alpha if ewma_alpha is not None
+                    else g("MXNET_NUMERICS_EWMA_ALPHA")),
+              int(warmup_steps if warmup_steps is not None
+                  else g("MXNET_NUMERICS_WARMUP_STEPS")))
+        self.loss_detector = EWMADetector(za[1], za[0], za[2],
+                                          rel_floor=0.1)
+        # gradient norms are heavy-tailed: 2-3x excursions are routine in
+        # healthy training (especially near convergence, where the EWMA
+        # variance collapses), so the gnorm band is floored a full mean
+        # wide — with zscore 8 a reading must reach ~9x the running mean
+        # before it flags. A real blow-up clears that by orders of
+        # magnitude; healthy jitter never does.
+        self.gnorm_detector = EWMADetector(za[1], za[0], za[2],
+                                           rel_floor=1.0)
+        self.dataloader = dataloader
+        self.checkpoint_manager = checkpoint_manager
+        self.repro_meta = dict(repro_meta or {})
+        self._ts = None                      # the attached ParallelTrainStep
+        # double-buffered retention: `_window` is the current (unread)
+        # window anchored at `_snapshot`; `_prev` is the aged window
+        # anchored at `_snap_prev`, whose health scalars are at least one
+        # full window old — the boundary read of `_prev` can never stall
+        # the pipeline. Detection therefore lags by up to 2*check_every_n
+        # steps, and recovery replays across both windows.
+        self._window: List[_StepRecord] = []
+        self._prev: List[_StepRecord] = []
+        self._snapshot = None
+        self._snap_prev = None
+        self._replaying = False
+        self._steps_since_sdc = 0
+        self._offenders: Dict[str, int] = {}   # fingerprint -> offense count
+        self.last_anomaly: Optional[Dict] = None
+        self.last_sdc: Optional[Dict] = None
+        self.sdc_bundles: List[str] = []
+        self.recoveries = 0                  # lifetime recovery count
+        self.skipped_steps = 0
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+    def attach(self, train_step) -> "NumericsGuard":
+        """Bind to a ParallelTrainStep: its compiled step gains the health
+        outputs (executables are rebuilt on next dispatch) and every
+        ``step()`` reports here."""
+        if self._ts is not None and self._ts is not train_step:
+            raise MXNetError("NumericsGuard is already attached to a "
+                             "different ParallelTrainStep")
+        train_step._attach_numerics_guard(self)
+        self._ts = train_step
+        self.reset()
+        return self
+
+    def reset(self):
+        """Drop the retained windows and re-anchor the snapshot at the
+        train step's CURRENT state (called on attach and after an external
+        restore — stale records must never be replayed over restored
+        state)."""
+        self._window = []
+        self._prev = []
+        self._snap_prev = None
+        self._snapshot = self._take_snapshot()
+        self._steps_since_sdc = 0
+
+    # ------------------------------------------------------------------
+    # snapshots: on-device copies of the carried state + the RNG chain
+    # ------------------------------------------------------------------
+    def _take_snapshot(self) -> Dict:
+        from .. import random as _random
+        ts = self._ts
+        params, opt = _tree_copy((list(ts._params), list(ts._opt_states)))
+        return {
+            "params": params,
+            "opt": opt,
+            "t": int(ts._t),
+            "rng": _random.get_state(),
+            "loader_pos": self._loader_pos(),
+            "wall_time": time.time(),
+        }
+
+    def _restore_snapshot(self, snap: Dict, restore_rng: bool = True):
+        """Place COPIES of ``snap`` back into the train step (the snapshot
+        itself must survive donation by the replayed steps, so it can seed
+        several recovery attempts)."""
+        from .. import random as _random
+        ts = self._ts
+        params, opt = _tree_copy((list(snap["params"]), list(snap["opt"])))
+        ts._params = params
+        ts._opt_states = opt
+        ts._t = int(snap["t"])
+        ts._autoformat_cache.pop("owner", None)
+        if restore_rng:
+            _random.set_state(snap["rng"])
+
+    def _loader_pos(self) -> Optional[Tuple[int, int]]:
+        dl = self.dataloader
+        if dl is None:
+            return None
+        return (int(dl.epoch), int(dl._pos))
+
+    # ------------------------------------------------------------------
+    # the hot path: input shim + per-step observation
+    # ------------------------------------------------------------------
+    def intercept(self, x, y):
+        """Input shim, called by the train step after device placement and
+        before dispatch. Consumes injected ``numerics`` faults and applies
+        the corruption they simulate; returns (x, y, injected_kind).
+        Replayed steps are exempt — their retained inputs already carry
+        whatever corruption the original dispatch saw."""
+        if self._replaying:
+            return x, y, None
+        try:
+            _faults.check("numerics")
+        except _faults.FaultInjected as e:
+            if e.kind in ("nan_grad", "bad_batch"):
+                import jax.numpy as jnp
+                idx = (0,) * getattr(x, "ndim", 1)
+                x = x.at[idx].set(jnp.asarray(float("nan"), x.dtype))
+                return x, y, e.kind
+            if e.kind == "loss_spike":
+                import jax.numpy as jnp
+                x = x * jnp.asarray(64.0, x.dtype)
+                return x, y, e.kind
+            raise
+        return x, y, None
+
+    def observe(self, *, x, y, extras, key, lrs, wds, t, loss, health,
+                injected=None):
+        """Per-step report from the train step (device values only — nothing
+        here reads the device). Triggers the boundary check every
+        ``check_every_n`` observed steps."""
+        grad_norm, finite = health
+        rec = _StepRecord(x=x, y=y, extras=extras, key=key, lrs=lrs, wds=wds,
+                          t=t, loss=loss, grad_norm=grad_norm, finite=finite,
+                          batch_pos=self._current_batch_pos(),
+                          injected=injected)
+        self._window.append(rec)
+        if self._replaying:
+            return
+        if len(self._window) >= self.check_every_n:
+            self.check()
+
+    def _current_batch_pos(self) -> Optional[Tuple[int, int]]:
+        dl = self.dataloader
+        if dl is None or self._replaying:
+            return None
+        # observe() runs right after step() consumed the batch the loader
+        # just yielded: _pos is the 1-based consumed count, so the batch the
+        # step trained on sits at 0-based index _pos - 1 of this epoch
+        if dl._pos <= 0:
+            return None
+        return (int(dl.epoch), int(dl._pos) - 1)
+
+    # ------------------------------------------------------------------
+    # the boundary check
+    # ------------------------------------------------------------------
+    def _read(self, records: Sequence[_StepRecord]):
+        """Fetch retained health scalars to host — ONE batched
+        ``device_get``; this is the only place the guard touches the
+        device. On the boundary path only the AGED window is read, so the
+        scalars are at least check_every_n steps old and the fetch can
+        never stall the dispatch pipeline."""
+        import jax
+        unread = [r for r in records if r.finite_v is None]
+        if not unread:
+            return
+        vals = jax.device_get([(r.loss, r.grad_norm, r.finite)
+                               for r in unread])
+        for rec, (loss_v, gnorm_v, finite_v) in zip(unread, vals):
+            rec.loss_v = float(loss_v)
+            rec.gnorm_v = float(gnorm_v)
+            rec.finite_v = bool(finite_v)
+
+    def _scan(self, records: Sequence[_StepRecord]
+              ) -> Optional[Tuple[int, str]]:
+        """(index, kind) of the first anomalous record, or None.
+        Non-finiteness is checked first: once a step goes NaN every later
+        record is contaminated, so only the earliest one is the culprit.
+        The EWMA band is NOT advanced here — readings are folded in only
+        once a window is accepted, so the same window can be re-scanned
+        after a repair without double-counting."""
+        for i, rec in enumerate(records):
+            if not rec.finite_v:
+                return i, "nan_grad"
+            if self.loss_detector.is_spike(rec.loss_v):
+                return i, "loss_spike"
+            if self.gnorm_detector.is_spike(rec.gnorm_v):
+                return i, "grad_spike"
+        return None
+
+    def _accept(self, records: Sequence[_StepRecord]):
+        """Fold a clean window's readings into the detector band."""
+        for rec in records:
+            self.loss_detector.update(rec.loss_v)
+            self.gnorm_detector.update(rec.gnorm_v)
+
+    def check(self, force: bool = False):
+        """The boundary: verify the aged window (a zero-stall read — its
+        scalars are a full window old), then rotate the current window into
+        aged position under a fresh snapshot. ``force=True`` additionally
+        drains the just-rotated window (the pre-exit / pre-preemption-flush
+        path, where a sync read is the point)."""
+        if self._replaying:
+            return
+        if not force and len(self._window) < self.check_every_n:
+            return
+        if self._verify_aged():
+            return                  # recovered: buffers are re-anchored
+        if self._window:
+            self._snap_prev = self._snapshot
+            self._prev = self._window
+            self._window = []
+            self._snapshot = self._take_snapshot()
+        if force:
+            self._verify_aged()
+
+    def _verify_aged(self) -> bool:
+        """Read + verify ``_prev``. Returns True when a recovery ran (the
+        caller's buffers were re-anchored and rotation must not proceed)."""
+        if not self._prev:
+            return False
+        self._read(self._prev)
+        bad = self._scan(self._prev)
+        if bad is not None:
+            _CHECKS.labels("anomaly").inc()
+            self._recover(self._snap_prev,
+                          list(self._prev) + list(self._window), *bad)
+            return True
+        _CHECKS.labels("clean").inc()
+        self._accept(self._prev)
+        tail = self._prev[-1]
+        _GRAD_NORM.set(tail.gnorm_v)
+        _LOSS_LAST.set(tail.loss_v)
+        self._maybe_sdc_check(self._prev, self._snap_prev)
+        self._steps_since_sdc += len(self._prev)
+        self._prev = []
+        self._snap_prev = None
+        return False
+
+    def finalize(self):
+        """Resolve everything pending — both retained windows, partial or
+        not — so the caller can trust the train step's state. The
+        pre-checkpoint / pre-exit hook (PreemptionGuard calls this before
+        its force-flush so a preemption can never checkpoint NaN state)."""
+        self.check(force=True)
+
+    def _reanchor(self):
+        """Drop all retained records and snapshot the CURRENT live state as
+        the new good anchor (post-recovery / post-rewind)."""
+        self._window = []
+        self._prev = []
+        self._snap_prev = None
+        self._snapshot = self._take_snapshot()
+
+    # ------------------------------------------------------------------
+    # recovery
+    # ------------------------------------------------------------------
+    def _decide(self, kind: str, rec: _StepRecord) -> str:
+        if self.policy != "auto":
+            return self.policy
+        if rec.injected == "bad_batch":
+            return "quarantine"
+        fp = self._fingerprint(rec)
+        if self._offenders.get(fp, 0) >= 1:
+            return "quarantine"
+        return "skip"
+
+    def _fingerprint(self, rec: _StepRecord) -> str:
+        return batch_fingerprint(rec.x, rec.y, rec.extras)
+
+    def _recover(self, snapshot: Dict, records: List[_StepRecord],
+                 bad_idx: int, kind: str):
+        rec = records[bad_idx]
+        action = self._decide(kind, rec)
+        label = "bad_batch" if action == "quarantine" else kind
+        _ANOMALIES.labels(label).inc()
+        self.last_anomaly = {
+            "kind": label, "action": action, "t": rec.t,
+            "loss": rec.loss_v, "grad_norm": rec.gnorm_v,
+            "finite": rec.finite_v, "batch_pos": rec.batch_pos,
+            "window_index": bad_idx, "injected": rec.injected,
+        }
+        if action == "rewind":
+            self._rewind(records)
+            return
+        self._skip_and_replay(snapshot, records, {bad_idx},
+                              quarantine=(action == "quarantine"))
+
+    def _skip_and_replay(self, snapshot: Dict, records: List[_StepRecord],
+                         excluded: set, quarantine: bool):
+        """Restore the anchoring snapshot and replay the retained records
+        minus ``excluded``, re-deriving every kept update bitwise (the RNG
+        chain is restored too, so the replayed steps consume exactly the
+        keys a run that never saw the excluded batches would have). A
+        replay that surfaces a NEW first-anomaly grows the exclusion set
+        and tries again, up to ``max_recoveries`` attempts."""
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > self.max_recoveries or \
+                    len(excluded) >= len(records) + 1:
+                self._window = []
+                self._prev = []
+                self._snap_prev = None
+                if self.policy == "auto" and \
+                        self.checkpoint_manager is not None:
+                    self._rewind(records)
+                    return
+                raise NumericsError(
+                    f"numerics recovery failed: window of {len(records)} "
+                    f"steps still anomalous after excluding "
+                    f"{sorted(excluded)} ({attempts - 1} attempts); "
+                    "restore from the latest checkpoint")
+            keep = [i for i in range(len(records)) if i not in excluded]
+            self._restore_snapshot(snapshot, restore_rng=True)
+            self._window = []
+            self._replaying = True
+            try:
+                for i in keep:
+                    r = records[i]
+                    self._ts._step_impl(r.x, r.y, *r.extras)
+            finally:
+                self._replaying = False
+            replayed = self._window
+            self._read(replayed)
+            again = self._scan(replayed)
+            if again is None:
+                break
+            excluded.add(keep[again[0]])
+        # the replayed records are clean: fold them into the detector band
+        # and quarantine/count what was thrown away
+        self._accept(replayed)
+        for i in sorted(excluded):
+            bad = records[i]
+            self.skipped_steps += 1
+            _SKIPPED.inc()
+            if quarantine:
+                self._quarantine(bad)
+            else:
+                self._offenders[self._fingerprint(bad)] = \
+                    self._offenders.get(self._fingerprint(bad), 0) + 1
+        action = "quarantine" if quarantine else "skip"
+        self.recoveries += 1
+        _RECOVERIES.labels(action).inc()
+        self._steps_since_sdc += len(replayed)
+        self._reanchor()
+
+    def _quarantine(self, rec: _StepRecord):
+        import jax
+        fp = self._fingerprint(rec)
+        self._offenders[fp] = self._offenders.get(fp, 0) + 1
+        _QUARANTINED.inc()
+        if rec.batch_pos is not None and self.dataloader is not None:
+            self.dataloader.quarantine_batch(*rec.batch_pos)
+        if self.quarantine_dir:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            stamp = f"t{rec.t:08d}-{fp[:12]}"
+            payload = {"x": onp.asarray(jax.device_get(rec.x))}
+            for j, leaf in enumerate(_tree_leaves(rec.y)):
+                payload[f"y{j}"] = onp.asarray(jax.device_get(leaf))
+            for j, e in enumerate(rec.extras):
+                payload[f"e{j}"] = onp.asarray(jax.device_get(e))
+            onp.savez(os.path.join(self.quarantine_dir,
+                                   f"quarantine-{stamp}.npz"), **payload)
+            meta = {"fingerprint": fp, "t": rec.t,
+                    "batch_pos": list(rec.batch_pos)
+                    if rec.batch_pos is not None else None,
+                    "loss": rec.loss_v, "grad_norm": rec.gnorm_v,
+                    "finite": rec.finite_v, "injected": rec.injected,
+                    "wall_time": time.time()}
+            with open(os.path.join(self.quarantine_dir,
+                                   f"quarantine-{stamp}.json"), "w") as f:
+                json.dump(meta, f, sort_keys=True)
+
+    def _rewind(self, window: Sequence[_StepRecord]):
+        """Restore the last good checkpoint and fast-forward the loader past
+        the poisoned window (every retained batch position is quarantined:
+        the resumed iteration skips them)."""
+        cm = self.checkpoint_manager
+        if cm is None:
+            raise NumericsError(
+                "numerics policy 'rewind' needs a checkpoint_manager; "
+                "none is attached")
+        self._window = []
+        self._prev = []
+        self._snap_prev = None
+        kw = {"train_step": self._ts}
+        if self.dataloader is not None:
+            kw["dataloader"] = self.dataloader
+        restored = cm.restore_latest(**kw)
+        if restored is None:
+            raise NumericsError(
+                "numerics rewind found no intact checkpoint to restore")
+        if self.dataloader is not None:
+            for rec in window:
+                if rec.batch_pos is not None:
+                    self.dataloader.quarantine_batch(*rec.batch_pos)
+                    _QUARANTINED.inc()
+        self.skipped_steps += len(window)
+        for _ in window:
+            _SKIPPED.inc()
+        self.recoveries += 1
+        _RECOVERIES.labels("rewind").inc()
+        self._snapshot = self._take_snapshot()
+        self._steps_since_sdc = 0
+
+    # ------------------------------------------------------------------
+    # SDC screening
+    # ------------------------------------------------------------------
+    def _maybe_sdc_check(self, records: List[_StepRecord], start_snap: Dict):
+        if self.sdc_check_every_n <= 0:
+            return
+        if self._steps_since_sdc + len(records) < self.sdc_check_every_n:
+            return
+        self._sdc_verify(records, start_snap)
+        self._steps_since_sdc = -len(records)   # the caller adds it back
+
+    def _sdc_verify(self, records: List[_StepRecord], start_snap: Dict):
+        """Re-execute a verified window from its anchoring snapshot with the
+        exact retained keys/schedules and compare parameter digests against
+        the state the live run reached at the window's end (``_snapshot``,
+        taken when the window rotated). Deterministic XLA makes any
+        mismatch a corruption in one of the two executions."""
+        import jax.numpy as jnp
+        ts = self._ts
+        live = {"params": list(ts._params),
+                "opt": list(ts._opt_states), "t": int(ts._t)}
+        end_params = self._snapshot["params"]
+        digest_live = _digest_arrays(end_params)
+        pre_digest = _digest_arrays(start_snap["params"])
+        self._restore_snapshot(start_snap, restore_rng=False)
+        self._replaying = True
+        try:
+            for rec in records:
+                ts.replay_exact(rec.x, rec.y, rec.extras, rec.key, rec.lrs,
+                                rec.wds, rec.t)
+        finally:
+            self._replaying = False
+        replayed = list(ts._params)
+        injected = None
+        try:
+            _faults.check("sdc")
+        except _faults.FaultInjected as e:
+            if e.kind != "sdc":
+                raise
+            # simulate the flaky chip: perturb one element of the
+            # re-executed parameters before digesting
+            injected = e.kind
+            p0 = replayed[0]
+            idx = (0,) * p0.ndim
+            replayed[0] = p0.at[idx].add(jnp.asarray(1e-3, p0.dtype))
+        digest_replay = _digest_arrays(replayed)
+        # put the live state back — screening must be invisible to training
+        ts._params = live["params"]
+        ts._opt_states = live["opt"]
+        ts._t = live["t"]
+        ts._autoformat_cache.pop("owner", None)
+        match = digest_replay == digest_live
+        _SDC_CHECKS.labels("match" if match else "mismatch").inc()
+        self.last_sdc = {"match": match, "digest_live": digest_live,
+                         "digest_replay": digest_replay,
+                         "pre_digest": pre_digest,
+                         "window": len(records), "injected": injected,
+                         "t": int(self._snapshot["t"])}
+        if match:
+            return
+        _SDC_SUSPECT.inc()
+        bundle = None
+        if self.sdc_bundle_dir:
+            bundle = self._write_sdc_bundle(records, start_snap, digest_live,
+                                            digest_replay, pre_digest)
+            self.sdc_bundles.append(bundle)
+            self.last_sdc["bundle"] = bundle
+        if self.sdc_raise:
+            raise SDCSuspectError(
+                f"SDC suspect at t={self._snapshot['t']}: re-executed "
+                f"window digest {digest_replay[:12]} != live "
+                f"{digest_live[:12]}"
+                + (f"; repro bundle: {bundle}" if bundle else ""))
+
+    def _write_sdc_bundle(self, records: List[_StepRecord], snap: Dict,
+                          digest_live: str, digest_replay: str,
+                          pre_digest: str) -> str:
+        """Deterministic repro bundle: the pre-window state (as a
+        ParallelTrainStep ``state_dict`` tree), every retained batch with
+        its RNG key and schedule rows, and both digests —
+        ``tools/replay_step.py`` re-executes it and reports which execution
+        the healthy re-run agrees with."""
+        import jax
+        root = self.sdc_bundle_dir
+        os.makedirs(root, exist_ok=True)
+        name = f"sdc-t{snap['t']:08d}-{digest_live[:8]}"
+        path = os.path.join(root, name)
+        os.makedirs(path, exist_ok=True)
+        # pre-window state, in load_state_dict()-compatible form
+        state = {"t": int(snap["t"]),
+                 "n_params": len(snap["params"]),
+                 "param_names": ",".join(p.name for p in self._ts._plist)}
+        arrays = {}
+        for i, a in enumerate(snap["params"]):
+            arrays[f"p{i}"] = onp.asarray(jax.device_get(a))
+        for j, st in enumerate(snap["opt"]):
+            for k, leaf in enumerate(jax.tree_util.tree_leaves(st)):
+                arrays[f"s{j}_l{k}"] = onp.asarray(jax.device_get(leaf))
+        onp.savez(os.path.join(path, "state.npz"), **arrays)
+        recs = {}
+        rec_meta = []
+        for i, rec in enumerate(records):
+            recs[f"r{i}_x"] = onp.asarray(jax.device_get(rec.x))
+            y_leaves = _tree_leaves(rec.y)
+            for j, leaf in enumerate(y_leaves):
+                recs[f"r{i}_y{j}"] = onp.asarray(jax.device_get(leaf))
+            for j, e in enumerate(rec.extras):
+                recs[f"r{i}_e{j}"] = onp.asarray(jax.device_get(e))
+            key_data, key_impl, key_typed = _serialize_key(rec.key)
+            recs[f"r{i}_key"] = key_data
+            recs[f"r{i}_lrs"] = onp.asarray(jax.device_get(rec.lrs))
+            recs[f"r{i}_wds"] = onp.asarray(jax.device_get(rec.wds))
+            rec_meta.append({"t": rec.t, "n_y": len(y_leaves),
+                             "n_extras": len(rec.extras),
+                             "key_impl": key_impl, "key_typed": key_typed})
+        onp.savez(os.path.join(path, "records.npz"), **recs)
+        meta = {"kind": "sdc_bundle", "version": 1,
+                "t": int(snap["t"]), "n_records": len(records),
+                "records": rec_meta,
+                "digest_live": digest_live, "digest_replay": digest_replay,
+                "pre_digest": pre_digest,
+                "opt_arities": [len(_tree_leaves(st)) for st in snap["opt"]],
+                "repro": self.repro_meta, "wall_time": time.time()}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, sort_keys=True, indent=1)
+        return path
+
+    # ------------------------------------------------------------------
+    # checkpoint surface
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict:
+        """Detector band + offense ledger (JSON scalars only — the retained
+        window is deliberately NOT checkpointed: a restore re-anchors via
+        :meth:`reset`)."""
+        return {"kind": "NumericsGuard", "version": 1,
+                "loss_mean": self.loss_detector.mean,
+                "loss_var": self.loss_detector.var,
+                "loss_count": self.loss_detector.count,
+                "gnorm_mean": self.gnorm_detector.mean,
+                "gnorm_var": self.gnorm_detector.var,
+                "gnorm_count": self.gnorm_detector.count,
+                "offenders": json.dumps(self._offenders, sort_keys=True),
+                "skipped_steps": int(self.skipped_steps),
+                "recoveries": int(self.recoveries)}
+
+    def load_state_dict(self, st: Dict):
+        if st.get("kind") != "NumericsGuard":
+            raise MXNetError(f"not a NumericsGuard state: {st.get('kind')!r}")
+        self.loss_detector.load_state_dict(
+            {"mean": st["loss_mean"], "var": st["loss_var"],
+             "count": st["loss_count"]})
+        self.gnorm_detector.load_state_dict(
+            {"mean": st["gnorm_mean"], "var": st["gnorm_var"],
+             "count": st["gnorm_count"]})
+        self._offenders = {str(k): int(v) for k, v in
+                           json.loads(st["offenders"]).items()}
+        self.skipped_steps = int(st["skipped_steps"])
+        self.recoveries = int(st["recoveries"])
+        if self._ts is not None:
+            self.reset()

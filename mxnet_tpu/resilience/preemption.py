@@ -87,14 +87,19 @@ class PreemptionGuard:
         (default ``(SIGTERM,)``). Installed on ``__enter__``, previous
         handlers chained and restored on ``__exit__``; installation is
         skipped (with the poll/notify paths intact) off the main thread.
+    numerics_guard : NumericsGuard, optional
+        Finalized (pending health window read + any anomaly recovered)
+        before the force-flush, so a preemption can never checkpoint NaN or
+        spiked state — the flushed checkpoint is known-good.
     """
 
     def __init__(self, manager: CheckpointManager, capture: Optional[Dict] = None,
                  sharded: bool = False, deadline_s: Optional[float] = None,
-                 signals=(signal.SIGTERM,)):
+                 signals=(signal.SIGTERM,), numerics_guard=None):
         self.manager = manager
         self.capture = dict(capture or {})
         self.sharded = bool(sharded)
+        self.numerics_guard = numerics_guard
         self.deadline_s = float(deadline_s if deadline_s is not None
                                 else _config.get("MXNET_PREEMPT_DEADLINE_S"))
         self.signals = tuple(signals)
@@ -177,6 +182,15 @@ class PreemptionGuard:
         deadline = t0 + self.deadline_s
         cm = self.manager
         errors = []
+        # 0) resolve the numerics guard's pending window first: an anomaly
+        #    sitting unread in the retained health scalars must be recovered
+        #    (skip/rewind) BEFORE the state is flushed — a preemption that
+        #    checkpoints NaN state preserves the outage, not the run
+        if self.numerics_guard is not None:
+            try:
+                self.numerics_guard.finalize()
+            except Exception as e:
+                errors.append(f"numerics finalize: {e}")
         # 1) the in-flight async write first (it holds an OLDER step; saves
         #    land in order) — bounded so a wedged writer cannot eat the
         #    whole grace window

@@ -28,6 +28,7 @@ from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
 from .faults import FaultInjected
+from .numerics import NumericsError
 
 __all__ = ["RetryPolicy", "classify_error", "RETRYABLE_MARKERS"]
 
@@ -52,6 +53,12 @@ def classify_error(exc: BaseException) -> bool:
     """True when ``exc`` is worth retrying (transient), False when fatal."""
     if isinstance(exc, FaultInjected):
         return exc.retryable
+    if isinstance(exc, NumericsError):
+        # NumericsError / BadBatchError / SDCSuspectError: the computation
+        # is deterministic — re-running a NaN step reproduces the NaN and
+        # burns the retry budget for nothing; recovery is the NumericsGuard
+        # (skip/quarantine/rewind), never the retry loop
+        return False
     msg = str(exc)
     if any(m in msg for m in _FATAL_MARKERS):
         return False

@@ -77,6 +77,48 @@ def test_tpu100_jit_decorated_counts_as_traced():
     assert codes(lint(src)) == ["TPU100"]
 
 
+# the pre-r13 amp.LossScaler overflow check: bool(jnp.all(jnp.isfinite(g)))
+# forced a host round-trip per parameter per step. Inside a traced context
+# TPU100 fires on exactly that form — the fused on-device flag with a
+# deferred read (the r13 rewrite) is the corrected shape.
+LOSS_SCALER_LEGACY = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def check_overflow(grads):
+    overflow = False
+    for g in grads:
+        finite = jnp.all(jnp.isfinite(g))
+        if not bool(finite):
+            overflow = True
+    return overflow
+'''
+
+LOSS_SCALER_FUSED = '''
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def check_overflow(grads):
+    flag = jnp.bool_(True)
+    for g in grads:
+        flag = jnp.logical_and(flag, jnp.all(jnp.isfinite(g)))
+    return flag
+'''
+
+
+def test_tpu100_fires_on_legacy_loss_scaler_overflow_check():
+    fs = lint(LOSS_SCALER_LEGACY)
+    assert "TPU100" in codes(fs)
+    sync = [f for f in fs if f.rule == "TPU100"]
+    assert any("bool()" in f.message for f in sync)
+
+
+def test_tpu100_silent_on_fused_deferred_overflow_check():
+    assert codes(lint(LOSS_SCALER_FUSED, rules=["TPU100"])) == []
+
+
 # ---------------------------------------------------------------------------
 # TPU101 — traced-value control flow
 # ---------------------------------------------------------------------------

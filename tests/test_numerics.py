@@ -1,0 +1,676 @@
+"""NumericsGuard tests (ISSUE r13): fused on-device health telemetry, EWMA
+spike detection, skip/quarantine/rewind auto-recovery, bad-batch quarantine
+through the DataLoader, and SDC screening with replayable repro bundles.
+
+The acceptance bar: a guarded run under injected ``nan_grad``/``bad_batch``
+faults ends BITWISE equal to a clean run trained on the same batches minus
+the skipped/quarantined ones; an injected ``sdc`` mismatch produces a repro
+bundle that ``tools/replay_step.py`` re-executes to the same verdict."""
+import json
+import os
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, parallel, telemetry
+from mxnet_tpu.amp import LossScaler
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.resilience import (BadBatchError, CheckpointManager,
+                                  EWMADetector, NumericsError, NumericsGuard,
+                                  PreemptionGuard, RetryPolicy,
+                                  SDCSuspectError, classify_error, faults)
+
+TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+IN, HID, OUT, BS = 8, 16, 4, 16
+
+
+def _build(seed=0, lr=0.05):
+    import jax
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(HID, activation="relu"), nn.Dense(OUT))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, IN), "float32")))
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    step = parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=lr), mesh,
+        retry_policy=RetryPolicy(max_attempts=3, base_ms=0.5, seed=seed))
+    return net, step
+
+
+def _data(seed, steps):
+    rng = onp.random.RandomState(seed)
+    return (rng.randn(steps, BS, IN).astype("float32"),
+            rng.randn(steps, BS, OUT).astype("float32"))
+
+
+def _params(step):
+    import jax
+    return [onp.asarray(jax.device_get(a)) for a in step.params]
+
+
+def _bitwise(a, b):
+    return all(onp.array_equal(x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# detector unit tests
+# ---------------------------------------------------------------------------
+def test_ewma_detector_flags_spike_after_warmup():
+    det = EWMADetector(alpha=0.1, zscore=4.0, warmup=5)
+    for v in (1.0, 1.1, 0.9, 1.0, 1.05):
+        assert not det.is_spike(v)       # warmup: never flags
+        det.update(v)
+    assert not det.is_spike(1.1)
+    assert det.is_spike(50.0)            # way outside the band
+    assert det.is_spike(float("nan"))    # non-finite always flags
+    assert not det.is_spike(0.0)         # one-sided: falling is fine
+
+
+def test_ewma_detector_anomalies_do_not_widen_band():
+    det = EWMADetector(alpha=0.1, zscore=4.0, warmup=3)
+    for v in (1.0, 1.0, 1.0, 1.0):
+        det.update(v)
+    var_before = det.var
+    assert det.is_spike(100.0)           # detected, NOT folded in
+    assert det.var == var_before
+    assert det.is_spike(100.0)           # still detected
+
+
+def test_ewma_detector_state_roundtrip():
+    det = EWMADetector(alpha=0.1, zscore=4.0, warmup=2)
+    for v in (1.0, 2.0, 1.5):
+        det.update(v)
+    det2 = EWMADetector(alpha=0.1, zscore=4.0, warmup=2)
+    det2.load_state_dict(det.state_dict())
+    assert (det2.mean, det2.var, det2.count) == (det.mean, det.var, det.count)
+
+
+# ---------------------------------------------------------------------------
+# fused health telemetry: free on the hot path, lazy at the boundary
+# ---------------------------------------------------------------------------
+def test_guarded_run_bitwise_equal_to_unguarded():
+    steps = 9
+    X, Y = _data(0, steps)
+    net_a, step_a = _build(0)
+    for i in range(steps):
+        step_a(X[i], Y[i])
+
+    net_b, step_b = _build(0)
+    NumericsGuard(check_every_n=4, policy="skip").attach(step_b)
+    for i in range(steps):
+        step_b(X[i], Y[i])
+    assert _bitwise(_params(step_a), _params(step_b))
+
+
+def test_health_scalars_retained_not_read_between_boundaries():
+    X, Y = _data(1, 3)
+    net, step = _build(1)
+    guard = NumericsGuard(check_every_n=10, policy="skip")
+    guard.attach(step)
+    for i in range(3):
+        step(X[i], Y[i])
+    # three records pending, none host-read yet (no boundary crossed)
+    assert len(guard._window) == 3
+    assert all(r.finite_v is None for r in guard._window)
+    guard.finalize()                     # the explicit read
+    assert guard._window == [] and guard._prev == []
+
+
+def test_boundary_updates_gauges_and_counters():
+    X, Y = _data(2, 8)
+    net, step = _build(2)
+    before = telemetry.counter("mxtpu_numerics_checks_total",
+                              labelnames=("result",)).labels("clean").value
+    guard = NumericsGuard(check_every_n=4, policy="skip")
+    guard.attach(step)
+    # double-buffered verification: the first boundary only AGES the window
+    # (its scalars are too fresh to read stall-free); the second verifies it
+    for i in range(8):
+        step(X[i], Y[i])
+    after = telemetry.counter("mxtpu_numerics_checks_total",
+                              labelnames=("result",)).labels("clean").value
+    assert after == before + 1
+    assert telemetry.gauge("mxtpu_numerics_grad_norm").value > 0
+
+
+def test_step_n_rejected_with_guard_attached():
+    X, Y = _data(3, 4)
+    net, step = _build(3)
+    NumericsGuard(check_every_n=4).attach(step)
+    with pytest.raises(mx.base.MXNetError, match="step_n"):
+        step.step_n(X, Y)
+
+
+# ---------------------------------------------------------------------------
+# skip recovery: bitwise equality with the clean run that skipped the batch
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind,bad_step", [("nan_grad", 5),
+                                           ("nan_grad", 7),   # on-boundary
+                                           ("loss_spike", 6)])
+def test_skip_recovery_bitwise(kind, bad_step):
+    steps = 12
+    X, Y = _data(10, steps)
+    net_r, step_r = _build(10)
+    for i in range(steps):
+        if i == bad_step:
+            continue
+        step_r(X[i], Y[i])
+
+    net_c, step_c = _build(10)
+    guard = NumericsGuard(check_every_n=4, policy="skip", warmup_steps=4,
+                          spike_zscore=6.0)
+    guard.attach(step_c)
+    with faults.inject(kind, at=(bad_step + 1,)) as inj:
+        for i in range(steps):
+            step_c(X[i], Y[i])
+    guard.finalize()
+    assert inj.fires == 1
+    assert guard.skipped_steps == 1
+    assert _bitwise(_params(step_r), _params(step_c))
+    assert guard.last_anomaly["kind"] in (kind, "grad_spike")
+
+
+def test_skip_recovery_two_bad_steps_same_window():
+    steps = 10
+    bad = {4, 6}
+    X, Y = _data(11, steps)
+    net_r, step_r = _build(11)
+    for i in range(steps):
+        if i in bad:
+            continue
+        step_r(X[i], Y[i])
+    net_c, step_c = _build(11)
+    guard = NumericsGuard(check_every_n=5, policy="skip")
+    guard.attach(step_c)
+    with faults.inject("nan_grad", at=tuple(i + 1 for i in bad)):
+        for i in range(steps):
+            step_c(X[i], Y[i])
+    guard.finalize()
+    assert guard.skipped_steps == 2
+    assert _bitwise(_params(step_r), _params(step_c))
+
+
+def test_unrecoverable_window_raises_fatal_numerics_error():
+    X, Y = _data(12, 6)
+    net, step = _build(12)
+    guard = NumericsGuard(check_every_n=3, policy="skip", max_recoveries=2)
+    guard.attach(step)
+    with faults.inject("nan_grad", every_n=1):    # EVERY batch poisoned
+        with pytest.raises(NumericsError) as ei:
+            for i in range(6):
+                step(X[i], Y[i])
+    assert not classify_error(ei.value)           # fatal, never retried
+
+
+# ---------------------------------------------------------------------------
+# quarantine: fingerprint + dump + positional exclusion via the DataLoader
+# ---------------------------------------------------------------------------
+def test_quarantine_dumps_fingerprint_and_excludes_position(tmp_path):
+    steps, bad = 8, 5
+    rng = onp.random.RandomState(20)
+    X = rng.randn(steps * BS, IN).astype("float32")
+    Y = rng.randn(steps * BS, OUT).astype("float32")
+    qdir = str(tmp_path / "quarantine")
+
+    net, step = _build(20)
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=BS, shuffle=True)
+    guard = NumericsGuard(check_every_n=4, policy="quarantine",
+                          quarantine_dir=qdir, dataloader=loader)
+    guard.attach(step)
+    onp.random.seed(21)
+    with faults.inject("bad_batch", at=(bad + 1,)):
+        for x, y in loader:
+            step(x, y)
+    guard.finalize()
+
+    assert loader.quarantined == [(0, bad)]
+    dumps = sorted(os.listdir(qdir))
+    npz = [f for f in dumps if f.endswith(".npz")]
+    metas = [f for f in dumps if f.endswith(".json")]
+    assert len(npz) == 1 and len(metas) == 1
+    with open(os.path.join(qdir, metas[0])) as f:
+        meta = json.load(f)
+    assert meta["batch_pos"] == [0, bad]
+    assert len(meta["fingerprint"]) == 64
+    assert meta["injected"] == "bad_batch"
+    # the dumped batch IS the corrupted one the step saw (NaN poisoned)
+    with onp.load(os.path.join(qdir, npz[0])) as z:
+        assert not onp.isfinite(z["x"]).all()
+
+
+def test_quarantined_position_excluded_on_resumed_epoch():
+    n_batches = 6
+    rng = onp.random.RandomState(22)
+    X = rng.randn(n_batches * BS, IN).astype("float32")
+    loader = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    onp.random.seed(23)
+    it = iter(loader)
+    first = next(it).asnumpy()
+    loader.quarantine_batch(0, 3)
+    st = loader.state_dict()
+
+    # oracle: same seed, full epoch, drop position 3
+    oracle = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    onp.random.seed(23)
+    obatches = [b.asnumpy() for b in oracle]
+    assert onp.array_equal(first, obatches[0])
+    want = [obatches[i] for i in range(1, n_batches) if i != 3]
+
+    resumed = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    resumed.load_state_dict(st)
+    got = [b.asnumpy() for b in resumed]
+    assert len(got) == len(want)
+    assert all(onp.array_equal(a, b) for a, b in zip(got, want))
+
+
+def test_quarantine_fast_forward_across_epoch_boundary():
+    """The rewind path's exactness guarantee: resume mid-epoch with a later
+    batch quarantined — iteration yields exactly the remaining
+    non-quarantined batches, and the NEXT epoch's shuffle permutation is
+    unchanged (seeded-shuffle invariant across the boundary)."""
+    n_batches = 5
+    rng = onp.random.RandomState(24)
+    X = rng.randn(n_batches * BS, IN).astype("float32")
+
+    # oracle: two uninterrupted epochs
+    oracle = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    onp.random.seed(25)
+    e0 = [b.asnumpy() for b in oracle]
+    e1 = [b.asnumpy() for b in oracle]
+
+    loader = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    onp.random.seed(25)
+    it = iter(loader)
+    got0 = [next(it).asnumpy(), next(it).asnumpy()]
+    loader.quarantine_batch(0, 3)          # poison a not-yet-served batch
+    st = loader.state_dict()               # checkpoint at (epoch 0, pos 2)
+
+    resumed = DataLoader(ArrayDataset(X), batch_size=BS, shuffle=True)
+    resumed.load_state_dict(st)
+    rest0 = [b.asnumpy() for b in resumed]           # finish epoch 0
+    next1 = [b.asnumpy() for b in resumed]           # full epoch 1
+    want0 = [e0[i] for i in range(2, n_batches) if i != 3]
+    assert len(rest0) == len(want0)
+    assert all(onp.array_equal(a, b) for a, b in zip(rest0, want0))
+    assert all(onp.array_equal(a, b) for a, b in zip(got0, e0[:2]))
+    # epoch 1: quarantine only named (0, 3), so every batch flows, and the
+    # permutation matches the uninterrupted run's
+    assert len(next1) == n_batches
+    assert all(onp.array_equal(a, b) for a, b in zip(next1, e1))
+
+
+def test_auto_policy_quarantines_second_offense():
+    steps = 12
+    X, Y = _data(26, steps)
+    Xb = X.copy()
+    Xb[7] = Xb[1]                         # the same batch content re-offends
+    Yb = Y.copy()
+    Yb[7] = Yb[1]
+    net, step = _build(26)
+    guard = NumericsGuard(check_every_n=3, policy="auto")
+    guard.attach(step)
+    # offenses land in well-separated windows so each gets its own recovery
+    with faults.inject("nan_grad", at=(2, 8)):
+        for i in range(steps):
+            step(Xb[i], Yb[i])
+    guard.finalize()
+    assert guard.skipped_steps == 2
+    # first offense skipped, identical-content second offense quarantined
+    assert guard.last_anomaly["action"] == "quarantine"
+    q = telemetry.counter("mxtpu_numerics_quarantined_batches_total").value
+    assert q >= 1
+
+
+# ---------------------------------------------------------------------------
+# rewind: restore the last good checkpoint, fast-forward past the window
+# ---------------------------------------------------------------------------
+def test_rewind_restores_checkpoint_and_quarantines_window(tmp_path):
+    steps = 10
+    rng = onp.random.RandomState(30)
+    X = rng.randn(steps * BS, IN).astype("float32")
+    Y = rng.randn(steps * BS, OUT).astype("float32")
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+
+    net, step = _build(30)
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=BS, shuffle=False)
+    guard = NumericsGuard(check_every_n=3, policy="rewind",
+                          checkpoint_manager=cm, dataloader=loader)
+    guard.attach(step)
+    onp.random.seed(31)
+    consumed = []
+    with faults.inject("nan_grad", at=(5,)):
+        for i, (x, y) in enumerate(loader):
+            consumed.append(i)
+            step(x, y)
+            if i == 2:                     # good checkpoint after 3 steps
+                guard.finalize()           # clean boundary first
+                cm.save(3, train_step=step, dataloader=loader)
+            if i >= steps - 1:
+                break
+    guard.finalize()
+    assert guard.recoveries == 1
+    assert guard.last_anomaly["action"] == "rewind"
+    # restored to step 3 and the poisoned window's positions are excluded
+    assert step._t >= 3
+    assert (0, 4) in loader._quarantined   # the NaN'd batch's position
+    restored = cm.restore_latest()
+    assert restored is not None and restored[0] == 3
+
+
+def test_rewind_without_checkpoint_manager_raises():
+    X, Y = _data(32, 4)
+    net, step = _build(32)
+    guard = NumericsGuard(check_every_n=2, policy="rewind")
+    guard.attach(step)
+    with faults.inject("nan_grad", at=(1,)):
+        with pytest.raises(NumericsError, match="checkpoint_manager"):
+            for i in range(4):
+                step(X[i], Y[i])
+
+
+# ---------------------------------------------------------------------------
+# SDC screening
+# ---------------------------------------------------------------------------
+def test_sdc_clean_screen_counts_match_and_is_invisible():
+    steps = 8
+    X, Y = _data(40, steps)
+    net_r, step_r = _build(40)
+    for i in range(steps):
+        step_r(X[i], Y[i])
+
+    before = telemetry.counter("mxtpu_sdc_checks_total",
+                              labelnames=("result",)).labels("match").value
+    net_c, step_c = _build(40)
+    guard = NumericsGuard(check_every_n=4, policy="skip",
+                          sdc_check_every_n=8)
+    guard.attach(step_c)
+    for i in range(steps):
+        step_c(X[i], Y[i])
+    guard.finalize()
+    after = telemetry.counter("mxtpu_sdc_checks_total",
+                      labelnames=("result",)).labels("match").value
+    assert after == before + 1
+    assert guard.last_sdc["match"]
+    assert _bitwise(_params(step_r), _params(step_c))
+
+
+def test_sdc_mismatch_writes_replayable_bundle(tmp_path):
+    sys.path.insert(0, TOOLS)
+    import replay_step
+
+    steps = 8
+    X, Y = _data(41, steps)
+    net, step = _build(41)
+    guard = NumericsGuard(
+        check_every_n=4, policy="skip", sdc_check_every_n=8,
+        sdc_bundle_dir=str(tmp_path),
+        repro_meta=dict(builder="demo_mlp", seed=41, in_dim=IN, hidden=HID,
+                        out_dim=OUT, lr=0.05))
+    guard.attach(step)
+    before = telemetry.counter("mxtpu_sdc_suspect_total").value
+    with faults.inject("sdc", at=(1,)):
+        for i in range(steps):
+            step(X[i], Y[i])
+        guard.finalize()
+    assert telemetry.counter("mxtpu_sdc_suspect_total").value == before + 1
+    assert len(guard.sdc_bundles) == 1
+    bundle = guard.sdc_bundles[0]
+    assert sorted(os.listdir(bundle)) == ["meta.json", "records.npz",
+                                          "state.npz"]
+    # the tool re-executes to the same verdict, deterministically
+    r1 = replay_step.replay(bundle)
+    r2 = replay_step.replay(bundle)
+    assert r1["verdict"] == "replay_corrupt"     # the screen was perturbed
+    assert r1 == r2
+    assert r1["pre_digest_ok"]
+
+
+def test_sdc_raise_mode_is_fatal():
+    steps = 8
+    X, Y = _data(42, steps)
+    net, step = _build(42)
+    guard = NumericsGuard(check_every_n=4, policy="skip",
+                          sdc_check_every_n=8, sdc_raise=True)
+    guard.attach(step)
+    with faults.inject("sdc", at=(1,)):
+        with pytest.raises(SDCSuspectError) as ei:
+            for i in range(steps):
+                step(X[i], Y[i])
+            guard.finalize()
+    assert not classify_error(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# retry classification (satellite): anomalies are fatal, never retried
+# ---------------------------------------------------------------------------
+def test_numerics_errors_classify_fatal():
+    assert not classify_error(NumericsError("nan step"))
+    assert not classify_error(BadBatchError("poisoned"))
+    assert not classify_error(SDCSuspectError("digest diverged"))
+    # sanity: the transient marker path is untouched
+    assert classify_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+
+
+def test_retry_policy_does_not_burn_attempts_on_numerics_errors():
+    calls = {"n": 0}
+
+    def nan_step():
+        calls["n"] += 1
+        raise NumericsError("non-finite gradient at t=7")
+
+    pol = RetryPolicy(max_attempts=5, base_ms=1.0, sleep=lambda s: None)
+    with pytest.raises(NumericsError):
+        pol.run(nan_step, site="train_step")
+    assert calls["n"] == 1               # fatal: exactly one attempt
+
+
+def test_injected_numerics_kinds_are_fatal_if_unconsumed():
+    # outside a guard, the numerics kinds classify fatal (retryable=False)
+    with faults.inject("nan_grad", every_n=1):
+        with pytest.raises(faults.FaultInjected) as ei:
+            faults.check("numerics")
+    assert not ei.value.retryable
+    assert not classify_error(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integration
+# ---------------------------------------------------------------------------
+def test_guard_state_roundtrips_through_checkpoint(tmp_path):
+    steps = 6
+    X, Y = _data(50, steps)
+    net, step = _build(50)
+    guard = NumericsGuard(check_every_n=3, policy="skip")
+    guard.attach(step)
+    with faults.inject("nan_grad", at=(2,)):
+        for i in range(steps):
+            step(X[i], Y[i])
+    guard.finalize()
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    cm.save(steps, train_step=step, numerics=guard)
+
+    net2, step2 = _build(51)
+    guard2 = NumericsGuard(check_every_n=3, policy="skip")
+    guard2.attach(step2)
+    restored = cm.restore_latest(train_step=step2, numerics=guard2)
+    assert restored is not None
+    assert guard2.skipped_steps == guard.skipped_steps
+    assert guard2.loss_detector.count == guard.loss_detector.count
+    assert guard2.loss_detector.mean == guard.loss_detector.mean
+    # the restore re-anchored the window (stale records never replay)
+    assert guard2._window == []
+    assert guard2._snapshot["t"] == step2._t
+
+
+def test_crash_restore_with_guard_attached_stays_bitwise(tmp_path):
+    steps, crash_at = 10, 5
+    X, Y = _data(52, steps)
+    net_r, step_r = _build(52)
+    for i in range(steps):
+        step_r(X[i], Y[i])
+
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    net_c, step_c = _build(52)
+    guard = NumericsGuard(check_every_n=5, policy="skip")
+    guard.attach(step_c)
+    for i in range(crash_at):
+        step_c(X[i], Y[i])
+    guard.finalize()                     # clean boundary before the save
+    cm.save(crash_at, train_step=step_c)
+    del net_c, step_c
+    net_c, step_c = _build(999)          # different init: must be restored
+    guard = NumericsGuard(check_every_n=5, policy="skip")
+    guard.attach(step_c)
+    assert cm.restore_latest(train_step=step_c) is not None
+    for i in range(crash_at, steps):
+        step_c(X[i], Y[i])
+    guard.finalize()
+    assert _bitwise(_params(step_r), _params(step_c))
+
+
+def test_preemption_flush_finalizes_guard_first(tmp_path):
+    """A preemption arriving with an unread NaN in the retained window must
+    flush the RECOVERED state — never checkpoint NaN."""
+    steps, bad, preempt_at = 8, 4, 6
+    X, Y = _data(53, steps)
+    # oracle: clean run skipping the bad batch, stopped at the preempt step
+    net_r, step_r = _build(53)
+    for i in range(preempt_at):
+        if i == bad:
+            continue
+        step_r(X[i], Y[i])
+
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    net_c, step_c = _build(53)
+    guard = NumericsGuard(check_every_n=10, policy="skip")  # no boundary yet
+    guard.attach(step_c)
+    pguard = PreemptionGuard(cm, capture=dict(train_step=step_c),
+                             numerics_guard=guard, deadline_s=30.0)
+    with pguard, faults.inject("nan_grad", at=(bad + 1,)), \
+            faults.inject("preempt", at=(preempt_at,)):
+        for i in range(steps):
+            step_c(X[i], Y[i])
+            if pguard.should_stop(i + 1):
+                break
+    assert pguard.last_flush["saved"]
+    net_n, step_n = _build(54)
+    restored = cm.restore_latest(train_step=step_n)
+    assert restored is not None
+    assert _bitwise(_params(step_r), _params(step_n))
+
+
+def test_loss_scaler_captured_by_checkpoint_manager(tmp_path):
+    ls = LossScaler(init_scale=2.0 ** 10, scale_factor=2.0, scale_window=4)
+    bad = nd.array(onp.array([[1.0, float("inf")]], "float32"))
+    good = nd.array(onp.ones((2, 2), "float32"))
+    ls.launch_check_overflow([bad])
+    assert ls.wait_and_update()                  # overflow: backoff
+    assert ls.loss_scale == 2.0 ** 9
+    for _ in range(2):
+        ls.launch_check_overflow([good])
+        assert not ls.wait_and_update()
+    assert ls._unskipped == 2                    # mid-backoff position
+
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    cm.save(1, loss_scaler=ls)
+    ls2 = LossScaler()
+    assert cm.restore_latest(loss_scaler=ls2) is not None
+    assert ls2.loss_scale == ls.loss_scale
+    assert ls2._unskipped == 2
+    # resuming the window hits the growth step at the same point as the
+    # uninterrupted scaler
+    for scaler in (ls, ls2):
+        for _ in range(2):
+            scaler.launch_check_overflow([good])
+            scaler.wait_and_update()
+    assert ls2.loss_scale == ls.loss_scale == 2.0 ** 10
+
+
+def test_loss_scaler_sharded_checkpoint_roundtrip(tmp_path):
+    net, step = _build(60)
+    X, Y = _data(60, 2)
+    step(X[0], Y[0])
+    ls = LossScaler(init_scale=2.0 ** 8, scale_window=7)
+    ls._unskipped = 3
+    cm = CheckpointManager(str(tmp_path), fsync=False)
+    cm.save(1, train_step=step, loss_scaler=ls, sharded=True)
+    ls2 = LossScaler()
+    net2, step2 = _build(61)
+    assert cm.restore_latest(train_step=step2, loss_scaler=ls2) is not None
+    assert ls2.loss_scale == 2.0 ** 8 and ls2._unskipped == 3
+
+
+# ---------------------------------------------------------------------------
+# loss scaler (satellite): fused, deferred, no per-step host sync
+# ---------------------------------------------------------------------------
+def test_loss_scaler_launch_is_deferred():
+    ls = LossScaler()
+    flag = ls.launch_check_overflow(
+        [nd.array(onp.ones((4, 4), "float32"))])
+    assert flag is not None
+    assert ls._pending is not None               # unread device scalar
+    assert not ls.wait_and_update()              # the deferred read
+    assert ls._pending is None
+
+
+def test_loss_scaler_overflow_backoff_and_recovery_window():
+    ls = LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=2)
+    bad = nd.array(onp.array([float("nan")], "float32"))
+    good = nd.array(onp.ones((2,), "float32"))
+    ls.launch_check_overflow([good, bad])
+    assert ls.wait_and_update() and ls.loss_scale == 4.0
+    ls.launch_check_overflow([good])
+    assert not ls.wait_and_update()
+    ls.launch_check_overflow([good])
+    assert not ls.wait_and_update()
+    assert ls.loss_scale == 8.0                  # window elapsed: regrow
+    assert ls.has_overflow([bad])                # sync convenience intact
+    assert not ls.has_overflow([good])
+
+
+def test_loss_scaler_adopts_guard_finite_flag():
+    import jax.numpy as jnp
+    ls = LossScaler(init_scale=4.0)
+    ls.observe_finite_flag(jnp.asarray(False))
+    assert ls.wait_and_update() and ls.loss_scale == 2.0
+    ls.observe_finite_flag(jnp.asarray(True))
+    assert not ls.wait_and_update()
+
+
+# ---------------------------------------------------------------------------
+# metric registration + chaos smoke (the tier-1 acceptance drill)
+# ---------------------------------------------------------------------------
+def test_numerics_metrics_registered():
+    snap = telemetry.snapshot()["metrics"]
+    for name in ("mxtpu_numerics_checks_total",
+                 "mxtpu_numerics_anomalies_total",
+                 "mxtpu_numerics_recoveries_total",
+                 "mxtpu_numerics_skipped_steps_total",
+                 "mxtpu_numerics_quarantined_batches_total",
+                 "mxtpu_numerics_grad_norm", "mxtpu_numerics_loss",
+                 "mxtpu_sdc_checks_total", "mxtpu_sdc_suspect_total"):
+        assert name in snap, name
+
+
+def test_chaos_numerics_smoke(tmp_path):
+    import io
+    sys.path.insert(0, TOOLS)
+    import chaos_check
+    buf = io.StringIO()
+    result = chaos_check.run_chaos(
+        seed=13, steps=30, scenarios=["nan_grad", "bad_batch", "sdc"],
+        out=buf)
+    assert result["ok"], buf.getvalue()
+    assert result["nan_grad"]["weights_bitwise_equal"]
+    assert result["nan_grad"]["skipped_steps"] == 2
+    assert result["bad_batch"]["weights_bitwise_equal"]
+    assert result["bad_batch"]["quarantine_dumps"] >= 2
+    assert result["sdc"]["replay_verdicts"] == ["replay_corrupt"] * 2
+    assert result["sdc"]["live_run_unperturbed"]

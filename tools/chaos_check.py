@@ -26,11 +26,24 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      continuous load with zero client errors plus a corrupt-checkpoint
      rollback.
 
+  4. NUMERICS SCENARIOS (``--scenario {nan_grad,bad_batch,sdc}``) — the r13
+     NumericsGuard drills: a 30-step run with injected NaN gradients must
+     end BITWISE equal to a clean run trained on the same batches minus the
+     skipped ones (detection is lagged — the guard reads its fused
+     on-device health scalars only every check_every_n steps — yet
+     skip-recovery re-derives every kept update exactly); a poisoned batch
+     served by a real DataLoader is quarantined (fingerprinted, dumped,
+     positionally excluded so replays never see it again) with the same
+     bitwise bar; an injected SDC digest mismatch must write a repro bundle
+     that tools/replay_step.py re-executes to the same verdict, twice.
+
 Every run prints its seed; a failing seed is a deterministic repro::
 
     python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
     python tools/chaos_check.py --seed 7 --scenario preempt \
         --scenario worker_kill --scenario hot_swap
+    python tools/chaos_check.py --scenario nan_grad --scenario bad_batch \
+        --scenario sdc
 
 Prints one JSON line per phase and a final summary; exit 0 iff both phases
 hold their invariant.
@@ -448,8 +461,172 @@ def check_hot_swap(seed, requests=30, p=0.0, cycles=3, in_dim=8, out_dim=4):
             "weights_epoch": epoch_after, "ok": bool(ok)}
 
 
+def check_nan_grad(seed, steps=30, p=0.0, in_dim=8, hidden=16, out_dim=4):
+    """SCENARIO nan_grad: NaN gradients injected mid-window; the guard's
+    lagged boundary read finds them, rewinds to its on-device snapshot and
+    replays the window minus the poisoned batches. The run must end BITWISE
+    equal to a clean run trained on the same batches minus the skipped
+    ones, and the guard must report exactly those skips."""
+    from mxnet_tpu.resilience import NumericsGuard, faults
+
+    rng = onp.random.RandomState(seed)
+    X = rng.randn(steps, 16, in_dim).astype("float32")
+    Y = rng.randn(steps, 16, out_dim).astype("float32")
+    # two poisoned steps, one mid-window and one right on a boundary
+    bad = sorted({max(2, steps // 4), max(3, (2 * steps) // 3)})
+
+    # clean reference: never trains on the poisoned batches
+    net_r, step_r = _build_train(seed, in_dim, hidden, out_dim)
+    for i in range(steps):
+        if i in bad:
+            continue
+        step_r(X[i], Y[i])
+    step_r.sync_to_block()
+    ref_w = [p_.data().asnumpy() for p_ in net_r.collect_params().values()]
+
+    # guarded chaos: injection corrupts the very same step indices
+    net_c, step_c = _build_train(seed, in_dim, hidden, out_dim)
+    guard = NumericsGuard(check_every_n=5, policy="skip")
+    guard.attach(step_c)
+    with faults.inject("nan_grad", at=tuple(i + 1 for i in bad)) as inj:
+        for i in range(steps):
+            step_c(X[i], Y[i])
+    guard.finalize()
+    step_c.sync_to_block()
+    chaos_w = [p_.data().asnumpy() for p_ in net_c.collect_params().values()]
+
+    w_ok = all(onp.array_equal(a, b) for a, b in zip(ref_w, chaos_w))
+    ok = (w_ok and inj.fires == len(bad) and
+          guard.skipped_steps == len(bad) and guard.recoveries >= 1)
+    return {"phase": "nan_grad", "seed": seed, "steps": steps,
+            "poisoned_steps": bad, "faults_fired": inj.fires,
+            "skipped_steps": guard.skipped_steps,
+            "recoveries": guard.recoveries,
+            "last_anomaly": guard.last_anomaly,
+            "weights_bitwise_equal": w_ok, "ok": bool(ok)}
+
+
+def check_bad_batch(seed, steps=30, p=0.0, in_dim=8, hidden=16, out_dim=4,
+                    quarantine_dir=None):
+    """SCENARIO bad_batch: a poisoned batch served by a real (seeded,
+    shuffling) DataLoader is quarantined — fingerprinted, dumped to the
+    quarantine dir, and positionally excluded so a resumed/rewound loader
+    never serves it again. Training must end bitwise-equal to a clean run
+    that skipped the same batch positions."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.resilience import NumericsGuard, faults
+
+    rng = onp.random.RandomState(seed)
+    n, bs = steps * 16, 16
+    X = rng.randn(n, in_dim).astype("float32")
+    Y = rng.randn(n, out_dim).astype("float32")
+    bad = sorted({max(1, steps // 3), max(2, steps // 2)})
+    quarantine_dir = quarantine_dir or tempfile.mkdtemp(prefix="chaos-quar-")
+
+    def run(poisoned):
+        net, step = _build_train(seed, in_dim, hidden, out_dim)
+        loader = DataLoader(ArrayDataset(X, Y), batch_size=bs, shuffle=True)
+        guard = None
+        if poisoned:
+            guard = NumericsGuard(check_every_n=5, policy="quarantine",
+                                  quarantine_dir=quarantine_dir,
+                                  dataloader=loader)
+            guard.attach(step)
+        onp.random.seed(seed + 77)          # epoch shuffle permutation
+        if poisoned:
+            with faults.inject("bad_batch",
+                               at=tuple(i + 1 for i in bad)) as inj:
+                for x, y in loader:
+                    step(x, y)
+            guard.finalize()
+        else:
+            inj = None
+            for i, (x, y) in enumerate(loader):
+                if i in bad:
+                    continue
+                step(x, y)
+        step.sync_to_block()
+        w = [p_.data().asnumpy() for p_ in net.collect_params().values()]
+        return w, guard, loader, inj
+
+    ref_w, _, _, _ = run(poisoned=False)
+    chaos_w, guard, loader, inj = run(poisoned=True)
+
+    w_ok = all(onp.array_equal(a, b) for a, b in zip(ref_w, chaos_w))
+    quarantined = loader.quarantined
+    dumps = sorted(f for f in os.listdir(quarantine_dir)
+                   if f.endswith(".npz"))
+    # the excluded positions must survive a state_dict round-trip (the
+    # rewind/replay exclusion guarantee)
+    st = loader.state_dict()
+    loader2 = DataLoader(ArrayDataset(X, Y), batch_size=bs, shuffle=True)
+    loader2.load_state_dict(st)
+    ok = (w_ok and inj.fires == len(bad) and
+          quarantined == [(0, i) for i in bad] and
+          len(dumps) >= len(bad) and
+          loader2.quarantined == quarantined)
+    return {"phase": "bad_batch", "seed": seed, "steps": steps,
+            "poisoned_positions": bad, "faults_fired": inj.fires,
+            "quarantined": quarantined, "quarantine_dumps": len(dumps),
+            "roundtrip_quarantine_ok": loader2.quarantined == quarantined,
+            "weights_bitwise_equal": w_ok, "ok": bool(ok)}
+
+
+def check_sdc(seed, steps=20, p=0.0, bundle_dir=None, in_dim=8, hidden=16,
+              out_dim=4):
+    """SCENARIO sdc: an injected digest divergence in the guard's window
+    re-execution must (a) leave the live run untouched, (b) fire the
+    suspect counter and write a repro bundle, and (c) have
+    tools/replay_step.py re-execute that bundle to the same deterministic
+    verdict — twice."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import replay_step
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.resilience import NumericsGuard, faults
+
+    rng = onp.random.RandomState(seed)
+    X = rng.randn(steps, 16, in_dim).astype("float32")
+    Y = rng.randn(steps, 16, out_dim).astype("float32")
+    bundle_dir = bundle_dir or tempfile.mkdtemp(prefix="chaos-sdc-")
+
+    net_c, step_c = _build_train(seed, in_dim, hidden, out_dim)
+    guard = NumericsGuard(
+        check_every_n=5, policy="skip", sdc_check_every_n=10,
+        sdc_bundle_dir=bundle_dir,
+        repro_meta=dict(builder="demo_mlp", seed=seed, in_dim=in_dim,
+                        hidden=hidden, out_dim=out_dim, lr=0.05))
+    guard.attach(step_c)
+    before = telemetry.counter("mxtpu_sdc_suspect_total").value
+    with faults.inject("sdc", at=(1,)) as inj:
+        for i in range(steps):
+            step_c(X[i], Y[i])
+    guard.finalize()
+    suspects = telemetry.counter("mxtpu_sdc_suspect_total").value - before
+
+    # the screen must be invisible to training: bitwise vs a plain run
+    net_r, step_r = _build_train(seed, in_dim, hidden, out_dim)
+    for i in range(steps):
+        step_r(X[i], Y[i])
+    live_ok = all(
+        onp.array_equal(onp.asarray(a), onp.asarray(b))
+        for a, b in zip(_gather(step_c), _gather(step_r)))
+
+    bundles = guard.sdc_bundles
+    verdicts = []
+    if bundles:
+        verdicts = [replay_step.replay(bundles[0])["verdict"]
+                    for _ in range(2)]
+    ok = (inj.fires == 1 and suspects == 1 and live_ok and
+          len(bundles) == 1 and verdicts == ["replay_corrupt"] * 2)
+    return {"phase": "sdc", "seed": seed, "steps": steps,
+            "faults_fired": inj.fires, "sdc_suspects": int(suspects),
+            "live_run_unperturbed": live_ok, "bundles": bundles,
+            "replay_verdicts": verdicts, "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
-             "hot_swap": check_hot_swap}
+             "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
+             "bad_batch": check_bad_batch, "sdc": check_sdc}
 
 
 def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
@@ -467,6 +644,12 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
                 res = check_worker_kill(seed, requests=requests)
             elif name == "hot_swap":
                 res = check_hot_swap(seed, requests=requests)
+            elif name == "nan_grad":
+                res = check_nan_grad(seed, steps=max(10, steps))
+            elif name == "bad_batch":
+                res = check_bad_batch(seed, steps=max(10, steps))
+            elif name == "sdc":
+                res = check_sdc(seed, steps=max(10, steps))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

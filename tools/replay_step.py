@@ -1,0 +1,159 @@
+"""Replay an SDC repro bundle: deterministic postmortem for a flaky chip.
+
+When the NumericsGuard's SDC screen finds a window whose re-execution digest
+diverges from the live run, it writes a bundle (``MXNET_SDC_BUNDLE_DIR``)
+holding everything a re-execution needs: the pre-window ParallelTrainStep
+state, every retained batch with the exact RNG key and lr/wd schedule rows it
+consumed, and the two conflicting digests. XLA is deterministic, so a healthy
+machine re-running the bundle must land exactly on ONE of them — telling you
+which execution was corrupted::
+
+    python tools/replay_step.py /path/to/sdc-t00000040-ab12cd34 [--builder m:f]
+
+Verdicts (the JSON ``verdict`` field):
+
+  ``live_corrupt``    re-run matches the screening re-execution's digest: the
+                      LIVE training pass was silently corrupted — the params
+                      the run continued with are suspect; rewind to the last
+                      checkpoint before the bundle's step.
+  ``replay_corrupt``  re-run matches the live digest: the screening
+                      *re-execution* hit the corruption (transient flip);
+                      the training state itself is fine.
+  ``no_reproduction`` re-run matches neither digest: the replay environment
+                      differs from the original (other jax version, dtype
+                      flags, topology) — fix the environment before drawing
+                      conclusions.
+
+The step function is rebuilt from ``--builder module:function`` — a callable
+``builder(meta) -> ParallelTrainStep`` — or, when the bundle's ``repro``
+metadata carries ``builder: demo_mlp`` dims (what tools/chaos_check.py
+embeds), from the built-in MLP builder. Exit code 0 iff the re-run reproduces
+one of the recorded digests (deterministically attributable).
+"""
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def demo_mlp_builder(meta):
+    """Rebuild the standard chaos-harness MLP train step from the bundle's
+    ``repro`` dims (what check_sdc embeds)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    r = meta.get("repro", {})
+    in_dim = int(r.get("in_dim", 8))
+    hidden = int(r.get("hidden", 16))
+    out_dim = int(r.get("out_dim", 4))
+    lr = float(r.get("lr", 0.05))
+    onp.random.seed(int(r.get("seed", 0)))
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, in_dim), "float32")))
+    mesh = parallel.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    return parallel.ParallelTrainStep(
+        net, gloss.L2Loss(), mx.optimizer.Adam(learning_rate=lr), mesh)
+
+
+def _load_builder(spec):
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--builder must be module:function, got {spec!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def load_bundle(path):
+    """(meta, state tree, records) from a bundle directory. The state tree is
+    ``ParallelTrainStep.load_state_dict`` compatible; each record is a dict
+    of host arrays plus its deserialized RNG key."""
+    from mxnet_tpu.resilience.numerics import deserialize_key
+
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "sdc_bundle":
+        raise SystemExit(f"{path} is not an SDC bundle "
+                         f"(kind={meta.get('kind')!r})")
+    with onp.load(os.path.join(path, "state.npz"), allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    params = {k: v for k, v in arrays.items()
+              if k.startswith("p") and "_" not in k}
+    opt = {}
+    for j, arity in enumerate(meta["opt_arities"]):
+        opt[f"s{j}"] = {f"l{k}": arrays[f"s{j}_l{k}"] for k in range(arity)}
+    state = {"kind": "ParallelTrainStep", "version": 1, "t": int(meta["t"]),
+             "n_params": len(params), "param_names": "", "params": params,
+             "opt": opt}
+    records = []
+    with onp.load(os.path.join(path, "records.npz"), allow_pickle=False) as z:
+        for i, rm in enumerate(meta["records"]):
+            y = tuple(z[f"r{i}_y{j}"] for j in range(int(rm["n_y"])))
+            records.append({
+                "x": z[f"r{i}_x"],
+                "y": y[0] if len(y) == 1 else y,
+                "extras": tuple(z[f"r{i}_e{j}"]
+                                for j in range(int(rm["n_extras"]))),
+                "key": deserialize_key(z[f"r{i}_key"], rm["key_impl"],
+                                       rm.get("key_typed", 1)),
+                "lrs": z[f"r{i}_lrs"], "wds": z[f"r{i}_wds"],
+                "t": int(rm["t"]),
+            })
+    return meta, state, records
+
+
+def replay(path, builder=None):
+    """Re-execute a bundle; returns the result dict (see module docstring
+    for the verdict semantics)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.resilience.numerics import _digest_arrays
+
+    meta, state, records = load_bundle(path)
+    if builder is None:
+        builder = demo_mlp_builder
+    ts = builder(meta)
+    ts.load_state_dict(state)
+    pre_digest = _digest_arrays(ts._params)
+    for rec in records:
+        ts.replay_exact(jnp.asarray(rec["x"]), rec["y"], rec["extras"],
+                        rec["key"], jnp.asarray(rec["lrs"]),
+                        jnp.asarray(rec["wds"]), rec["t"])
+    digest = _digest_arrays(ts._params)
+    live, screen = meta["digest_live"], meta["digest_replay"]
+    if digest == screen:
+        verdict = "live_corrupt"
+    elif digest == live:
+        verdict = "replay_corrupt"
+    else:
+        verdict = "no_reproduction"
+    return {"bundle": path, "verdict": verdict,
+            "pre_digest_ok": pre_digest == meta.get("pre_digest"),
+            "replayed_digest": digest, "digest_live": live,
+            "digest_replay": screen, "n_records": len(records),
+            "t": int(meta["t"])}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", help="SDC bundle directory (sdc-tNNNNNNNN-*)")
+    ap.add_argument("--builder", default=None,
+                    help="module:function returning a compatible "
+                         "ParallelTrainStep (default: the bundle's embedded "
+                         "demo-MLP dims)")
+    args = ap.parse_args(argv)
+    builder = _load_builder(args.builder) if args.builder else None
+    result = replay(args.bundle, builder=builder)
+    print(json.dumps(result, sort_keys=True))
+    return 0 if result["verdict"] in ("live_corrupt", "replay_corrupt") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
