@@ -64,6 +64,10 @@ if config.get("MXNET_PROFILER_AUTOSTART"):
     profiler.start()
 # MXNET_TELEMETRY_DUMP_PATH: start the background metrics reporter
 telemetry.reporter._autostart()
+# MXNET_FLIGHT_DIR: arm the flight recorder's unhandled-exception hooks
+telemetry.flight._autostart()
+# MXNET_DEBUG_PORT: start the localhost HTTP introspection server
+telemetry.debug_server._autostart()
 from . import parallel
 from . import serving
 from . import resilience

@@ -277,3 +277,55 @@ register("MXNET_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
          "drain; past it pending requests are abandoned (failed with "
          "ServerClosedError, counted in mxtpu_drain_abandoned_total) so a "
          "wedged endpoint can never hang shutdown forever.")
+register("MXNET_FLIGHT_DIR", "", str,
+         "FlightRecorder: directory where trigger-driven flight bundles "
+         "(ring contents + metrics snapshot + knob/env fingerprint + "
+         "thread stacks) are written, with rotation. Empty keeps the rings "
+         "recording but disables automatic bundle dumps; explicit "
+         "flight.dump() still works. Also arms the unhandled-exception "
+         "crash hooks at import when set.")
+register("MXNET_FLIGHT_SPANS", 512, int,
+         "FlightRecorder: capacity of the finished-span ring buffer.")
+register("MXNET_FLIGHT_EVENTS", 256, int,
+         "FlightRecorder: capacity of the structured-event ring buffer "
+         "(telemetry.event: breaker transitions, retries, failovers, "
+         "hot-swaps, numerics anomalies, preemptions, SLO alerts).")
+register("MXNET_FLIGHT_REQUESTS", 128, int,
+         "FlightRecorder: capacity of the completed-serving-request ring "
+         "(keyed by trace id).")
+register("MXNET_FLIGHT_KEEP", 8, int,
+         "FlightRecorder: newest bundles retained per directory; older "
+         "flight-*.json files are rotated away after each dump.")
+register("MXNET_FLIGHT_MIN_INTERVAL_S", 1.0, float,
+         "FlightRecorder: per-trigger-kind dump rate limit; a re-trigger "
+         "of the same kind inside the interval records the event but "
+         "skips the bundle (mxtpu_flight_dumps_suppressed_total).")
+register("MXNET_DEBUG_PORT", 0, int,
+         "Debug server: TCP port for the localhost HTTP introspection "
+         "pages (/metricsz /healthz /statusz /tracez /flightz). 0 (the "
+         "default) disables the server entirely.")
+register("MXNET_DEBUG_HOST", "127.0.0.1", str,
+         "Debug server: bind address. Keep it loopback unless a scrape "
+         "sidecar genuinely lives off-host — the pages expose knobs and "
+         "thread stacks.")
+register("MXNET_SLO_TARGET", 0.999, float,
+         "SLO monitor: default objective target (fraction of requests "
+         "under the endpoint's slo_ms) when server.register() does not "
+         "pass one explicitly.")
+register("MXNET_SLO_FAST_WINDOW_S", 300.0, float,
+         "SLO monitor: fast burn-rate window (seconds) — catches a sharp "
+         "latency regression within minutes.")
+register("MXNET_SLO_SLOW_WINDOW_S", 3600.0, float,
+         "SLO monitor: slow burn-rate window (seconds) — de-bounces the "
+         "fast window so blips never page.")
+register("MXNET_SLO_BURN_THRESHOLD", 10.0, float,
+         "SLO monitor: burn-rate multiple (bad_ratio / error_budget) both "
+         "windows must exceed before the alert fires / the breaker "
+         "escalates.")
+register("MXNET_SLO_MIN_EVENTS", 10, int,
+         "SLO monitor: minimum requests in the fast window before an "
+         "alert may fire (no paging on a sample of three).")
+register("MXNET_SLO_ESCALATE", False, bool,
+         "SLO monitor: when a burn alert fires, force the offending "
+         "tenant's circuit breaker to DEGRADED so admission tightens "
+         "before the queue melts. Off by default (alert-only).")

@@ -651,6 +651,10 @@ class NumericsGuard:
             "finite": rec.finite_v, "batch_pos": rec.batch_pos,
             "window_index": bad_idx, "injected": rec.injected,
         }
+        from ..telemetry import flight as _flight
+        _flight.trigger("numerics_anomaly", kind=label, action=action,
+                        step=rec.t, batch_pos=rec.batch_pos,
+                        loss=rec.loss_v, grad_norm=rec.gnorm_v)
         if action == "rewind":
             self._rewind(records)
             return
@@ -842,6 +846,11 @@ class NumericsGuard:
                                             digest_replay, pre_digest)
             self.sdc_bundles.append(bundle)
             self.last_sdc["bundle"] = bundle
+        from ..telemetry import flight as _flight
+        _flight.trigger("sdc_suspect", t=int(self._snapshot["t"]),
+                        digest_live=digest_live[:16],
+                        digest_replay=digest_replay[:16],
+                        window=len(records), sdc_bundle=bundle)
         if self.sdc_raise:
             raise SDCSuspectError(
                 f"SDC suspect at t={self._snapshot['t']}: re-executed "

@@ -225,6 +225,10 @@ class PreemptionGuard:
         self.last_flush = info
         _PREEMPTIONS.labels(outcome).inc()
         _FLUSH_DUR.observe(int(elapsed * 1e6))
+        from ..telemetry import flight as _flight
+        _flight.trigger("preemption", outcome=outcome,
+                        **{k: v for k, v in info.items() if k != "errors"},
+                        errors="; ".join(errors)[:500])
 
     # ------------------------------------------------------------------
     # the resuming side

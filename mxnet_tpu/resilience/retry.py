@@ -143,6 +143,9 @@ class RetryPolicy:
                 if on_retry is not None:
                     on_retry(e, attempt, delay_s)
                 _RETRIES.labels(site, type(e).__name__).inc()
+                _telemetry.event("retry", site=site,
+                                 error=type(e).__name__, attempt=attempt,
+                                 delay_ms=round(delay_s * 1e3, 3))
                 self._sleep(delay_s)
                 attempt += 1
 

@@ -36,6 +36,7 @@ from typing import Callable, Optional
 from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
+from ..telemetry import flight as _flight
 
 __all__ = ["Watchdog", "CircuitBreaker",
            "HEALTHY", "DEGRADED", "OPEN", "HALF_OPEN"]
@@ -131,6 +132,10 @@ class Watchdog:
                         fired.append((name, now - start))
             for name, elapsed in fired:
                 _STALLS.labels(name).inc()
+                # a stall is a flight trigger: the bundle captures the hung
+                # thread's stack while it is still hung
+                _flight.trigger("watchdog_stall", watch=name,
+                                elapsed_s=round(elapsed, 3))
                 cb = self._on_stall
                 if cb is not None:
                     try:
@@ -197,6 +202,13 @@ class CircuitBreaker:
         self._gauge.set(_STATE_CODE[new])
         self.transitions.append((old, new))
         del self.transitions[:-16]
+        _telemetry.event("circuit_transition", scope=self.scope,
+                         old=old, new=new)
+        if new == OPEN:
+            # a circuit opening means a tenant just lost admission: dump
+            # the last seconds of spans/events while they're still in-ring
+            _flight.trigger("circuit_open", scope=self.scope,
+                            failures=self._failures)
         if self._on_transition is not None:
             try:
                 self._on_transition(old, new)

@@ -87,17 +87,22 @@ class StepCostEWMA:
 class Tenant:
     """One endpoint's seat at the scheduler: its queue, its circuit breaker
     (per-tenant shedding: this tenant's overload degrades this tenant's
-    admission, not the whole server), and its optional SLO."""
+    admission, not the whole server), and its optional SLO (``slo_us`` is
+    both the scheduling deadline default and the latency objective the SLO
+    monitor burns against ``slo_target``)."""
 
-    __slots__ = ("name", "endpoint", "queue", "breaker", "slo_us")
+    __slots__ = ("name", "endpoint", "queue", "breaker", "slo_us",
+                 "slo_target")
 
     def __init__(self, name: str, endpoint, queue: EndpointQueue,
-                 breaker, slo_us: Optional[int] = None):
+                 breaker, slo_us: Optional[int] = None,
+                 slo_target: Optional[float] = None):
         self.name = name
         self.endpoint = endpoint
         self.queue = queue
         self.breaker = breaker
         self.slo_us = slo_us
+        self.slo_target = slo_target
 
 
 class Router:

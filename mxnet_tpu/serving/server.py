@@ -49,6 +49,9 @@ import numpy as onp
 from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
+from ..telemetry import debug_server as _debug
+from ..telemetry import flight as _flight
+from ..telemetry.slo import MONITOR as _SLO
 from ..ndarray.ndarray import NDArray
 from ..resilience import faults as _faults
 from ..resilience.retry import RetryPolicy
@@ -189,6 +192,7 @@ class InferenceServer:
     def register(self, endpoint: ModelEndpoint, warmup: bool = True,
                  max_queue: Optional[int] = None,
                  slo_ms: Optional[float] = None,
+                 slo_target: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None) -> ModelEndpoint:
         """Attach an endpoint as a tenant; by default compiles every shape
         bucket now so no request ever pays first-compile latency (warmup also
@@ -197,7 +201,10 @@ class InferenceServer:
         ``max_queue`` overrides the server default queue bound (the tenant's
         row quota); ``slo_ms`` sets the tenant's scheduling SLO — requests
         without an explicit deadline are scheduled as if due ``slo_ms`` after
-        submit; ``breaker`` overrides the tenant's circuit breaker."""
+        submit, and it doubles as the tenant's latency *objective*: the SLO
+        monitor tracks the fraction of requests finishing under it against
+        ``slo_target`` (default MXNET_SLO_TARGET) with burn-rate alerting;
+        ``breaker`` overrides the tenant's circuit breaker."""
         with self._cond:
             if endpoint.name in self._router:
                 raise MXNetError(f"endpoint {endpoint.name!r} already registered")
@@ -212,7 +219,11 @@ class InferenceServer:
                         scope=f"serving:{endpoint.name}")
             self._router.add(Tenant(
                 endpoint.name, endpoint, q, breaker,
-                slo_us=int(slo_ms * 1000) if slo_ms is not None else None))
+                slo_us=int(slo_ms * 1000) if slo_ms is not None else None,
+                slo_target=slo_target))
+        if slo_ms is not None:
+            _SLO.register(endpoint.name, threshold_us=slo_ms * 1000.0,
+                          target=slo_target, breaker=breaker)
         if warmup:
             endpoint.warmup()
         return endpoint
@@ -276,10 +287,15 @@ class InferenceServer:
             staged = ep.stage_weights(req.host_params)
             report = ep.validate_and_commit(staged, req.probe)
             report["source"] = req.label
+            _telemetry.event("hot_swap", endpoint=ep.name, ok=True,
+                             source=str(req.label),
+                             weights_epoch=ep.weights_epoch)
             resolve(req.future, report)
         except Exception as e:
             exc = e if isinstance(e, HotSwapError) else HotSwapError(
                 f"hot swap of {ep.name!r} failed validation: {e}")
+            _telemetry.event("hot_swap", endpoint=ep.name, ok=False,
+                             source=str(req.label), error=str(e)[:200])
             fail(req.future, exc)
 
     # ------------------------------------------------------------------
@@ -297,6 +313,7 @@ class InferenceServer:
             self._state = _RUNNING
             self._prepared.clear()
             self._spawn_threads()
+        _debug.attach(self)     # /healthz + /statusz see every live server
         return self
 
     def _spawn_threads(self):  # mxlint: disable=CONC200
@@ -410,6 +427,7 @@ class InferenceServer:
                 "pending_rows": t.queue.pending_rows,
                 "circuit": t.breaker.state(),
                 "slo_ms": t.slo_us / 1000.0 if t.slo_us else None,
+                "slo_target": t.slo_target,
                 "weights_epoch": t.endpoint.weights_epoch,
             }
         worst = max((b.state() for b in breakers),
@@ -675,8 +693,10 @@ class InferenceServer:
         _FAILOVERS.labels(reason).inc()
         if requeued:
             _FAILOVER_REQUEUED.inc(requeued)
-        return {"reason": reason, "epoch": epoch, "requeued": requeued,
-                "tenant": tenant_name}
+        report = {"reason": reason, "epoch": epoch, "requeued": requeued,
+                  "tenant": tenant_name}
+        _flight.trigger("failover", **report)
+        return report
 
     # ------------------------------------------------------------------
     # serial worker (pipeline=False): assemble -> prepare -> execute inline
@@ -853,8 +873,14 @@ class InferenceServer:
         except Exception as e:  # retries exhausted / fatal: fail the batch
             killed = False
             pb.tenant.breaker.record_failure()
+            failed_at = _now_us()
             for r in pb.requests:
                 fail(r.future, e)
+                _flight.record_request(r.trace_id, ep.name,
+                                       failed_at - r.enqueue_us,
+                                       rows=r.rows, ok=False,
+                                       error=type(e).__name__)
+                _SLO.record(ep.name, failed_at - r.enqueue_us, ok=False)
             return
         finally:
             self._overlap.step_end()
@@ -875,6 +901,9 @@ class InferenceServer:
             resolve(r.future, sliced[0] if ep.num_outputs == 1 else sliced)
             ep.stats.record_latency(done - r.enqueue_us)
             ep.stats.bump("completed")
+            _flight.record_request(r.trace_id, ep.name, done - r.enqueue_us,
+                                   rows=r.rows)
+            _SLO.record(ep.name, done - r.enqueue_us)
             if profiling:
                 from .. import profiler
                 profiler.record_duration(f"serving[{ep.name}].request",

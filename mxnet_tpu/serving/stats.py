@@ -23,6 +23,7 @@ import threading
 from typing import Dict
 
 from .. import telemetry as _telemetry
+from ..telemetry.metrics import _quantile_from_buckets
 
 __all__ = ["LatencyHistogram", "EndpointStats"]
 
@@ -105,6 +106,10 @@ _EVENT_NAMES = {"submitted": "submitted", "completed": "completed",
 # (~9% wide), starting at 1 us. 240 bins tops out around 1e9 us (~17 min).
 _RATIO = 2.0 ** 0.125
 _NBINS = 240
+# upper bound of each bin (bin i covers [_RATIO**i, _RATIO**(i+1))): the
+# shape telemetry.metrics._quantile_from_buckets expects, so this histogram
+# keeps its finer resolution while sharing the one quantile estimator
+_BOUNDS = tuple(_RATIO ** (i + 1) for i in range(_NBINS))
 
 
 class LatencyHistogram:
@@ -132,16 +137,8 @@ class LatencyHistogram:
     def percentile(self, p: float) -> float:
         """p in [0, 100] -> approximate duration in us (geometric bin midpoint),
         0.0 when empty."""
-        if self.n == 0:
-            return 0.0
-        rank = max(1, int(round(p / 100.0 * self.n)))
-        seen = 0
-        for idx, c in enumerate(self.counts):
-            seen += c
-            if seen >= rank:
-                lo = _RATIO ** idx
-                return lo * (_RATIO ** 0.5)
-        return self.max_us
+        return _quantile_from_buckets(_BOUNDS, self.counts, self.n, p,
+                                      self.max_us)
 
     def snapshot(self) -> Dict[str, float]:
         if self.n == 0:

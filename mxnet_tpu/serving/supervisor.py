@@ -43,6 +43,7 @@ from typing import Optional
 
 from ..base import MXNetError
 from .. import config as _config
+from .. import telemetry as _telemetry
 from .server import InferenceServer, _RUNNING
 
 __all__ = ["PoolSupervisor"]
@@ -160,6 +161,7 @@ class PoolSupervisor:
             with self._lock:
                 self._stalled = None
                 self.reports.append(report)
+            _telemetry.event("supervisor_failover", **report)
 
     @property
     def failovers(self) -> int:

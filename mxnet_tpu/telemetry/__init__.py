@@ -35,10 +35,14 @@ from __future__ import annotations
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       DEFAULT_BUCKETS, METRIC_NAME_RE)
+from .flight import FlightRecorder, event
 from .tracing import (Span, span, current_span, current_trace_id,
                       new_trace_id)
 from .reporter import (PeriodicReporter, periodic_logger, dump,
                        sample_device_memory, summary_line)
+from .debug_server import DebugServer
+from .slo import SLOMonitor
+from . import flight, debug_server, slo
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -46,6 +50,9 @@ __all__ = [
     "Span", "span", "current_span", "current_trace_id", "new_trace_id",
     "PeriodicReporter", "periodic_logger", "dump", "sample_device_memory",
     "summary_line",
+    "FlightRecorder", "event", "flight",
+    "DebugServer", "debug_server",
+    "SLOMonitor", "slo",
     "counter", "gauge", "histogram", "snapshot", "snapshot_json",
     "prometheus_text", "lint_names",
 ]

@@ -13,6 +13,7 @@ so long-running jobs are observable without code changes.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import logging
 import os
@@ -116,6 +117,11 @@ class PeriodicReporter:
         self.prometheus = prometheus
         self._log = logger or _LOG
         self._stop = threading.Event()
+        self._stopped = False
+        self._stop_lock = threading.Lock()
+        # interpreter exit between ticks would silently drop the final
+        # interval's snapshot — atexit guarantees one last dump lands
+        atexit.register(self.stop)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="mxtpu-telemetry-reporter")
         self._thread.start()
@@ -136,7 +142,13 @@ class PeriodicReporter:
 
     def stop(self, final_tick: bool = True):
         """Stop the reporter; by default take one last sample/dump so the
-        file on disk reflects end-of-run state."""
+        file on disk reflects end-of-run state. Idempotent: the atexit hook
+        and an explicit stop() cannot double-tick."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        atexit.unregister(self.stop)
         self._stop.set()
         self._thread.join(timeout=self.interval + 5)
         if final_tick:

@@ -1,0 +1,275 @@
+"""Per-tenant SLO objectives with multi-window burn-rate alerting.
+
+An *objective* is "fraction of requests under ``threshold_us`` must be at
+least ``target``" (e.g. 99.9% under 50 ms). The monitor classifies every
+completed request as good or bad, aggregates per-second buckets over two
+sliding windows — a fast window (minutes: catches a sharp regression before
+the queue melts) and a slow window (an hour: filters blips) — and computes
+the **burn rate**: ``bad_ratio / error_budget`` where the error budget is
+``1 - target``. Burn 1.0 means the tenant consumes its budget exactly at the
+sustainable pace; burn 14 on a 99.9% objective means the monthly budget is
+gone in ~2 days. Following SRE practice the alert fires only when *both*
+windows burn above ``MXNET_SLO_BURN_THRESHOLD`` — the fast window gives
+latency, the slow window gives de-bounce — and latches until the fast window
+recovers, so a single breach episode is one alert, not a firehose.
+
+On alert: ``mxtpu_slo_alerts_total`` bumps, a ``slo_burn_alert`` flight
+event is recorded, and — when ``MXNET_SLO_ESCALATE`` is on and the objective
+carries the tenant's breaker — the breaker is forced DEGRADED so admission
+tightens on the *offending* tenant only (serving sheds its excess instead of
+letting it melt every queue).
+
+Objectives are registered from ``InferenceServer.register(slo_ms=...)``;
+the process-wide monitor is ``slo.MONITOR``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .metrics import REGISTRY
+from . import flight as _flight
+
+__all__ = ["Objective", "SLOMonitor", "MONITOR"]
+
+_GOOD = REGISTRY.counter(
+    "mxtpu_slo_good_total",
+    "Requests that met their endpoint's latency objective.",
+    labelnames=("endpoint",))
+_BAD = REGISTRY.counter(
+    "mxtpu_slo_bad_total",
+    "Requests that missed their endpoint's latency objective (too slow or "
+    "failed).",
+    labelnames=("endpoint",))
+_BURN = REGISTRY.gauge(
+    "mxtpu_slo_burn_rate",
+    "Error-budget burn rate (bad_ratio / (1 - target)) per window: 1.0 = "
+    "budget consumed exactly at the sustainable pace.",
+    labelnames=("endpoint", "window"))
+_ALERT_ACTIVE = REGISTRY.gauge(
+    "mxtpu_slo_alert_active",
+    "1 while an endpoint's multi-window burn alert is latched, else 0.",
+    labelnames=("endpoint",))
+_ALERTS = REGISTRY.counter(
+    "mxtpu_slo_alerts_total",
+    "Burn-rate alert episodes fired (both windows over threshold).",
+    labelnames=("endpoint",))
+_ESCALATIONS = REGISTRY.counter(
+    "mxtpu_slo_escalations_total",
+    "Burn alerts that escalated the offending tenant's breaker to DEGRADED "
+    "(MXNET_SLO_ESCALATE).",
+    labelnames=("endpoint",))
+
+
+def _cfg(name, default):
+    try:
+        from .. import config
+        return config.get(name, default)
+    except Exception:
+        return default
+
+
+class Objective:
+    """One endpoint's latency objective plus its sliding good/bad buckets."""
+
+    __slots__ = ("name", "threshold_us", "target", "breaker", "buckets",
+                 "alert_active", "_good", "_bad", "_burn_fast", "_burn_slow",
+                 "_active_g")
+
+    def __init__(self, name: str, threshold_us: float, target: float,
+                 breaker=None):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got {target}")
+        self.name = name
+        self.threshold_us = float(threshold_us)
+        self.target = float(target)
+        self.breaker = breaker
+        # (second, good, bad) per-second aggregation, oldest first
+        self.buckets: deque = deque()
+        self.alert_active = False
+        self._good = _GOOD.labels(name)
+        self._bad = _BAD.labels(name)
+        self._burn_fast = _BURN.labels(name, "fast")
+        self._burn_slow = _BURN.labels(name, "slow")
+        self._active_g = _ALERT_ACTIVE.labels(name)
+        self._active_g.set(0)
+
+    def window_totals(self, window_s: float, now: float):
+        """(good, bad) over the trailing ``window_s`` seconds."""
+        lo = now - window_s
+        good = bad = 0
+        for sec, g, b in reversed(self.buckets):
+            if sec < lo:
+                break
+            good += g
+            bad += b
+        return good, bad
+
+
+class SLOMonitor:
+    """Process-wide burn-rate monitor. Windows/threshold/escalation re-read
+    their knobs on every check unless pinned at construction, so tests and
+    live operators can retune without a restart."""
+
+    def __init__(self, target: Optional[float] = None,
+                 fast_window_s: Optional[float] = None,
+                 slow_window_s: Optional[float] = None,
+                 burn_threshold: Optional[float] = None,
+                 min_events: Optional[int] = None,
+                 escalate: Optional[bool] = None,
+                 time_fn=time.monotonic):
+        self._target = target
+        self._fast = fast_window_s
+        self._slow = slow_window_s
+        self._threshold = burn_threshold
+        self._min_events = min_events
+        self._escalate = escalate
+        self._now = time_fn
+        self._lock = threading.Lock()
+        self._objectives: Dict[str, Objective] = {}
+
+    # -- knob-backed settings ----------------------------------------------
+    @property
+    def fast_window_s(self) -> float:
+        return self._fast if self._fast is not None else \
+            float(_cfg("MXNET_SLO_FAST_WINDOW_S", 300.0))
+
+    @property
+    def slow_window_s(self) -> float:
+        return self._slow if self._slow is not None else \
+            float(_cfg("MXNET_SLO_SLOW_WINDOW_S", 3600.0))
+
+    @property
+    def burn_threshold(self) -> float:
+        return self._threshold if self._threshold is not None else \
+            float(_cfg("MXNET_SLO_BURN_THRESHOLD", 10.0))
+
+    @property
+    def min_events(self) -> int:
+        return self._min_events if self._min_events is not None else \
+            int(_cfg("MXNET_SLO_MIN_EVENTS", 10))
+
+    @property
+    def escalate(self) -> bool:
+        return self._escalate if self._escalate is not None else \
+            bool(_cfg("MXNET_SLO_ESCALATE", False))
+
+    # -- registration -------------------------------------------------------
+    def register(self, name: str, threshold_us: float,
+                 target: Optional[float] = None, breaker=None) -> Objective:
+        """Register (or replace) an endpoint's objective. ``target`` falls
+        back to MXNET_SLO_TARGET."""
+        if target is None:
+            target = self._target if self._target is not None else \
+                float(_cfg("MXNET_SLO_TARGET", 0.999))
+        obj = Objective(name, threshold_us, target, breaker=breaker)
+        with self._lock:
+            self._objectives[name] = obj
+        return obj
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._objectives.pop(name, None)
+
+    def get(self, name: str) -> Optional[Objective]:
+        with self._lock:
+            return self._objectives.get(name)
+
+    def objectives(self) -> List[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    # -- recording ----------------------------------------------------------
+    def record(self, name: str, latency_us: float, ok: bool = True):
+        """Classify one completed request; no-op for endpoints without an
+        objective. Also runs the burn check for this objective."""
+        obj = self.get(name)
+        if obj is None:
+            return
+        good = bool(ok) and latency_us <= obj.threshold_us
+        now = self._now()
+        sec = int(now)
+        with self._lock:
+            if obj.buckets and obj.buckets[-1][0] == sec:
+                s, g, b = obj.buckets[-1]
+                obj.buckets[-1] = (s, g + good, b + (not good))
+            else:
+                obj.buckets.append((sec, int(good), int(not good)))
+                lo = now - self.slow_window_s - 1
+                while obj.buckets and obj.buckets[0][0] < lo:
+                    obj.buckets.popleft()
+        (obj._good if good else obj._bad).inc()
+        self.check(obj, now)
+
+    # -- burn check / alerting ----------------------------------------------
+    @staticmethod
+    def _burn(good: int, bad: int, target: float) -> float:
+        total = good + bad
+        if total == 0:
+            return 0.0
+        return (bad / total) / (1.0 - target)
+
+    def check(self, obj: Objective, now: Optional[float] = None) -> dict:
+        """Recompute both windows' burn rates, update gauges, and fire /
+        clear the latched alert. Returns the computed state (for tests and
+        /statusz)."""
+        if now is None:
+            now = self._now()
+        with self._lock:
+            fg, fb = obj.window_totals(self.fast_window_s, now)
+            sg, sb = obj.window_totals(self.slow_window_s, now)
+        fast = self._burn(fg, fb, obj.target)
+        slow = self._burn(sg, sb, obj.target)
+        obj._burn_fast.set(fast)
+        obj._burn_slow.set(slow)
+        thr = self.burn_threshold
+        breaching = (fg + fb >= self.min_events and fast >= thr
+                     and slow >= thr)
+        if breaching and not obj.alert_active:
+            obj.alert_active = True
+            obj._active_g.set(1)
+            _ALERTS.labels(obj.name).inc()
+            escalated = False
+            if self.escalate and obj.breaker is not None:
+                try:
+                    obj.breaker.force_degraded(
+                        f"slo burn {fast:.1f}x fast / {slow:.1f}x slow "
+                        f"(threshold {thr:g}x)")
+                    escalated = True
+                    _ESCALATIONS.labels(obj.name).inc()
+                except Exception:
+                    pass
+            _flight.event("slo_burn_alert", endpoint=obj.name,
+                          fast_burn=round(fast, 3), slow_burn=round(slow, 3),
+                          threshold=thr, target=obj.target,
+                          escalated=escalated)
+        elif obj.alert_active and fast < thr:
+            obj.alert_active = False
+            obj._active_g.set(0)
+            _flight.event("slo_burn_clear", endpoint=obj.name,
+                          fast_burn=round(fast, 3))
+        return {"endpoint": obj.name, "fast_burn": fast, "slow_burn": slow,
+                "alert_active": obj.alert_active,
+                "fast_events": fg + fb, "slow_events": sg + sb}
+
+    def check_all(self) -> List[dict]:
+        return [self.check(obj) for obj in self.objectives()]
+
+    def snapshot(self) -> List[dict]:
+        """Objective states for /statusz."""
+        out = []
+        for obj in self.objectives():
+            st = self.check(obj)
+            st.update(threshold_us=obj.threshold_us, target=obj.target)
+            out.append(st)
+        return out
+
+    def _reset_for_tests(self):
+        with self._lock:
+            self._objectives.clear()
+
+
+# the process-wide monitor InferenceServer.register() feeds
+MONITOR = SLOMonitor()

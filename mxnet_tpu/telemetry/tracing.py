@@ -32,8 +32,13 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from .metrics import REGISTRY
+from .flight import RECORDER as _FLIGHT_RECORDER
 
 __all__ = ["Span", "span", "current_span", "current_trace_id", "new_trace_id"]
+
+# pre-bound deque.append: the flight span ring rides every span exit, so the
+# hot path pays one bounded-deque append (GIL-atomic) and nothing else
+_record_flight_span = _FLIGHT_RECORDER._spans.append
 
 _CURRENT: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "mxtpu_current_span", default=None)
@@ -93,6 +98,7 @@ def span(name: str, trace_id: Optional[str] = None, **attrs):
         _CURRENT.reset(token)
         s.dur_us = _now_us() - s.t0_us
         _SPAN_DURATION.labels(name).observe(s.dur_us)
+        _record_flight_span(s)
         _emit_profiler(s)
 
 

@@ -628,6 +628,49 @@ SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
              "bad_batch": check_bad_batch, "sdc": check_sdc}
 
+# the flight-recorder trigger each injected fault must leave behind (a clean
+# hot_swap is a structured event, not a dump trigger, so it has no entry)
+EXPECTED_FLIGHT_TRIGGER = {
+    "preempt": "preemption",
+    "worker_kill": "failover",
+    "nan_grad": "numerics_anomaly",
+    "bad_batch": "numerics_anomaly",
+    "sdc": "sdc_suspect",
+}
+
+
+def check_flight_bundle(name, fn):
+    """Run one scenario with a private MXNET_FLIGHT_DIR and assert the
+    injected fault left at least one parseable flight bundle whose trigger
+    kind matches the fault — the black box must capture every drill."""
+    from mxnet_tpu import config
+    from mxnet_tpu.telemetry import flight
+
+    expected = EXPECTED_FLIGHT_TRIGGER.get(name)
+    if expected is None:
+        return fn()
+    fdir = tempfile.mkdtemp(prefix=f"chaos-flight-{name}-")
+    flight.RECORDER.reset_rate_limit()   # prior scenarios must not suppress
+    config.set("MXNET_FLIGHT_DIR", fdir)
+    try:
+        res = fn()
+    finally:
+        config.set("MXNET_FLIGHT_DIR", "")
+    triggers = []
+    parse_ok = True
+    for path in flight.list_bundles(fdir):
+        try:
+            triggers.append(flight.load_bundle(path)["trigger"]["kind"])
+        except (OSError, ValueError, KeyError):
+            parse_ok = False
+    flight_ok = parse_ok and expected in triggers
+    res["flight_dir"] = fdir
+    res["flight_expected"] = expected
+    res["flight_triggers"] = triggers
+    res["flight_ok"] = bool(flight_ok)
+    res["ok"] = bool(res["ok"] and flight_ok)
+    return res
+
 
 def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
               scenarios=None, out=sys.stdout):
@@ -638,18 +681,22 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
         ok = True
         for name in scenarios:
             if name == "preempt":
-                res = check_preempt(seed, steps=max(4, steps // 2),
-                                    ckpt_dir=ckpt_dir)
+                res = check_flight_bundle(name, lambda: check_preempt(
+                    seed, steps=max(4, steps // 2), ckpt_dir=ckpt_dir))
             elif name == "worker_kill":
-                res = check_worker_kill(seed, requests=requests)
+                res = check_flight_bundle(name, lambda: check_worker_kill(
+                    seed, requests=requests))
             elif name == "hot_swap":
                 res = check_hot_swap(seed, requests=requests)
             elif name == "nan_grad":
-                res = check_nan_grad(seed, steps=max(10, steps))
+                res = check_flight_bundle(name, lambda: check_nan_grad(
+                    seed, steps=max(10, steps)))
             elif name == "bad_batch":
-                res = check_bad_batch(seed, steps=max(10, steps))
+                res = check_flight_bundle(name, lambda: check_bad_batch(
+                    seed, steps=max(10, steps)))
             elif name == "sdc":
-                res = check_sdc(seed, steps=max(10, steps))
+                res = check_flight_bundle(name, lambda: check_sdc(
+                    seed, steps=max(10, steps)))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

@@ -16,7 +16,9 @@ the file every ``MXNET_TELEMETRY_DUMP_INTERVAL`` seconds) or by calling
     # raw snapshot JSON (pretty-printed)
     python tools/metrics_dump.py /tmp/mxtpu.json --json
 
-    # live view of a running loadgen: re-read every 2 s
+    # live view of a running loadgen: re-read every 2 s; _total counters
+    # grow a Δ/s column (per-interval rate) so the watch reads like a
+    # dashboard instead of a raw dump
     python tools/metrics_dump.py /tmp/mxtpu.json --watch 2
 
     # include zero-valued series (the full registered catalog)
@@ -37,9 +39,42 @@ def _fmt_labels(labels):
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
 
 
-def render_table(snap, include_zero=False):
-    """Human-readable series table from a snapshot dict."""
-    lines = [f"{'metric':<58}{'type':>10}{'value':>16}"]
+def counter_totals(snap):
+    """{series_key: value} for every ``_total`` counter series — the state a
+    --watch loop diffs between reads to derive per-interval rates."""
+    totals = {}
+    for name, fam in snap.get("metrics", {}).items():
+        if fam.get("type") != "counter" or not name.endswith("_total"):
+            continue
+        for s in fam.get("series", []):
+            totals[name + _fmt_labels(s.get("labels"))] = s.get("value", 0)
+    return totals
+
+
+def compute_rates(prev_totals, totals, dt_s):
+    """Δ/s per series between two counter_totals() reads. A counter that
+    went backwards (process restart) reads as a fresh start, not a negative
+    rate."""
+    if dt_s <= 0:
+        return {}
+    rates = {}
+    for key, v in totals.items():
+        prev = prev_totals.get(key)
+        if prev is None:
+            continue
+        delta = v - prev
+        rates[key] = (delta / dt_s) if delta >= 0 else v / dt_s
+    return rates
+
+
+def render_table(snap, include_zero=False, rates=None):
+    """Human-readable series table from a snapshot dict. ``rates`` (from
+    compute_rates) adds a Δ/s column to ``_total`` counter rows so a live
+    --watch reads like a dashboard."""
+    head = f"{'metric':<58}{'type':>10}{'value':>16}"
+    if rates is not None:
+        head += f"{'Δ/s':>14}"
+    lines = [head]
     for name, fam in sorted(snap.get("metrics", {}).items()):
         for s in fam.get("series", []):
             key = name + _fmt_labels(s.get("labels"))
@@ -58,8 +93,11 @@ def render_table(snap, include_zero=False):
                 v = s.get("value", 0)
                 if not v and not include_zero:
                     continue
-                vs = f"{v:.6g}"
-                lines.append(f"{key:<58}{fam['type']:>10}{vs:>16}")
+                row = f"{key:<58}{fam['type']:>10}{v:>16.6g}"
+                if rates is not None and fam["type"] == "counter" and \
+                        name.endswith("_total"):
+                    row += f"{rates.get(key, 0.0):>13.6g}/s"
+                lines.append(row)
     return "\n".join(lines)
 
 
@@ -92,22 +130,31 @@ def main(argv=None):
 
     from mxnet_tpu.telemetry.metrics import prometheus_from_snapshot
 
-    def render():
-        snap = load_snapshot(args.path)
+    def render(snap, rates=None):
         if args.prom:
             return prometheus_from_snapshot(snap)
         if args.json:
             return json.dumps(snap, indent=1, sort_keys=True)
         ts = snap.get("ts")
         age = f" (snapshot age {time.time() - ts:.1f}s)" if ts else ""
-        return f"# {args.path}{age}\n" + render_table(snap, args.all)
+        return f"# {args.path}{age}\n" + render_table(snap, args.all,
+                                                      rates=rates)
 
     if args.watch is None:
-        print(render())
+        print(render(load_snapshot(args.path)))
         return 0
+    # watch mode: diff consecutive reads so _total counters also show Δ/s
+    prev_totals, prev_ts = None, None
     try:
         while True:
-            print("\033[2J\033[H" + render(), flush=True)
+            snap = load_snapshot(args.path)
+            now = snap.get("ts") or time.time()
+            totals = counter_totals(snap)
+            rates = {}
+            if prev_totals is not None:
+                rates = compute_rates(prev_totals, totals, now - prev_ts)
+            print("\033[2J\033[H" + render(snap, rates=rates), flush=True)
+            prev_totals, prev_ts = totals, now
             time.sleep(args.watch)
     except KeyboardInterrupt:
         return 0
