@@ -288,6 +288,17 @@ def main():
     tsnap = telemetry.snapshot()
     print(json.dumps({"telemetry_summary": telemetry.summary_line(),
                       "metric_families": len(tsnap["metrics"])}), flush=True)
+    # compile-ledger rollup: every serving-bucket compile of the run, the
+    # distinct programs behind them, and the seconds re-spent on programs
+    # the process had already compiled (what a persistent cache would save)
+    cls = telemetry.compile_ledger.summary()
+    print(json.dumps({"compile_ledger": {
+        "compiles": cls["compiles"],
+        "distinct_fingerprints": cls["distinct_fingerprints"],
+        "duplicates": cls["duplicates"],
+        "dup_waste_s": cls["dup_waste_s"],
+        "wall_s": round(cls["lower_s"] + cls["compile_s"], 3),
+    }}), flush=True)
     dump_path = os.environ.get("SLG_TELEMETRY", "")
     if dump_path:
         telemetry.dump(dump_path)

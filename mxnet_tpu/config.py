@@ -329,3 +329,44 @@ register("MXNET_SLO_ESCALATE", False, bool,
          "SLO monitor: when a burn alert fires, force the offending "
          "tenant's circuit breaker to DEGRADED so admission tightens "
          "before the queue melts. Off by default (alert-only).")
+register("MXNET_COMPILE_LEDGER_DIR", "", str,
+         "Compile ledger: directory for the append-only per-process "
+         "ledger-<pid>.jsonl files (one CompileRecord per XLA compile, "
+         "atomic line appends, shared across processes for cross-process "
+         "duplicate detection). Empty keeps the in-memory ring + metrics "
+         "but writes no files.")
+register("MXNET_COMPILE_LEDGER_KEEP", 64, int,
+         "Compile ledger: CompileRecords served by recent() — the window "
+         "the /compilez page and every flight bundle snapshot.")
+register("MXNET_COMPILE_LEDGER_EAGER", "auto", str,
+         "Compile ledger: instrument the eager jit cache ('1'/'0'; 'auto' "
+         "follows MXNET_COMPILE_LEDGER_DIR). Instrumentation AOT-compiles "
+         "per aval signature to observe each compile; the default eager "
+         "hot path is untouched when off.")
+register("MXNET_MEM_TRACK", True, bool,
+         "Memstats: maintain the HBM holder registry (endpoint params / "
+         "bucket executables / donated train state / numerics snapshots) "
+         "and reconcile it against device.memory_stats(). 0 turns "
+         "register() into a no-op.")
+register("MXNET_MEM_HOLDERS_KEEP", 32, int,
+         "Memstats: ranked holders shown in breakdown() — the /memz page, "
+         "OOM flight bundles; the rest fold into an omitted-bytes line.")
+register("MXNET_PERF_SENTINEL", True, bool,
+         "Perf sentinel: feed train-step and serving-step latencies into "
+         "per-stream EWMA drift detectors that fire a perf_regression "
+         "flight event on sustained regression. 0 disables.")
+register("MXNET_PERF_EWMA_ALPHA", 0.05, float,
+         "Perf sentinel: baseline EWMA smoothing factor (the fast 'now' "
+         "track uses 4x this).")
+register("MXNET_PERF_REGRESSION_RATIO", 1.5, float,
+         "Perf sentinel: fast-track / baseline ratio that counts as "
+         "regressed; must hold for MXNET_PERF_SUSTAIN_N consecutive "
+         "observations to fire.")
+register("MXNET_PERF_SUSTAIN_N", 8, int,
+         "Perf sentinel: consecutive over-ratio observations required "
+         "before the perf_regression trigger fires (one spike never "
+         "pages).")
+register("MXNET_PERF_WARMUP_N", 50, int,
+         "Perf sentinel: observations per stream before the detector "
+         "arms — compile-time outliers and cold caches train the "
+         "baseline instead of firing it.")

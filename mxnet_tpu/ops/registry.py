@@ -143,6 +143,15 @@ def _executor(op: Op, attrs: Dict[str, Any]) -> Callable:
         if fn is None:
             base = functools.partial(op.fn, **attrs) if attrs else op.fn
             fn = jax.jit(base)
+            # Compile-ledger instrumentation is opt-in (a ledger dir or
+            # MXNET_COMPILE_LEDGER_EAGER=1): the default eager hot path
+            # stays byte-identical to protect dispatch latency.
+            try:
+                from ..telemetry import compile_ledger as _ledger
+                if _ledger.eager_active():
+                    fn = _ledger.instrument_eager_jit(fn, op.name)
+            except Exception:
+                pass
             _JIT_CACHE[key] = fn
             cap = _jit_cache_capacity()
             while len(_JIT_CACHE) > cap:

@@ -163,6 +163,14 @@ class ParallelTrainStep:
             else self._data_sharding
         self._extra_shardings = [mesh.sharding(*s) for s in extra_specs]
         self._aux_ids_cell: List = []
+        # HBM attribution: the carried (donated) train state — params + aux
+        # + optimizer moments — sized live at every memstats reconcile, so
+        # the figure survives donation replacing the arrays each step
+        from ..telemetry import memstats as _memstats
+        _memstats.register(
+            "train", f"train_step.state.{id(self):x}", owner=self,
+            sizer=lambda ts: _memstats.nbytes_of(ts._params) +
+            _memstats.nbytes_of(ts._opt_states))
 
     # ------------------------------------------------------------------
     def _make_raw_step(self, with_health: bool = False):
@@ -396,7 +404,18 @@ class ParallelTrainStep:
                     return jax.ShapeDtypeStruct(a.shape, a.dtype)
                 abstract = tuple(jax.tree_util.tree_map(sds, args[i])
                                  for i in range(3))
-                comp = jfn.lower(*abstract, *args[3:]).compile()
+                from ..telemetry import compile_ledger as _ledger
+                try:
+                    mesh_shape = dict(self._mesh.mesh.shape)
+                except Exception:
+                    mesh_shape = {}
+                comp = _ledger.lower_and_compile(
+                    jfn, tuple(abstract) + tuple(args[3:]),
+                    site="train_step",
+                    key={"mesh": mesh_shape,
+                         "mesh_devices": int(self._mesh.size),
+                         "dtype": str(self._compute_dtype),
+                         "data_sig": repr(key[2])[:200]})
                 cache[key] = comp
             if cache.get("owner") is not comp:
                 # move the carried state into THIS executable's formats; keep
@@ -440,6 +459,7 @@ class ParallelTrainStep:
         _STEPS.inc()
         _EXAMPLES.inc(examples)
         _STEP_LATENCY.observe(sp.dur_us)
+        _telemetry.perf_sentinel.observe("train_step", sp.dur_us)
         return out
 
     def _step_impl(self, x, y, *extras):
@@ -537,6 +557,7 @@ class ParallelTrainStep:
         _STEPS.inc(k)
         _EXAMPLES.inc(examples)
         _STEP_LATENCY.observe(sp.dur_us)
+        _telemetry.perf_sentinel.observe("train_step", sp.dur_us)
         return out
 
     def _step_n_impl(self, xs, ys, *extras_s):

@@ -432,6 +432,14 @@ class NumericsGuard:
         self._snap_prev = None
         self._snapshot = self._take_snapshot()
         self._steps_since_sdc = 0
+        # HBM attribution: the guard pins up to two full state copies
+        # (snapshot + aged snapshot); sized live at every reconcile
+        from ..telemetry import memstats as _memstats
+        _memstats.register(
+            "numerics", f"guard.snapshots.{id(self):x}", owner=self,
+            sizer=lambda g: sum(
+                _memstats.nbytes_of([s["params"], s["opt"]])
+                for s in (g._snapshot, g._snap_prev) if s))
 
     # ------------------------------------------------------------------
     # snapshots: on-device copies of the carried state + the RNG chain

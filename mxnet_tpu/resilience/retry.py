@@ -30,7 +30,8 @@ from .. import telemetry as _telemetry
 from .faults import FaultInjected
 from .numerics import NumericsError
 
-__all__ = ["RetryPolicy", "classify_error", "RETRYABLE_MARKERS"]
+__all__ = ["RetryPolicy", "classify_error", "is_oom_error",
+           "RETRYABLE_MARKERS"]
 
 _RETRIES = _telemetry.counter(
     "mxtpu_retries_total",
@@ -47,6 +48,20 @@ RETRYABLE_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
 #: first so e.g. "INVALID_ARGUMENT ... while allocating" stays fatal
 _FATAL_MARKERS = ("INVALID_ARGUMENT", "shape mismatch", "Incompatible shapes",
                   "dtype mismatch", "NOT_FOUND", "UNIMPLEMENTED")
+
+#: the subset of retryable markers that specifically mean device OOM; these
+#: fire the ``oom`` flight trigger so the bundle captures the memstats
+#: holder table while the allocation pressure is still in place
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "Failed to allocate")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a device allocation failure."""
+    msg = str(exc)
+    if any(m in msg for m in _FATAL_MARKERS):
+        return False
+    return any(m in msg for m in _OOM_MARKERS)
 
 
 def classify_error(exc: BaseException) -> bool:
@@ -134,6 +149,15 @@ class RetryPolicy:
             try:
                 return fn()
             except Exception as e:
+                if is_oom_error(e):
+                    # OOM post-mortem: the flight bundle snapshots the
+                    # memstats holder table at dump time, i.e. while the
+                    # pins that caused the exhaustion are still live. Fires
+                    # for retried AND fatal/exhausted OOMs (rate-limited
+                    # inside flight.trigger).
+                    _telemetry.flight.trigger(
+                        "oom", site=site, error=type(e).__name__,
+                        attempt=attempt, message=str(e)[:200])
                 if not self._classify(e) or attempt + 1 >= self.max_attempts:
                     raise
                 delay_s = self.delay_ms(attempt) / 1e3

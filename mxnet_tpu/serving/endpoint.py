@@ -170,6 +170,29 @@ class ModelEndpoint:
                     "batch-major (leading axis = batch) so per-request rows "
                     f"can be sliced back out; got output shape {getattr(o, 'shape', None)}")
         self._params = list(self.block.collect_params().values())
+        # HBM attribution: the weight set actually served (post-hot-swap
+        # device arrays when present) and the pipeline's double-buffered
+        # input sets, sized live at every memstats reconcile
+        from ..telemetry import memstats as _memstats
+        _memstats.register(
+            "serving", f"{self.name}.params", owner=self,
+            device=self._device_label(),
+            sizer=lambda ep: _memstats.nbytes_of(ep._param_datas()))
+        _memstats.register(
+            "serving", f"{self.name}.parity_bufs", owner=self,
+            device=self._device_label(),
+            sizer=lambda ep: _memstats.nbytes_of(
+                [slot[1] for slot in ep._parity_bufs if slot]))
+
+    def _device_label(self) -> str:
+        """The memstats/ledger device label ('cpu:0', 'tpu:3', ...)."""
+        try:
+            d = self.ctx.jax_device()
+            return f"{d.platform}:{d.id}"
+        except (AttributeError, RuntimeError, ValueError, ImportError):
+            # no jax device behind this ctx (stub backends) — holders
+            # registered with an empty label roll up under "unassigned"
+            return ""
 
     def _donate_inputs(self) -> bool:
         """Donate input buffers to the executable on backends that implement
@@ -221,6 +244,8 @@ class ModelEndpoint:
                 return comp
             import jax
             from .. import telemetry
+            from ..telemetry import compile_ledger as _ledger
+            from ..telemetry import memstats as _memstats
             from ..resilience import faults as _faults
             t0 = _now_us()
             _faults.check("compile")
@@ -233,8 +258,24 @@ class ModelEndpoint:
                 in_sds = tuple(
                     jax.ShapeDtypeStruct((bucket,) + s, dt)
                     for s, dt in zip(self.input_shapes, self._jnp_dtypes))
-                comp = self._infer_fn().lower(param_sds, *in_sds).compile()
+                comp = _ledger.lower_and_compile(
+                    self._infer_fn(), (param_sds,) + in_sds,
+                    site="serving_bucket",
+                    key={"endpoint": self.name, "bucket": bucket,
+                         "dtype": str(self._jnp_dtypes[0].__name__
+                                      if hasattr(self._jnp_dtypes[0],
+                                                 "__name__")
+                                      else self._jnp_dtypes[0]),
+                         "device": self._device_label()})
             self._execs[bucket] = comp
+            # attribute the executable's own device footprint (output +
+            # scratch + generated code; arguments belong to params/inputs)
+            mem = _ledger._memory_analysis(comp)
+            _memstats.register(
+                "serving", f"{self.name}.exec_b{bucket}", owner=self,
+                device=self._device_label(),
+                nbytes=sum(mem.get(k, 0) for k in
+                           ("output_bytes", "temp_bytes", "code_bytes")))
             self.stats.record_compile(_now_us() - t0)
             return comp
 

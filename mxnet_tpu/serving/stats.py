@@ -250,6 +250,8 @@ class EndpointStats:
         with self._lock:
             self.step.record(dur_us)
         self._m_step.observe(dur_us)
+        from ..telemetry import perf_sentinel as _perf_sentinel
+        _perf_sentinel.observe(f"serving_step.{self.name}", dur_us)
 
     def record_queue_wait(self, dur_us: float):
         with self._lock:

@@ -43,6 +43,7 @@ from .reporter import (PeriodicReporter, periodic_logger, dump,
 from .debug_server import DebugServer
 from .slo import SLOMonitor
 from . import flight, debug_server, slo
+from . import compile_ledger, memstats, perf_sentinel
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -53,6 +54,7 @@ __all__ = [
     "FlightRecorder", "event", "flight",
     "DebugServer", "debug_server",
     "SLOMonitor", "slo",
+    "compile_ledger", "memstats", "perf_sentinel",
     "counter", "gauge", "histogram", "snapshot", "snapshot_json",
     "prometheus_text", "lint_names",
 ]
@@ -75,10 +77,22 @@ def histogram(name, help="", labelnames=(), buckets=None) -> Histogram:
     return REGISTRY.histogram(name, help, labelnames, buckets)
 
 
+def _refresh_memory_gauges():
+    """On-demand gauge refresh for the operator's single-pane exports:
+    device memory_stats plus the memstats holder/attribution gauges (the
+    scrape IS the sampling tick — no background thread required)."""
+    sample_device_memory()
+    try:
+        memstats.reconcile()
+    except Exception:
+        pass
+
+
 def snapshot() -> dict:
     """Whole-registry snapshot as one JSON-able dict (refreshes device
-    memory gauges first — the snapshot is the operator's single pane)."""
-    sample_device_memory()
+    memory + attribution gauges first — the snapshot is the operator's
+    single pane)."""
+    _refresh_memory_gauges()
     return REGISTRY.snapshot()
 
 
@@ -89,7 +103,7 @@ def snapshot_json(**dumps_kw) -> str:
 
 def prometheus_text() -> str:
     """Prometheus text exposition of the default registry."""
-    sample_device_memory()
+    _refresh_memory_gauges()
     return REGISTRY.prometheus_text()
 
 

@@ -1,0 +1,365 @@
+"""Compile ledger — content-addressed observability for every XLA compile.
+
+Every AOT compile in this stack (serving bucket executables, the
+ParallelTrainStep autoformat path, the eager jit cache) emits one
+:class:`CompileRecord`: a sha256 fingerprint of the lowered StableHLO text
+(the content address ROADMAP item 2's persistent executable cache will key
+on), lowering + compile wall time, the backend's ``cost_analysis()`` flops /
+bytes and ``memory_analysis()`` argument/output/temp/code bytes where
+available, and the trigger key (endpoint/bucket/mesh/dtype/op) that explains
+*why* the compile happened.
+
+Records land in three places:
+
+  - a bounded in-memory ring (``recent()``) — the flight recorder snapshots
+    it into every bundle, and the ``/compilez`` debug page renders it live;
+  - the shared metrics registry (``mxtpu_compile_*`` families);
+  - when ``MXNET_COMPILE_LEDGER_DIR`` is set, an append-only JSONL file per
+    process (single ``O_APPEND`` write per record: atomic line appends even
+    with several processes sharing the directory).
+
+Duplicate detection is the point: a fingerprint seen before — in this
+process, or by any process that wrote into the ledger directory — means the
+wall time of the new compile was *re-spent* on a program the fleet already
+owned. That waste is quantified in
+``mxtpu_compile_duplicate_waste_seconds_total`` and is exactly the win a
+persistent executable cache would bank.
+
+Fingerprints are canonicalized (MLIR location metadata stripped) so the same
+function lowered at the same avals in two different processes hashes
+identically — the property the cross-subprocess stability test pins.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .metrics import REGISTRY
+
+__all__ = ["CompileRecord", "fingerprint_text", "lower_and_compile",
+           "record", "recent", "summary", "instrument_eager_jit",
+           "eager_active", "ledger_dir", "read_ledger", "reset"]
+
+_RECORDS = REGISTRY.counter(
+    "mxtpu_compile_records_total",
+    "CompileRecords emitted, by compile site (serving_bucket / train_step / "
+    "eager_jit).",
+    labelnames=("site",))
+_WALL = REGISTRY.counter(
+    "mxtpu_compile_wall_seconds_total",
+    "Wall seconds spent in XLA lowering/compilation, by site and phase "
+    "(lower / compile).",
+    labelnames=("site", "phase"))
+_DUPS = REGISTRY.counter(
+    "mxtpu_compile_duplicates_total",
+    "Compiles whose StableHLO fingerprint was already in the ledger — a "
+    "program the fleet had already paid to compile.",
+    labelnames=("site",))
+_DUP_WASTE = REGISTRY.counter(
+    "mxtpu_compile_duplicate_waste_seconds_total",
+    "Wall seconds re-spent compiling already-seen programs (the win a "
+    "persistent executable cache keyed by StableHLO hash would bank).")
+
+# ring larger than any MXNET_COMPILE_LEDGER_KEEP a page would ask for
+_RING_CAP = 512
+
+_LOCK = threading.Lock()
+_RING: deque = deque(maxlen=_RING_CAP)
+_SEEN: Dict[str, float] = {}        # fingerprint -> first-seen compile secs
+_SEEDED_DIR: Optional[str] = None   # ledger dir whose files seeded _SEEN
+_LOC_RE = re.compile(r"\s*loc\([^)]*\)")
+_LAST_ERRORS: Dict[str, str] = {}   # where -> last swallowed error
+
+
+def _note(where: str, exc: BaseException):
+    """Instrumentation must never fail the compile it observes — errors are
+    swallowed, but the last one per site stays inspectable here (an empty
+    ledger with a populated _LAST_ERRORS is a bug report)."""
+    _LAST_ERRORS[where] = f"{type(exc).__name__}: {exc}"
+
+
+def _cfg(name, default):
+    try:
+        from .. import config
+        return config.get(name, default)
+    except Exception as e:
+        _note("cfg", e)
+        return default
+
+
+def ledger_dir() -> str:
+    """The JSONL ledger directory ('' = in-memory only), read live."""
+    return str(_cfg("MXNET_COMPILE_LEDGER_DIR", "") or "")
+
+
+def eager_active() -> bool:
+    """Whether the eager jit cache should emit ledger records. 'auto' (the
+    default) follows the ledger directory: instrumenting the eager path AOT
+    compiles per aval signature, which is only worth doing when someone is
+    collecting the records."""
+    mode = str(_cfg("MXNET_COMPILE_LEDGER_EAGER", "auto")).lower()
+    if mode in ("1", "true", "yes", "on"):
+        return True
+    if mode in ("0", "false", "no", "off"):
+        return False
+    return bool(ledger_dir())
+
+
+class CompileRecord(dict):
+    """One compile, as a plain JSON-able dict (subclass only for the name)."""
+    __slots__ = ()
+
+
+def fingerprint_text(text: str) -> str:
+    """sha256 of canonicalized StableHLO text. MLIR location metadata
+    (``loc(...)`` / ``#loc`` lines) is stripped so the hash depends on the
+    program alone, not on where in the host source it was traced from —
+    two processes lowering the same function at the same avals agree."""
+    lines = [ln for ln in text.splitlines() if not ln.lstrip().startswith("#loc")]
+    canon = "\n".join(_LOC_RE.sub("", ln) for ln in lines)
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def _cost_analysis(compiled) -> Dict[str, float]:
+    """flops / bytes accessed from ``compiled.cost_analysis()``; {} when the
+    backend doesn't provide it (CPU often reports partial numbers)."""
+    out: Dict[str, float] = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if not isinstance(cost, dict):
+            return out
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed")):
+            v = cost.get(src)
+            if v is not None:
+                out[dst] = float(v)
+    except Exception as e:
+        _note("cost_analysis", e)
+    return out
+
+
+def _memory_analysis(compiled) -> Dict[str, int]:
+    """argument/output/temp/generated-code bytes from
+    ``compiled.memory_analysis()`` where the backend provides them."""
+    out: Dict[str, int] = {}
+    try:
+        mem = compiled.memory_analysis()
+        if mem is None:
+            return out
+        for attr, dst in (("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes"),
+                          ("temp_size_in_bytes", "temp_bytes"),
+                          ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                out[dst] = int(v)
+    except Exception as e:
+        _note("memory_analysis", e)
+    return out
+
+
+def _seed_seen(d: str):
+    """Load fingerprints already written into ``d`` by ANY process (once per
+    directory) so duplicate detection spans process restarts — the recompile
+    waste a cold start pays is visible, not reset."""
+    global _SEEDED_DIR
+    if _SEEDED_DIR == d:
+        return
+    _SEEDED_DIR = d
+    try:
+        names = [n for n in os.listdir(d)
+                 if n.startswith("ledger-") and n.endswith(".jsonl")]
+    except OSError:
+        return
+    for n in names:
+        try:
+            with open(os.path.join(d, n)) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    fp = rec.get("fingerprint")
+                    if fp and fp not in _SEEN:
+                        _SEEN[fp] = float(rec.get("compile_s", 0.0) or 0.0)
+        except OSError:
+            continue
+
+
+def _append_jsonl(d: str, rec: Dict):
+    """One O_APPEND write of one line: atomic for the short records we write
+    even when multiple processes share the ledger directory."""
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"ledger-{os.getpid()}.jsonl")
+        data = (json.dumps(rec, sort_keys=True) + "\n").encode("utf-8")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass          # a broken disk must not take down the compile it logs
+
+
+def record(site: str, fingerprint: Optional[str], lower_s: float,
+           compile_s: float, key: Optional[Dict[str, Any]] = None,
+           compiled=None) -> CompileRecord:
+    """Emit one CompileRecord (ring + metrics + JSONL). Never raises."""
+    rec = CompileRecord(
+        ts=time.time(), pid=os.getpid(), site=str(site),
+        fingerprint=fingerprint,
+        lower_s=round(float(lower_s), 6), compile_s=round(float(compile_s), 6),
+        key={str(k): v for k, v in (key or {}).items()},
+        duplicate=False,
+    )
+    if compiled is not None:
+        rec.update(_cost_analysis(compiled))
+        rec.update(_memory_analysis(compiled))
+    d = ledger_dir()
+    with _LOCK:
+        if d:
+            _seed_seen(d)
+        if fingerprint is not None:
+            if fingerprint in _SEEN:
+                rec["duplicate"] = True
+            else:
+                _SEEN[fingerprint] = rec["lower_s"] + rec["compile_s"]
+        _RING.append(rec)
+    try:
+        _RECORDS.labels(rec["site"]).inc()
+        _WALL.labels(rec["site"], "lower").inc(rec["lower_s"])
+        _WALL.labels(rec["site"], "compile").inc(rec["compile_s"])
+        if rec["duplicate"]:
+            _DUPS.labels(rec["site"]).inc()
+            _DUP_WASTE.inc(rec["lower_s"] + rec["compile_s"])
+    except Exception as e:
+        _note("metrics", e)
+    if d:
+        _append_jsonl(d, rec)
+    return rec
+
+
+def lower_and_compile(jfn, args, *, site: str,
+                      key: Optional[Dict[str, Any]] = None,
+                      kwargs: Optional[Dict] = None):
+    """The one-stop instrumentation for an AOT compile site: time
+    ``jfn.lower(*args)``, fingerprint the lowered StableHLO, time
+    ``.compile()``, emit the record, return the compiled executable.
+    Ledger failures never fail the compile."""
+    t0 = time.perf_counter()
+    lowered = jfn.lower(*args, **(kwargs or {}))
+    t1 = time.perf_counter()
+    fp = None
+    try:
+        fp = fingerprint_text(lowered.as_text())
+    except Exception as e:
+        _note("fingerprint", e)
+    t2 = time.perf_counter()
+    compiled = lowered.compile()
+    t3 = time.perf_counter()
+    try:
+        record(site, fp, lower_s=t1 - t0, compile_s=t3 - t2, key=key,
+               compiled=compiled)
+    except Exception as e:
+        _note("record", e)
+    return compiled
+
+
+def instrument_eager_jit(jfn, op_name: str):
+    """Wrap an eager ``jax.jit`` wrapper so each NEW aval signature compiles
+    through the ledger (AOT) instead of lazily inside the jit call. Installed
+    by ops/registry only when :func:`eager_active` — the default eager path
+    is untouched, so the dispatch-latency gate never pays for bookkeeping it
+    isn't using. Tracer inputs (op dispatched inside an outer trace) and
+    non-array inputs fall through to the plain jit wrapper."""
+    compiled: Dict[tuple, Any] = {}
+    lock = threading.Lock()
+
+    def wrapper(*args):
+        import jax
+        try:
+            if any(isinstance(a, jax.core.Tracer) for a in args):
+                return jfn(*args)
+            sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        except Exception:
+            return jfn(*args)
+        comp = compiled.get(sig)
+        if comp is None:
+            with lock:
+                comp = compiled.get(sig)
+                if comp is None:
+                    comp = lower_and_compile(jfn, args, site="eager_jit",
+                                             key={"op": op_name})
+                    compiled[sig] = comp
+        return comp(*args)
+
+    wrapper._ledger_instrumented = True
+    return wrapper
+
+
+def recent(k: Optional[int] = None) -> List[Dict]:
+    """The last ``k`` CompileRecords (default MXNET_COMPILE_LEDGER_KEEP),
+    oldest first."""
+    if k is None:
+        k = int(_cfg("MXNET_COMPILE_LEDGER_KEEP", 64))
+    with _LOCK:
+        items = list(_RING)
+    return [dict(r) for r in items[-max(0, k):]]
+
+
+def summary() -> Dict[str, float]:
+    """Process-lifetime totals over every record still in scope: compile
+    counts, distinct programs, duplicate count and re-spent seconds."""
+    with _LOCK:
+        items = list(_RING)
+    dups = [r for r in items if r.get("duplicate")]
+    return {
+        "compiles": len(items),
+        "distinct_fingerprints": len({r["fingerprint"] for r in items
+                                      if r.get("fingerprint")}),
+        "duplicates": len(dups),
+        "dup_waste_s": round(sum(r["lower_s"] + r["compile_s"]
+                                 for r in dups), 6),
+        "lower_s": round(sum(r["lower_s"] for r in items), 6),
+        "compile_s": round(sum(r["compile_s"] for r in items), 6),
+    }
+
+
+def read_ledger(d: Optional[str] = None) -> List[Dict]:
+    """Every record in the JSONL ledger directory (all processes), in file
+    order. Used by tools/compile_report.py."""
+    d = d or ledger_dir()
+    out: List[Dict] = []
+    if not d or not os.path.isdir(d):
+        return out
+    for n in sorted(os.listdir(d)):
+        if not (n.startswith("ledger-") and n.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(d, n)) as f:
+                for line in f:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    return out
+
+
+def reset():
+    """Forget ring + seen-set (tests; a changed ledger dir re-seeds)."""
+    global _SEEDED_DIR
+    with _LOCK:
+        _RING.clear()
+        _SEEN.clear()
+        _SEEDED_DIR = None

@@ -14,6 +14,10 @@ production RPC server grows eventually:
   /tracez     recent finished spans grouped by trace id (flight span ring)
   /flightz    flight bundle listing; ``/flightz?dump=1`` triggers a manual
               bundle right now
+  /compilez   compile-ledger view: totals per site, duplicate-fingerprint
+              waste, recent records ranked by compile seconds
+  /memz       HBM attribution: device memory_stats() (refreshed on demand)
+              reconciled against the registered holder table
 
 The handler only ever *reads* — registry snapshots, ring copies, ``health()``
 dicts — so scraping cannot perturb serving beyond a snapshot's cost, and
@@ -240,6 +244,93 @@ def flightz(do_dump: bool = False) -> Dict:
     return body
 
 
+def _fmt_bytes(v: float) -> str:
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{v:.0f}B"
+        v /= 1024.0
+    return f"{v:.1f}GiB"
+
+
+def compilez(top_n: int = 20) -> str:
+    """Compile-ledger page: process totals, per-site breakdown, duplicate
+    waste, and the recent records ranked by compile seconds."""
+    from . import compile_ledger as _ledger
+    s = _ledger.summary()
+    records = _ledger.recent()
+    lines = [f"compilez  ts={time.strftime('%Y-%m-%d %H:%M:%S')} "
+             f"ledger_dir={_ledger.ledger_dir() or '(unset: ring-only)'}"]
+    lines.append("")
+    lines.append(
+        f"compiles={s['compiles']} distinct={s['distinct_fingerprints']} "
+        f"duplicates={s['duplicates']} dup_waste_s={s['dup_waste_s']:.3f} "
+        f"lower_s={s['lower_s']:.3f} compile_s={s['compile_s']:.3f}")
+    by_site: Dict[str, Dict[str, float]] = {}
+    for r in records:
+        st = by_site.setdefault(r["site"], {"n": 0, "dup": 0, "s": 0.0})
+        st["n"] += 1
+        st["dup"] += 1 if r.get("duplicate") else 0
+        st["s"] += r["lower_s"] + r["compile_s"]
+    if by_site:
+        lines.append("")
+        lines.append("== per site ==")
+        for site, st in sorted(by_site.items()):
+            lines.append(f"  {site}: n={st['n']:.0f} dup={st['dup']:.0f} "
+                         f"wall_s={st['s']:.3f}")
+    ranked = sorted(records, key=lambda r: r["lower_s"] + r["compile_s"],
+                    reverse=True)[:top_n]
+    if ranked:
+        lines.append("")
+        lines.append(f"== top {len(ranked)} by wall seconds ==")
+        for r in ranked:
+            fp = (r.get("fingerprint") or "?")[:12]
+            flops = r.get("flops")
+            ba = r.get("bytes_accessed")
+            ratio = (f" flops/byte={flops / ba:.2f}"
+                     if flops and ba else "")
+            dup = " DUP" if r.get("duplicate") else ""
+            key = ",".join(f"{k}={v}" for k, v in sorted(r["key"].items()))
+            lines.append(
+                f"  {fp} {r['site']:<14} lower={r['lower_s'] * 1e3:8.1f}ms "
+                f"compile={r['compile_s'] * 1e3:8.1f}ms{ratio}{dup} "
+                f"[{key}]")
+    return "\n".join(lines) + "\n"
+
+
+def memz() -> str:
+    """HBM-attribution page. Refreshes the device-memory gauges on demand
+    (the page IS the scrape) before reconciling the holder table."""
+    from .reporter import sample_device_memory
+    from . import memstats as _memstats
+    sample_device_memory()
+    bd = _memstats.breakdown()
+    lines = [f"memz  ts={time.strftime('%Y-%m-%d %H:%M:%S')}"]
+    lines.append("")
+    lines.append("== devices (memory_stats vs attributed holders) ==")
+    if not bd["devices"]:
+        lines.append("  (backend reports no memory_stats; holders only)")
+    for dev, st in sorted(bd["devices"].items()):
+        lines.append(
+            f"  {dev}: in_use={_fmt_bytes(st['bytes_in_use'])} "
+            f"peak={_fmt_bytes(st['peak_bytes_in_use'])} "
+            f"attributed={_fmt_bytes(st['attributed'])} "
+            f"unattributed={_fmt_bytes(st['unattributed'])}")
+    lines.append("")
+    lines.append(f"== holders (top {len(bd['holders'])} of "
+                 f"{bd['holders_total']}, "
+                 f"attributed={_fmt_bytes(bd['attributed_bytes'])}) ==")
+    for h in bd["holders"]:
+        dev = f" dev={h['device']}" if h["device"] else ""
+        lines.append(f"  {_fmt_bytes(h['bytes']):>10}  "
+                     f"peak={_fmt_bytes(h['peak_bytes']):>10}  "
+                     f"{h['subsystem']}/{h['holder']}{dev}")
+    if bd["holders_omitted_bytes"]:
+        lines.append(f"  ... omitted holders: "
+                     f"{_fmt_bytes(bd['holders_omitted_bytes'])}")
+    return "\n".join(lines) + "\n"
+
+
 def _safe_size(p: str) -> Optional[int]:
     import os
     try:
@@ -282,10 +373,15 @@ class _Handler(BaseHTTPRequestHandler):
                                ("1", "true", "yes"))
                 self._send(200, json.dumps(body, indent=1, default=repr),
                            ctype="application/json")
+            elif page == "/compilez":
+                self._send(200, compilez())
+            elif page == "/memz":
+                self._send(200, memz())
             elif page == "/":
                 self._send(200, "mxnet_tpu debug server\n"
                                 "pages: /metricsz /healthz /statusz "
-                                "/tracez /flightz[?dump=1]\n")
+                                "/tracez /flightz[?dump=1] /compilez "
+                                "/memz\n")
             else:
                 self._send(404, f"no such page: {page}\n")
                 return

@@ -54,7 +54,7 @@ _SUPPRESSED = REGISTRY.counter(
     "Trigger dumps suppressed by the per-kind MXNET_FLIGHT_MIN_INTERVAL_S "
     "rate limit (the event is still recorded in the ring).")
 
-_SCHEMA = 1
+_SCHEMA = 2   # 2: + compile_records / memstats sections (perf observability)
 _JSONABLE = (str, int, float, bool, type(None))
 
 
@@ -221,6 +221,17 @@ class FlightRecorder:
             knobs = {}
         env = {k: v for k, v in os.environ.items()
                if k.startswith(("MXNET_", "JAX_", "XLA_", "TPU_"))}
+        try:
+            from . import compile_ledger as _ledger
+            compile_records = _ledger.recent()
+            compile_summary = _ledger.summary()
+        except Exception:
+            compile_records, compile_summary = [], {}
+        try:
+            from . import memstats as _memstats
+            mem = _memstats.breakdown()
+        except Exception:
+            mem = {}
         return {
             "schema": _SCHEMA,
             "ts": time.time(),
@@ -230,6 +241,9 @@ class FlightRecorder:
             "events": self.recent_events(),
             "requests": self.recent_requests(),
             "metrics": REGISTRY.snapshot(),
+            "compile_records": {"summary": compile_summary,
+                                "records": compile_records},
+            "memstats": mem,
             "config": knobs,
             "fingerprint": {
                 "pid": os.getpid(),

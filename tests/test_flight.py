@@ -139,8 +139,9 @@ def test_dump_writes_complete_bundle(tmp_path):
     assert os.path.dirname(path) == str(tmp_path)
     assert os.path.basename(path).startswith("flight-")
     b = flight.load_bundle(path)
-    assert b["schema"] == 1
+    assert b["schema"] == 2
     assert b["trigger"] == {"kind": "unit_dump", "attrs": {"a": 1}}
+    assert "compile_records" in b and "memstats" in b  # schema-2 sections
     assert b["events"][-1]["kind"] == "boom"
     assert b["requests"][-1]["trace_id"] == "tid1"
     assert b["fingerprint"]["pid"] == os.getpid()
@@ -345,7 +346,8 @@ def test_concurrent_scrapes_do_not_perturb_serving():
                 return
 
     scrapers = [threading.Thread(target=scraper, args=(p,), daemon=True)
-                for p in ("/metricsz", "/statusz", "/metricsz", "/tracez")]
+                for p in ("/metricsz", "/statusz", "/metricsz", "/tracez",
+                          "/compilez", "/memz")]
     for t in scrapers:
         t.start()
     try:

@@ -2,7 +2,8 @@
 
 Pairs with ``mxnet_tpu.telemetry.flight``: when a trigger fires (watchdog
 stall, circuit OPEN, failover, numerics anomaly, SDC suspect, preemption,
-unhandled exception, or an explicit ``flight.dump()``), the process writes a
+device OOM, sustained perf regression, unhandled exception, or an explicit
+``flight.dump()``), the process writes a
 ``flight-*.json`` bundle to ``MXNET_FLIGHT_DIR``. This tool reads one from
 the outside and renders what an on-call human asks first:
 
@@ -37,6 +38,15 @@ def _fmt_us(v):
     if v >= 1e3:
         return f"{v / 1e3:.2f}ms"
     return f"{v:.0f}us"
+
+
+def _fmt_bytes(v):
+    v = float(v or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{v:.0f}B"
+        v /= 1024.0
+    return f"{v:.1f}GiB"
 
 
 def _fmt_ts(ts):
@@ -151,6 +161,45 @@ def render(bundle, path="", threads=False, max_traces=50):
                     lines.append(f"  {name}{{{label}}} = {v:g}")
         lines.append("  (full snapshot: pipe --json into "
                      "tools/metrics_dump.py)")
+
+    comp = bundle.get("compile_records", {})
+    if comp.get("records") or comp.get("summary", {}).get("compiles"):
+        s = comp.get("summary", {})
+        lines.append("")
+        lines.append(
+            f"== compile ledger ({s.get('compiles', 0)} compiles, "
+            f"{s.get('distinct_fingerprints', 0)} distinct, "
+            f"{s.get('duplicates', 0)} duplicate, "
+            f"dup waste {s.get('dup_waste_s', 0.0):.3f}s) ==")
+        ranked = sorted(comp.get("records", []),
+                        key=lambda r: r.get("lower_s", 0) + r.get("compile_s", 0),
+                        reverse=True)[:15]
+        for r in ranked:
+            fp = (r.get("fingerprint") or "?")[:12]
+            dup = " DUP" if r.get("duplicate") else ""
+            key = ",".join(f"{k}={v}" for k, v in
+                           sorted(r.get("key", {}).items()))
+            lines.append(
+                f"  {fp} {r.get('site', '?'):<14} "
+                f"lower={r.get('lower_s', 0) * 1e3:8.1f}ms "
+                f"compile={r.get('compile_s', 0) * 1e3:8.1f}ms{dup} [{key}]")
+
+    mem = bundle.get("memstats", {})
+    if mem.get("holders") or mem.get("devices"):
+        lines.append("")
+        lines.append(
+            f"== memstats ({mem.get('holders_total', 0)} holders, "
+            f"{_fmt_bytes(mem.get('attributed_bytes', 0))} attributed) ==")
+        for dev, st in sorted(mem.get("devices", {}).items()):
+            lines.append(
+                f"  device {dev}: in_use={_fmt_bytes(st.get('bytes_in_use', 0))} "
+                f"attributed={_fmt_bytes(st.get('attributed', 0))} "
+                f"unattributed={_fmt_bytes(st.get('unattributed', 0))}")
+        for h in mem.get("holders", []):
+            dev = f" dev={h['device']}" if h.get("device") else ""
+            lines.append(f"  {_fmt_bytes(h.get('bytes', 0)):>10}  "
+                         f"peak={_fmt_bytes(h.get('peak_bytes', 0)):>10}  "
+                         f"{h.get('subsystem')}/{h.get('holder')}{dev}")
 
     stacks = bundle.get("threads", {})
     if stacks:
