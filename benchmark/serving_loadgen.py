@@ -31,6 +31,20 @@ Env knobs (benchmark/_timing.py conventions; CLI flags override env):
                           combine with MXNET_TELEMETRY_DUMP_PATH for
                           periodic in-run dumps)
 
+r11 adds the generative phase (``--decode`` / SLG_DECODE=1): closed-loop
+autoregressive clients against a DecodeEndpoint + DecodeScheduler (paged KV
+cache, token-granularity continuous batching) split across a gold/bulk
+tenant pair. Reports decode tok/s/chip, client-observed inter-token
+p50/p95/p99 and KV-pool occupancy — the round-16 gate metrics.
+
+  SLG_DECODE=1            run the decode phase after the image sweep
+  SLG_DEC_CLIENTS=4       closed-loop decode clients (alternate gold/bulk)
+  SLG_DEC_SECONDS=        measured decode window (default SLG_SECONDS)
+  SLG_DEC_SEQ=64          max sequence length (prompt + generated)
+  SLG_DEC_NEW=16          max new tokens per request (budgets drawn from
+                          [SLG_DEC_NEW/2, SLG_DEC_NEW])
+  SLG_DTYPES=none         skip the image sweep (decode-only run)
+
 CLI:
   --tenants N       register N endpoints of the model (t0..tN-1) on ONE
                     server and emit a per-tenant latency table per level
@@ -168,6 +182,102 @@ def _run_level(server, names, img, np_dtype, conc, seconds, weights):
     return agg, per
 
 
+def _run_decode(args):
+    """Generative phase: a small TransformerLM behind the paged-KV decode
+    path under multi-tenant closed-loop load. One aggregate JSON row
+    (``"decode": true``) plus one per-tenant row; the aggregate carries the
+    round-16 gate metrics ``tok_s_chip`` and ``intertoken_p99_ms``."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon.model_zoo.bert import TransformerLM
+
+    conc, seconds = args.dec_clients, args.dec_seconds
+    seq_len, max_new = args.dec_seq, args.dec_new
+    onp.random.seed(0)
+    lm = TransformerLM(num_layers=2, units=32, hidden_size=64, num_heads=2,
+                       vocab_size=64, max_length=seq_len)
+    lm.initialize(mx.init.Normal(0.5))
+    eng = serving.DecodeEndpoint("loadgen_lm", lm, max_seq_len=seq_len,
+                                 max_batch_size=max(2, conc))
+    eng.warmup()
+    compiles_warm = eng.stats.snapshot()["counters"]["compiles"]
+    sched = serving.DecodeScheduler(eng, poll_s=0.002) \
+        .add_tenant("gold", slo_ms=20.0).add_tenant("bulk", slo_ms=200.0)
+    sched.start()
+
+    lock = threading.Lock()
+    per = {t: {"gaps_ms": [], "tokens": 0, "seqs": 0}
+           for t in ("gold", "bulk")}
+    stop_at = time.perf_counter() + seconds
+
+    def client(ci):
+        tenant = "gold" if ci % 2 == 0 else "bulk"
+        rng = onp.random.default_rng(100 + ci)
+        while time.perf_counter() < stop_at:
+            plen = int(rng.integers(2, max(3, seq_len // 4)))
+            prompt = [int(t) for t in rng.integers(1, 64, size=plen)]
+            budget = int(rng.integers(max(1, max_new // 2), max_new + 1))
+            stream = sched.submit(prompt, max_new_tokens=budget,
+                                  tenant=tenant)
+            gaps, n, t_prev = [], 0, None
+            for _ in stream:             # client-observed inter-token gaps
+                now = time.perf_counter()
+                if t_prev is not None:
+                    gaps.append((now - t_prev) * 1e3)
+                t_prev = now
+                n += 1
+            with lock:
+                per[tenant]["gaps_ms"].extend(gaps)
+                per[tenant]["tokens"] += n
+                per[tenant]["seqs"] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(conc)]
+    for t in threads:
+        t.start()
+    occ_peak = occ_sum = 0.0
+    occ_n = 0
+    while any(t.is_alive() for t in threads):
+        o = eng.pool.occupancy()
+        occ_peak, occ_sum, occ_n = max(occ_peak, o), occ_sum + o, occ_n + 1
+        time.sleep(0.02)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    sched.stop(drain=True)
+
+    chips = max(1, jax.device_count())
+    tokens = sum(v["tokens"] for v in per.values())
+    all_gaps = [g for v in per.values() for g in v["gaps_ms"]]
+    snap = eng.stats.snapshot()
+    assert snap["counters"]["compiles"] == compiles_warm, \
+        "decode traffic recompiled beyond warmup buckets"
+    row = {"decode": True, "clients": conc, "tenants": 2, "chips": chips,
+           "seconds": round(wall, 2),
+           "seqs": sum(v["seqs"] for v in per.values()), "tokens": tokens,
+           "tok_s_chip": round(tokens / wall / chips, 1)}
+    row.update({f"intertoken_{k}": v
+                for k, v in _percentiles(all_gaps).items()})
+    row.update({
+        "kv_occupancy_peak": round(occ_peak, 3),
+        "kv_occupancy_mean": round(occ_sum / max(1, occ_n), 3),
+        "kv_pages": eng.pool.num_pages - 1,
+        "prefill_p50_ms": round(snap["prefill"]["p50_us"] / 1e3, 2),
+        "step_p50_ms": round(snap["step"]["p50_us"] / 1e3, 2),
+        "compiles": compiles_warm,
+    })
+    print(json.dumps(row), flush=True)
+    for tenant in ("gold", "bulk"):     # the per-tenant inter-token table
+        trow = {"decode": True, "tenant": tenant,
+                "seqs": per[tenant]["seqs"],
+                "tokens": per[tenant]["tokens"]}
+        trow.update({f"intertoken_{k}": v
+                     for k, v in _percentiles(per[tenant]["gaps_ms"]).items()})
+        print(json.dumps(trow), flush=True)
+
+
 def _parse_args():
     env = os.environ.get
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -188,13 +298,25 @@ def _parse_args():
                    default=int(env("SLG_MAX_BATCH", 32)))
     p.add_argument("--timeout-ms", type=float,
                    default=float(env("SLG_TIMEOUT_MS", 5)))
+    p.add_argument("--decode", action="store_true",
+                   default=env("SLG_DECODE", "") not in ("", "0"),
+                   help="also run the generative decode phase "
+                        "(env SLG_DECODE=1)")
+    p.add_argument("--dec-clients", type=int,
+                   default=int(env("SLG_DEC_CLIENTS", 4)))
+    p.add_argument("--dec-seconds", type=float,
+                   default=float(env("SLG_DEC_SECONDS",
+                                     env("SLG_SECONDS", 5))))
+    p.add_argument("--dec-seq", type=int, default=int(env("SLG_DEC_SEQ", 64)))
+    p.add_argument("--dec-new", type=int, default=int(env("SLG_DEC_NEW", 16)))
     return p.parse_args()
 
 
 def main():
     args = _parse_args()
     model, img, classes = args.model, args.img, args.classes
-    dtypes = args.dtypes.split(",")
+    dtypes = [d for d in args.dtypes.split(",")
+              if d.strip() and d.strip() != "none"]
     conc_levels = [int(c) for c in str(args.conc).split(",")]
     seconds, max_batch = args.seconds, args.max_batch
     timeout_ms = args.timeout_ms
@@ -280,6 +402,9 @@ def main():
         }), flush=True)
         for name in names:
             serving.unregister(name)
+
+    if args.decode:
+        _run_decode(args)
 
     # one whole-process telemetry snapshot: serving latency histograms,
     # executable-cache hit/miss/compile-seconds, queue depth / occupancy,

@@ -22,7 +22,8 @@ TARGET_DTYPE_OPS = [
     "_contrib_interleaved_matmul_selfatt_valatt",
     "_contrib_interleaved_matmul_encdec_qk",
     "_contrib_interleaved_matmul_encdec_valatt", "multi_head_attention",
-    "flash_attention", "Embedding", "_contrib_SparseEmbedding",
+    "flash_attention", "single_query_attention", "Embedding",
+    "_contrib_SparseEmbedding",
 ]
 
 # numerically sensitive ops pinned to fp32

@@ -277,6 +277,41 @@ register("MXNET_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
          "drain; past it pending requests are abandoned (failed with "
          "ServerClosedError, counted in mxtpu_drain_abandoned_total) so a "
          "wedged endpoint can never hang shutdown forever.")
+register("MXNET_KV_PAGE_SIZE", 16, int,
+         "Paged KV cache: token positions per page. Small pages waste less "
+         "tail allocation per sequence but grow page tables; the page size "
+         "is baked into the decode executables' scatter/gather indexing, "
+         "so changing it recompiles.")
+register("MXNET_KV_POOL_PAGES", 256, int,
+         "Paged KV cache: total pages preallocated per pool (page 0 is the "
+         "reserved scratch page, so usable pages are N-1). Bounds the "
+         "number of concurrent sequences times their page footprint; "
+         "reserve() past it raises KVPoolExhausted and the scheduler keeps "
+         "the sequence queued.")
+register("MXNET_KV_DEFRAG_RATIO", 0.0, float,
+         "Paged KV cache: auto-compaction threshold on the fragmentation "
+         "spread (highest live page id / pages in use); free() triggers "
+         "defrag() when the spread exceeds it. 0 (default) disables "
+         "auto-compaction (explicit defrag() still works; compaction is a "
+         "pure page copy, bitwise-invisible to decode output).")
+register("MXNET_DECODE_MAX_BATCH", 8, int,
+         "Decode scheduler: max sequences advanced per decode step (top of "
+         "the pow2 decode-bucket ladder; every bucket compiles one "
+         "decode-step executable at warmup).")
+register("MXNET_DECODE_MAX_TOKENS", 64, int,
+         "Decode scheduler: default generation budget (max_new_tokens) for "
+         "submit() calls that do not specify one. The whole budget's KV "
+         "pages are reserved at admission, so a running sequence can never "
+         "hit pool exhaustion mid-generation.")
+register("MXNET_DECODE_STREAM_BUFFER", 64, int,
+         "TokenStream: buffered tokens per client stream before "
+         "backpressure pauses the sequence (pages kept, not stepped; "
+         "resumes when the consumer drains below half).")
+register("MXNET_DECODE_SLO_MS", 100.0, float,
+         "Decode scheduler: default per-tenant inter-token SLO "
+         "(milliseconds between consecutive tokens of one sequence) used "
+         "for EDF admission slack; tenants can override at add_tenant(). "
+         "0 disables deadline pricing (FIFO admission).")
 register("MXNET_FLIGHT_DIR", "", str,
          "FlightRecorder: directory where trigger-driven flight bundles "
          "(ring contents + metrics snapshot + knob/env fingerprint + "

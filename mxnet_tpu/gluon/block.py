@@ -302,13 +302,20 @@ def _trace_nd(data) -> NDArray:
     return arr
 
 
-def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
+def pure_apply(block, param_list, param_datas, input_datas, key, training=True,
+               method=None):
     """Run ``block`` as a pure function of explicit parameter arrays.
 
     Returns (out_datas, aux_values, aux_param_ids): aux_* capture in-graph
     state writes (BatchNorm moving stats) as extra outputs instead of side
     effects. The single tracing primitive shared by CachedOp (hybridize) and
-    parallel.ParallelTrainStep (multi-chip training)."""
+    parallel.ParallelTrainStep (multi-chip training).
+
+    ``method`` names an alternative entry point on ``block`` to trace instead
+    of the default forward — how the generative-serving engine compiles a
+    model's ``prefill_collect``/``decode_step`` views of the same parameters
+    (serving/generate/engine.py) without the block having to multiplex
+    behaviors through one forward signature."""
     from .. import autograd, tracing, random as _rng
     param_map = {id(p): _trace_nd(d) for p, d in zip(param_list, param_datas)}
     inputs = [d if isinstance(d, NDArray) else _trace_nd(d) for d in input_datas]
@@ -322,7 +329,10 @@ def pure_apply(block, param_list, param_datas, input_datas, key, training=True):
             _rng.push_key_source(tctx.take_key)
             try:
                 with autograd._RecordingStateScope(False, training):
-                    out = block._eager_forward(*inputs)
+                    if method is None:
+                        out = block._eager_forward(*inputs)
+                    else:
+                        out = getattr(block, method)(*inputs)
             finally:
                 _rng.pop_key_source()
     finally:

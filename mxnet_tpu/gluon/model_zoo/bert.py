@@ -22,29 +22,64 @@ from ..block import HybridBlock
 from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
 
 __all__ = ["BERTEncoder", "BERTModel", "BERTForPretraining", "BERTPretrainingLoss",
-           "bert_base", "bert_large", "shard_for_tensor_parallel"]
+           "TransformerLM", "bert_base", "bert_large",
+           "shard_for_tensor_parallel"]
 
 
 class SelfAttention(HybridBlock):
     """Multi-head self-attention with fused QKV (contrib/transformer.cc:650
-    interleaved_matmul_selfatt_qk/valatt semantics, one projection matmul)."""
+    interleaved_matmul_selfatt_qk/valatt semantics, one projection matmul).
 
-    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+    ``causal=True`` bakes the bottom-right causal mask into attention
+    (decoder-only stacks — TransformerLM); besides the full forward the block
+    then offers the two incremental-decode views the generative-serving
+    engine compiles: ``forward_collect`` (prefill: full causal pass that also
+    returns the per-position K/V for the cache) and ``attend_step`` (one
+    token against cached context via single_query_attention)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._heads = num_heads
+        self._causal = causal
         with self.name_scope():
             self.qkv = Dense(3 * units, flatten=False, in_units=units)
             self.proj = Dense(units, flatten=False, in_units=units)
             self.drop = Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def _project(self, F, x):
         qkv = self.qkv(x)
         q = F.slice_axis(qkv, axis=-1, begin=0, end=self._units)
         k = F.slice_axis(qkv, axis=-1, begin=self._units, end=2 * self._units)
         v = F.slice_axis(qkv, axis=-1, begin=2 * self._units, end=3 * self._units)
-        out = F.multi_head_attention(q, k, v, mask, heads=self._heads)
+        return q, k, v
+
+    def hybrid_forward(self, F, x, mask=None):
+        q, k, v = self._project(F, x)
+        out = F.multi_head_attention(q, k, v, mask, heads=self._heads,
+                                     causal=self._causal)
         return self.drop(self.proj(out))
+
+    def forward_collect(self, x, mask=None):
+        """Full forward that also returns the (B, S, H*D) key/value
+        projections — the prefill half of the KV-cache contract."""
+        F = _F()
+        q, k, v = self._project(F, x)
+        out = F.multi_head_attention(q, k, v, mask, heads=self._heads,
+                                     causal=self._causal)
+        return self.drop(self.proj(out)), k, v
+
+    def attend_step(self, x, k_ctx, v_ctx, lengths):
+        """One decode step: ``x`` (B, H*D) is the current token's hidden
+        state, ``k_ctx``/``v_ctx`` (B, L, H*D) the cached context, and
+        ``lengths`` (B,) the number of cached positions per row (== the
+        current token's position). Returns (out, k_new, v_new) so the caller
+        can append this step's K/V to the cache."""
+        F = _F()
+        q, k, v = self._project(F, x)
+        out = F.single_query_attention(q, k_ctx, v_ctx, k, v, lengths,
+                                       heads=self._heads)
+        return self.drop(self.proj(out)), k, v
 
 
 class PositionwiseFFN(HybridBlock):
@@ -76,10 +111,11 @@ class TransformerEncoderLayer(HybridBlock):
     """Post-LN transformer encoder layer (BERT convention)."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 activation="gelu_tanh", **kwargs):
+                 activation="gelu_tanh", causal=False, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.attention = SelfAttention(units, num_heads, dropout)
+            self.attention = SelfAttention(units, num_heads, dropout,
+                                           causal=causal)
             self.ln1 = LayerNorm(in_channels=units)
             self.ffn = PositionwiseFFN(units, hidden_size, dropout,
                                        activation=activation)
@@ -90,16 +126,35 @@ class TransformerEncoderLayer(HybridBlock):
         x = self.ln2(x + self.ffn(x))
         return x
 
+    def forward_collect(self, x, mask=None):
+        """Prefill view: the normal layer forward, plus this layer's
+        (B, S, H*D) K/V for the cache."""
+        a, k, v = self.attention.forward_collect(x, mask)
+        x = self.ln1(x + a)
+        x = self.ln2(x + self.ffn(x))
+        return x, k, v
+
+    def decode_step(self, x, k_ctx, v_ctx, lengths):
+        """Incremental view: one token (B, H*D) against cached context.
+        Residual + post-LN structure is identical to ``forward`` — every op
+        is per-row, which is what keeps batched decode bitwise equal to
+        serial decode (see serving/generate/)."""
+        a, k, v = self.attention.attend_step(x, k_ctx, v_ctx, lengths)
+        x = self.ln1(x + a)
+        x = self.ln2(x + self.ffn(x))
+        return x, k, v
+
 
 class BERTEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout=0.0,
-                 activation="gelu_tanh", **kwargs):
+                 activation="gelu_tanh", causal=False, **kwargs):
         super().__init__(**kwargs)
         self._layers = []
         with self.name_scope():
             for i in range(num_layers):
                 layer = TransformerEncoderLayer(units, hidden_size, num_heads,
-                                                dropout, activation=activation)
+                                                dropout, activation=activation,
+                                                causal=causal)
                 self.register_child(layer, f"layer{i}")
                 self._layers.append(layer)
 
@@ -204,6 +259,98 @@ class BERTPretrainingLoss(HybridBlock):
         nsp_logp = F.log_softmax(nsp_logits, axis=-1)
         nsp_loss = -F.pick(nsp_logp, nsp_labels.astype("float32"), axis=-1).mean()
         return mlm_loss + nsp_loss
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only causal language model over the BERT encoder stack.
+
+    The generative-serving model: same post-LN transformer layers with the
+    bottom-right causal mask baked in (``causal=True`` threads down to
+    ``multi_head_attention``), word + position embeddings, and an LM head
+    tied to the word embedding (the BERTForPretraining MLM idiom). Three
+    entry points share one parameter set:
+
+    - ``forward(tokens)``: full causal pass, (B, S) -> (B, S, V) logits —
+      the training/scoring path and the decode oracle's reference.
+    - ``prefill_collect(tokens)``: full causal pass that also returns every
+      layer's (B, S, H*D) K/V — compiled per sequence-length bucket as the
+      prefill executable.
+    - ``decode_step(ids, positions, *kv_ctx)``: one token per row against
+      cached context — compiled per batch bucket as the decode-step
+      executable. ``positions`` (B,) is both the position-embedding index
+      and the cached length (token t has t predecessors).
+
+    Both incremental entry points are traced through ``pure_apply(...,
+    method=...)`` by serving/generate/engine.py.
+    """
+
+    def __init__(self, num_layers=2, units=64, hidden_size=128, num_heads=2,
+                 vocab_size=256, max_length=128, dropout=0.0,
+                 activation="gelu_tanh", **kwargs):
+        super().__init__(**kwargs)
+        self.num_layers = num_layers
+        self.units = units
+        self.num_heads = num_heads
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units)
+            self.position_embed = Embedding(max_length, units)
+            self.embed_ln = LayerNorm(in_channels=units)
+            self.embed_drop = Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers, units, hidden_size,
+                                       num_heads, dropout,
+                                       activation=activation, causal=True)
+
+    def _embed_w(self, h):
+        return self.word_embed.weight.data(
+            h.context if hasattr(h, "context") else None)
+
+    def forward(self, tokens):
+        F = _F()
+        S = tokens.shape[1]
+        positions = F.arange(0, S, dtype="int32")
+        h = self.word_embed(tokens) + self.position_embed(positions)
+        h = self.embed_drop(self.embed_ln(h))
+        h = self.encoder(h, None)
+        embed_w = self._embed_w(h)
+        return F.dot(h.reshape(-1, h.shape[-1]), embed_w.T) \
+            .reshape(h.shape[0], h.shape[1], self.vocab_size)
+
+    def prefill_collect(self, tokens):
+        """(B, S) tokens -> (logits (B, S, V), k_0, v_0, ..., k_{n-1},
+        v_{n-1}) with each k/v (B, S, H*D)."""
+        F = _F()
+        S = tokens.shape[1]
+        positions = F.arange(0, S, dtype="int32")
+        h = self.word_embed(tokens) + self.position_embed(positions)
+        h = self.embed_drop(self.embed_ln(h))
+        kvs = []
+        for layer in self.encoder._layers:
+            h, k, v = layer.forward_collect(h, None)
+            kvs.extend((k, v))
+        embed_w = self._embed_w(h)
+        logits = F.dot(h.reshape(-1, h.shape[-1]), embed_w.T) \
+            .reshape(h.shape[0], h.shape[1], self.vocab_size)
+        return (logits,) + tuple(kvs)
+
+    def decode_step(self, ids, positions, *kv_ctx):
+        """One decode step. ``ids``/``positions`` (B,) int32; ``kv_ctx`` is
+        ``(k_ctx_0, v_ctx_0, ...)`` per layer, each (B, L, H*D) gathered from
+        the KV pool. Returns (logits (B, V), k_new_0, v_new_0, ...) with
+        each new k/v (B, H*D) for the caller to scatter back into the
+        pool."""
+        F = _F()
+        h = self.word_embed(ids) + self.position_embed(positions)
+        h = self.embed_drop(self.embed_ln(h))
+        kvs = []
+        for i, layer in enumerate(self.encoder._layers):
+            h, k, v = layer.decode_step(h, kv_ctx[2 * i], kv_ctx[2 * i + 1],
+                                        positions)
+            kvs.extend((k, v))
+        embed_w = self._embed_w(h)
+        logits = F.dot(h, embed_w.T)
+        return (logits,) + tuple(kvs)
 
 
 def bert_base(vocab_size=30522, max_length=512, dropout=0.1, **kwargs):

@@ -14,6 +14,11 @@ named boundaries —
     ``preemption``        PreemptionGuard's poll point, once per guarded step
     ``numerics``          NumericsGuard's input shim, once per guarded step
     ``sdc``               NumericsGuard's SDC re-execution, once per verify
+    ``decode``            generative decode: the scheduler's step boundary
+                          and PagedKVPool.reserve (kinds ``decode_stall`` —
+                          a WorkerKilled that takes the decode worker down
+                          mid-generation — and ``kv_exhausted`` — a
+                          simulated out-of-pages reservation failure)
 
 The ``numerics``/``sdc`` kinds (``nan_grad``, ``loss_spike``, ``bad_batch``,
 ``sdc``) are never raised to user code: the NumericsGuard *consumes* them and
@@ -54,7 +59,7 @@ __all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
 
 #: boundaries where production code calls :func:`check`
 SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
-         "checkpoint_write", "preemption", "numerics", "sdc")
+         "checkpoint_write", "preemption", "numerics", "sdc", "decode")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -139,11 +144,18 @@ _KINDS = {
     "sdc": (("sdc",), False,
             "silent data corruption: re-executed step diverged "
             "(injected {kind} #{count} at {site})"),
+    "decode_stall": (("decode",), False,
+                     "simulated decode stall: generation worker "
+                     "unresponsive mid-sequence "
+                     "(injected {kind} #{count} at {site})"),
+    "kv_exhausted": (("decode",), True,
+                     "RESOURCE_EXHAUSTED: KV cache pool out of pages "
+                     "(injected {kind} #{count} at {site})"),
 }
 
 #: kinds that raise a dedicated exception class instead of FaultInjected
 _KIND_CLS = {"crash": SimulatedCrash, "preempt": PreemptionNotice,
-             "worker_kill": WorkerKilled}
+             "worker_kill": WorkerKilled, "decode_stall": WorkerKilled}
 
 _LOCK = threading.Lock()
 _ACTIVE: list = []          # the hot-path gate: empty list == harness off

@@ -70,21 +70,37 @@ RESILIENCE.md. Observability rides the telemetry registry: queue-wait and
 prep histograms, the prep/step overlap gauge, per-tenant shed counters, and
 ``stats()`` snapshots per-endpoint latency histograms, queue depth, batch
 occupancy (real vs padded rows) and executable-cache hit/compile counters.
+
+r11 adds the generative path (``serving.generate``): autoregressive decode
+with a paged KV cache and token-granularity continuous batching — a
+``DecodeEndpoint`` compiles two AOT executables per bucket (prefill by
+sequence length, decode-step by batch size), a ``DecodeScheduler`` re-forms
+the decode batch every token (EDF admission against per-tenant *inter-token*
+SLOs, lossless stream backpressure, failover that requeues partial
+sequences), and ``server.register_generator(engine)`` /
+``server.generate(name, prompt)`` expose it behind the InferenceServer
+facade with streaming ``TokenStream`` responses. Batched continuous decode
+is bitwise-equal to serial greedy decode (tier-1 oracle).
 """
 from __future__ import annotations
 
 from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
-from .errors import (HotSwapError, RequestTimeoutError, ServerClosedError,
-                     ServerOverloadError, ServingError)
+from .errors import (HotSwapError, KVPoolExhausted, RequestTimeoutError,
+                     ServerClosedError, ServerOverloadError, ServingError)
 from .router import Router, StepCostEWMA, Tenant
 from .server import InferenceServer
 from .supervisor import PoolSupervisor
 from . import bucketing
+from . import generate
+from .generate import (DecodeEndpoint, DecodeScheduler, PagedKVPool,
+                       TokenStream)
 
 __all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
            "get_endpoint", "list_endpoints", "unregister", "ServingError",
            "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
-           "HotSwapError", "Router", "StepCostEWMA", "Tenant", "bucketing"]
+           "HotSwapError", "KVPoolExhausted", "Router", "StepCostEWMA",
+           "Tenant", "bucketing", "generate", "DecodeEndpoint",
+           "DecodeScheduler", "PagedKVPool", "TokenStream"]
 
 
 def stats():

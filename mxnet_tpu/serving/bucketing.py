@@ -17,7 +17,8 @@ import numpy as onp
 
 from ..base import MXNetError
 
-__all__ = ["pow2_buckets", "bucket_for", "pad_rows", "validate_buckets"]
+__all__ = ["pow2_buckets", "seq_buckets", "bucket_for", "pad_rows",
+           "validate_buckets"]
 
 
 def validate_buckets(buckets: Sequence[int], max_batch_size: int
@@ -64,6 +65,34 @@ def pow2_buckets(max_batch_size: int) -> Tuple[int, ...]:
         b *= 2
     out.append(max_batch_size)
     return tuple(out)
+
+
+def seq_buckets(max_seq_len: int, min_bucket: int = 16,
+                ladder: Sequence[int] = None) -> Tuple[int, ...]:
+    """Sequence-length ladder for prefill bucketing.
+
+    Same contract as the batch ladder — each distinct prompt-length bucket
+    is one prefill executable, so the ladder bounds the AOT cache while
+    padding waste per prompt stays < 2x — but anchored at ``min_bucket``
+    instead of 1: a one-token prefill executable is useless (the decode-step
+    executable already covers single tokens) and sub-tile sequence lengths
+    pessimize the attention kernels. Doubles from ``min_bucket`` and is
+    capped at (and always includes) ``max_seq_len``. An explicit ``ladder``
+    skips generation and gets the same :func:`validate_buckets` dup /
+    ascending / largest-equals-max checks."""
+    if max_seq_len < 1:
+        raise MXNetError(f"max_seq_len must be >= 1, got {max_seq_len}")
+    if ladder is not None:
+        return validate_buckets(ladder, max_seq_len)
+    if min_bucket < 1:
+        raise MXNetError(f"min_bucket must be >= 1, got {min_bucket}")
+    out = []
+    b = min(min_bucket, max_seq_len)
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return validate_buckets(out, max_seq_len)
 
 
 def bucket_for(rows: int, buckets: Sequence[int]) -> int:

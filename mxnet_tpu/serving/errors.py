@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..base import MXNetError
 
 __all__ = ["ServingError", "ServerOverloadError", "RequestTimeoutError",
-           "ServerClosedError", "HotSwapError"]
+           "ServerClosedError", "HotSwapError", "KVPoolExhausted"]
 
 
 class ServingError(MXNetError):
@@ -35,3 +35,11 @@ class HotSwapError(ServingError):
     """A weight hot-swap was refused (corrupt/mismatched checkpoint) or its
     probe validation failed. The endpoint rolled back and keeps serving the
     previous weights — the swap never became client-visible."""
+
+
+class KVPoolExhausted(ServingError):
+    """The paged KV cache has no free pages for a new sequence's reservation.
+    Retryable by waiting: running sequences release pages as they finish, so
+    the decode scheduler keeps the sequence queued instead of failing it.
+    The message carries the ``RESOURCE_EXHAUSTED`` marker a real device OOM
+    carries, so message-based retry classifiers agree."""

@@ -1,0 +1,394 @@
+"""DecodeEndpoint: one generative model plus its paged KV pool and the two
+AOT executable families decode needs.
+
+Per the endpoint design (serving/endpoint.py), everything rides as
+executable *arguments* — params, token ids, page tables, and the KV pool
+arrays themselves — so the compiled programs are independent of weights and
+cache contents. Two families, both routed through
+``compile_ledger.lower_and_compile`` so the ledger's duplicate-fingerprint
+accounting covers decode traffic:
+
+- **prefill**, bucketed by sequence length (``seq_buckets`` ladder): one
+  full causal forward of a single prompt (``TransformerLM.prefill_collect``
+  traced via ``pure_apply(..., method=...)``), scattering every layer's K/V
+  into the sequence's pages and returning the first generated token.
+- **decode-step**, bucketed by batch size (pow2 ladder): one token for every
+  running sequence — gather each row's cached context through its page
+  table, run ``TransformerLM.decode_step`` (single_query_attention inside),
+  scatter the new K/V row, greedy-argmax the next token on device.
+
+Bitwise contract: every model op is per-row and masked lanes carry exactly
+zero softmax weight, so a row's output depends only on its own tokens and
+pages — not on batch composition, bucket size, physical page placement, or
+stale pool contents. That is what makes batched continuous decode
+bitwise-equal to one-sequence-at-a-time greedy decode (the tier-1 oracle).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ... import config as _config
+from ...base import Context, MXNetError, current_context
+from .. import bucketing
+from ..router import StepCostEWMA
+from .kv_cache import PagedKVPool, gather_ctx, write_prefill, write_step
+from .stats import DecodeStats
+
+__all__ = ["DecodeEndpoint"]
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
+
+
+class DecodeEndpoint:
+    """A named generative model with bucketed prefill/decode executables.
+
+    ``block`` must expose the incremental-decode protocol of
+    ``gluon.model_zoo.bert.TransformerLM``: ``num_layers``/``units``
+    attributes, ``prefill_collect(tokens)`` and
+    ``decode_step(ids, positions, *kv_ctx)``.
+
+    Device work (``prefill``/``decode_step``/``warmup``/pool mutation)
+    follows the serving single-dispatcher rule: one thread — the decode
+    scheduler's worker — runs it.
+    """
+
+    def __init__(self, name: str, block, *, max_seq_len: int = 128,
+                 max_batch_size: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 decode_buckets: Optional[Sequence[int]] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 ctx: Optional[Context] = None):
+        self.name = name
+        self.block = block
+        self.ctx = ctx if ctx is not None else current_context()
+        self.max_seq_len = int(max_seq_len)
+        if max_batch_size is None:
+            max_batch_size = int(_config.get("MXNET_DECODE_MAX_BATCH"))
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise MXNetError("max_batch_size must be >= 1")
+        if decode_buckets is None:
+            decode_buckets = bucketing.pow2_buckets(self.max_batch_size)
+        self.decode_buckets = bucketing.validate_buckets(
+            decode_buckets, self.max_batch_size)
+        self.prefill_buckets = bucketing.seq_buckets(
+            self.max_seq_len, ladder=prefill_buckets)
+        max_len = getattr(block, "max_length", None)
+        if max_len is not None and self.max_seq_len > int(max_len):
+            raise MXNetError(
+                f"max_seq_len={self.max_seq_len} exceeds the model's "
+                f"position-embedding table ({max_len})")
+
+        self.stats = DecodeStats(name)
+        self.step_cost = StepCostEWMA()      # per decode batch bucket, us
+        self.prefill_cost = StepCostEWMA()   # per prefill seq bucket, us
+        self._lock = threading.Lock()
+        self._prefill_execs: Dict[int, object] = {}
+        self._decode_execs: Dict[int, object] = {}
+        self._pf_jfn = None
+        self._dec_jfn = None
+        self._probe()
+        self.pool = PagedKVPool(name, int(block.num_layers),
+                                int(block.units), self.max_seq_len,
+                                page_size=page_size, num_pages=num_pages,
+                                dtype=self._param_datas()[0].dtype)
+
+    # ------------------------------------------------------------------
+    def _probe(self):
+        """One eager prefill-bucket forward: triggers deferred parameter
+        init and validates the block's decode protocol."""
+        from ... import autograd
+        from ...ndarray.ndarray import NDArray
+        for attr in ("num_layers", "units", "prefill_collect", "decode_step"):
+            if not hasattr(self.block, attr):
+                raise MXNetError(
+                    f"decode endpoint {self.name!r}: block lacks the "
+                    f"incremental-decode protocol member {attr!r} "
+                    "(see gluon.model_zoo.bert.TransformerLM)")
+        dummy = NDArray(onp.zeros((1, self.prefill_buckets[0]), onp.int32),
+                        ctx=self.ctx)
+        with autograd._RecordingStateScope(False, False):
+            self.block(dummy)
+        self._params = list(self.block.collect_params().values())
+        from ...telemetry import memstats as _memstats
+        _memstats.register(
+            "serving", f"{self.name}.params", owner=self,
+            device=self._device_label(),
+            sizer=lambda ep: _memstats.nbytes_of(ep._param_datas()))
+
+    def _device_label(self) -> str:
+        try:
+            d = self.ctx.jax_device()
+            return f"{d.platform}:{d.id}"
+        except (AttributeError, RuntimeError, ValueError, ImportError):
+            return ""
+
+    def _donate_pools(self) -> bool:
+        """Donate the KV pool arguments on backends with buffer donation:
+        the pool is the largest recurring operand and every step consumes
+        the previous step's arrays, so donation makes the cache update
+        in-place on TPU/GPU. CPU warns on donation — keep it off there."""
+        try:
+            return self.ctx.jax_device().platform in ("tpu", "gpu")
+        except Exception:
+            return False
+
+    def _param_datas(self):
+        return tuple(p.data(self.ctx).data for p in self._params)
+
+    # ------------------------------------------------------------------
+    # traced programs
+    # ------------------------------------------------------------------
+    def _prefill_fn(self):
+        if self._pf_jfn is None:
+            import jax
+            import jax.numpy as jnp
+            from ...gluon.block import pure_apply
+            block, plist = self.block, self._params
+            page_size = int(_config.get("MXNET_KV_PAGE_SIZE")) \
+                if not hasattr(self, "pool") else self.pool.page_size
+
+            def prefill(param_datas, tokens, length, table, k_pool, v_pool):
+                outs, _, _ = pure_apply(block, plist, param_datas, (tokens,),
+                                        None, training=False,
+                                        method="prefill_collect")
+                logits = outs[0]                       # (1, S, V)
+                ks = jnp.stack(outs[1::2], 0)[:, 0]    # (layers, S, kv)
+                vs = jnp.stack(outs[2::2], 0)[:, 0]
+                k_pool = write_prefill(k_pool, ks, table[0], length[0],
+                                       page_size)
+                v_pool = write_prefill(v_pool, vs, table[0], length[0],
+                                       page_size)
+                next_id = jnp.argmax(logits[0, length[0] - 1]) \
+                    .astype(jnp.int32)
+                return next_id.reshape(1), k_pool, v_pool
+
+            donate = (4, 5) if self._donate_pools() else ()
+            self._pf_jfn = jax.jit(prefill, donate_argnums=donate)
+        return self._pf_jfn
+
+    def _decode_fn(self):
+        if self._dec_jfn is None:
+            import jax
+            import jax.numpy as jnp
+            from ...gluon.block import pure_apply
+            block, plist = self.block, self._params
+            page_size = self.pool.page_size
+            num_layers = int(block.num_layers)
+
+            def decode(param_datas, ids, positions, tables, valid,
+                       k_pool, v_pool):
+                gk = gather_ctx(k_pool, tables)    # (layers, B, L, kv)
+                gv = gather_ctx(v_pool, tables)
+                inputs = (ids, positions)
+                for i in range(num_layers):
+                    inputs = inputs + (gk[i], gv[i])
+                outs, _, _ = pure_apply(block, plist, param_datas, inputs,
+                                        None, training=False,
+                                        method="decode_step")
+                logits = outs[0]                   # (B, V)
+                ks = jnp.stack(outs[1::2], 0)      # (layers, B, kv)
+                vs = jnp.stack(outs[2::2], 0)
+                k_pool = write_step(k_pool, ks, tables, positions, valid,
+                                    page_size)
+                v_pool = write_step(v_pool, vs, tables, positions, valid,
+                                    page_size)
+                next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return next_ids, k_pool, v_pool
+
+            donate = (5, 6) if self._donate_pools() else ()
+            self._dec_jfn = jax.jit(decode, donate_argnums=donate)
+        return self._dec_jfn
+
+    # ------------------------------------------------------------------
+    # the bucketed executable caches
+    # ------------------------------------------------------------------
+    def _pool_sds(self):
+        import jax
+        return (jax.ShapeDtypeStruct(self.k_pool_shape, self.pool_dtype),
+                jax.ShapeDtypeStruct(self.k_pool_shape, self.pool_dtype))
+
+    @property
+    def k_pool_shape(self):
+        return tuple(self.pool.k_pool.shape)
+
+    @property
+    def pool_dtype(self):
+        return self.pool.k_pool.dtype
+
+    def _compile(self, cache, bucket, jfn, arg_sds, kind):
+        comp = cache.get(bucket)
+        if comp is not None:
+            return comp
+        with self._lock:
+            comp = cache.get(bucket)
+            if comp is not None:
+                return comp
+            import jax
+            from ... import telemetry
+            from ...resilience import faults as _faults
+            from ...telemetry import compile_ledger as _ledger
+            from ...telemetry import memstats as _memstats
+            t0 = _now_us()
+            _faults.check("compile")
+            param_sds = tuple(
+                jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                for a in self._param_datas())
+            with telemetry.span("serving.compile", endpoint=self.name,
+                                bucket=bucket, kind=kind):
+                comp = _ledger.lower_and_compile(
+                    jfn, (param_sds,) + arg_sds,
+                    site=f"decode_{kind}",
+                    key={"endpoint": self.name, "kind": kind,
+                         "bucket": bucket,
+                         "dtype": str(self.pool_dtype),
+                         "device": self._device_label()})
+            cache[bucket] = comp
+            mem = _ledger._memory_analysis(comp)
+            _memstats.register(
+                "serving", f"{self.name}.{kind}_b{bucket}", owner=self,
+                device=self._device_label(),
+                nbytes=sum(mem.get(k, 0) for k in
+                           ("output_bytes", "temp_bytes", "code_bytes")))
+            self.stats.record_compile()
+            _ = _now_us() - t0
+            return comp
+
+    def _get_prefill(self, seq_bucket: int):
+        import jax
+        import jax.numpy as jnp
+        P = self.pool.pages_per_seq
+        arg_sds = (jax.ShapeDtypeStruct((1, seq_bucket), jnp.int32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32),
+                   jax.ShapeDtypeStruct((1, P), jnp.int32)) + self._pool_sds()
+        return self._compile(self._prefill_execs, seq_bucket,
+                             self._prefill_fn(), arg_sds, "prefill")
+
+    def _get_decode(self, batch_bucket: int):
+        import jax
+        import jax.numpy as jnp
+        P = self.pool.pages_per_seq
+        arg_sds = (jax.ShapeDtypeStruct((batch_bucket,), jnp.int32),
+                   jax.ShapeDtypeStruct((batch_bucket,), jnp.int32),
+                   jax.ShapeDtypeStruct((batch_bucket, P), jnp.int32),
+                   jax.ShapeDtypeStruct((batch_bucket,), jnp.bool_)) \
+            + self._pool_sds()
+        return self._compile(self._decode_execs, batch_bucket,
+                             self._decode_fn(), arg_sds, "step")
+
+    def warmup(self, execute: bool = True) -> int:
+        """Compile every prefill and decode bucket (and by default execute
+        each once to seed the cost EWMAs). Warmup traffic only ever writes
+        scratch page 0 — zero page tables, zero valid masks — so it cannot
+        perturb a later sequence. Returns the number of executables built."""
+        import jax
+        n = 0
+        P = self.pool.pages_per_seq
+        for b in self.prefill_buckets:
+            fresh = b not in self._prefill_execs
+            comp = self._get_prefill(b)
+            if fresh:
+                n += 1
+                if execute:
+                    toks = onp.zeros((1, b), onp.int32)
+                    length = onp.asarray([1], onp.int32)
+                    table = onp.zeros((1, P), onp.int32)
+                    t0 = _now_us()
+                    out = comp(self._param_datas(), toks, length, table,
+                               self.pool.k_pool, self.pool.v_pool)
+                    jax.block_until_ready(out)
+                    self.pool.update_arrays(out[1], out[2])
+                    self.prefill_cost.observe(b, _now_us() - t0)
+        for b in self.decode_buckets:
+            fresh = b not in self._decode_execs
+            comp = self._get_decode(b)
+            if fresh:
+                n += 1
+                if execute:
+                    ids = onp.zeros((b,), onp.int32)
+                    pos = onp.zeros((b,), onp.int32)
+                    tables = onp.zeros((b, P), onp.int32)
+                    valid = onp.zeros((b,), bool)
+                    t0 = _now_us()
+                    out = comp(self._param_datas(), ids, pos, tables, valid,
+                               self.pool.k_pool, self.pool.v_pool)
+                    jax.block_until_ready(out)
+                    self.pool.update_arrays(out[1], out[2])
+                    self.step_cost.observe(b, _now_us() - t0)
+        return n
+
+    # ------------------------------------------------------------------
+    # execution (decode-worker thread only)
+    # ------------------------------------------------------------------
+    def prefill(self, prompt: Sequence[int], table: onp.ndarray) -> int:
+        """Run one prompt through its sequence-length bucket's prefill
+        executable; the sequence's pages fill with K/V and the first
+        generated token comes back."""
+        import jax
+        n = len(prompt)
+        S = bucketing.bucket_for(n, self.prefill_buckets)
+        comp = self._get_prefill(S)
+        toks = onp.zeros((1, S), onp.int32)
+        toks[0, :n] = prompt
+        length = onp.asarray([n], onp.int32)
+        t0 = _now_us()
+        next_id, k, v = comp(self._param_datas(), toks, length,
+                             table.reshape(1, -1), self.pool.k_pool,
+                             self.pool.v_pool)
+        out = int(onp.asarray(next_id)[0])     # sync point
+        self.pool.update_arrays(k, v)
+        dt = _now_us() - t0
+        self.prefill_cost.observe(S, dt)
+        self.stats.record_prefill(dt)
+        return out
+
+    def decode_step(self, rows: Sequence[Tuple[int, int, onp.ndarray]]
+                    ) -> Tuple[int, ...]:
+        """One batched decode step. ``rows`` is ``(input_id, position,
+        page_table)`` per running sequence; returns the next token id per
+        row. Padding rows (bucket fill) carry zero tables and a False valid
+        mask — their writes land on scratch page 0."""
+        n = len(rows)
+        B = bucketing.bucket_for(n, self.decode_buckets)
+        P = self.pool.pages_per_seq
+        ids = onp.zeros((B,), onp.int32)
+        pos = onp.zeros((B,), onp.int32)
+        tables = onp.zeros((B, P), onp.int32)
+        valid = onp.zeros((B,), bool)
+        for i, (tok, p, table) in enumerate(rows):
+            ids[i] = tok
+            pos[i] = p
+            tables[i] = table
+            valid[i] = True
+        comp = self._get_decode(B)
+        t0 = _now_us()
+        next_ids, k, v = comp(self._param_datas(), ids, pos, tables, valid,
+                              self.pool.k_pool, self.pool.v_pool)
+        out = onp.asarray(next_ids)            # sync point
+        self.pool.update_arrays(k, v)
+        dt = _now_us() - t0
+        self.step_cost.observe(B, dt)
+        self.stats.record_step(dt, n, B)
+        return tuple(int(x) for x in out[:n])
+
+    def snapshot(self) -> Dict:
+        return {
+            "endpoint": self.name,
+            "prefill_buckets": list(self.prefill_buckets),
+            "decode_buckets": list(self.decode_buckets),
+            "executables": len(self._prefill_execs) + len(self._decode_execs),
+            "stats": self.stats.snapshot(),
+            "kv_pool": self.pool.snapshot(),
+        }
+
+    def __repr__(self):
+        return (f"DecodeEndpoint({self.name!r}, "
+                f"prefill_buckets={self.prefill_buckets}, "
+                f"decode_buckets={self.decode_buckets})")

@@ -1,0 +1,326 @@
+"""Paged KV cache: preallocated on-device block pools for autoregressive decode.
+
+Contiguous per-sequence KV buffers force the classic serving dilemma:
+reserve max_seq_len per sequence (wasting most of it on short outputs) or
+reallocate as sequences grow (fragmenting HBM and recompiling shapes). The
+paged layout decouples the two — the pool preallocates a fixed grid of
+fixed-size pages ONCE, per-sequence page tables map logical positions to
+physical pages, and the decode executables take the pool arrays as
+*arguments* (the params-as-arguments lesson from PERF.md round 4), so the
+compiled prefill/decode-step programs are independent of pool contents and
+of which sequence owns which page.
+
+Layout: ``(num_layers, num_pages, page_size, kv_dim)`` per pool (one for K,
+one for V). **Page 0 is reserved as a scratch page** and never allocated:
+scatter writes for padded/invalid positions are routed to it, and padded
+page-table entries gather from it. Whatever garbage accumulates there is
+masked to an exactly-zero softmax weight before it can touch a real row
+(``_NEG_INF`` underflow — see ops/pallas/flash_attention.py
+``single_query_attention``), which is the property the batched-vs-serial
+bitwise decode oracle rests on.
+
+Host-side management (alloc/free/defrag, counters, the memstats holder) is
+in :class:`PagedKVPool`; the jit-side scatter/gather helpers
+(:func:`write_prefill`, :func:`write_step`, :func:`gather_ctx`) are pure
+functions traced into the compiled executables.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ... import config as _config
+from ... import telemetry as _telemetry
+from ...base import MXNetError
+from ...resilience import faults as _faults
+from ..errors import KVPoolExhausted
+
+__all__ = ["PagedKVPool", "KVPoolExhausted", "write_prefill", "write_step",
+           "gather_ctx"]
+
+_POOL_PAGES = _telemetry.gauge(
+    "mxtpu_kv_pool_pages",
+    "Usable pages preallocated in one paged KV pool (page 0, the scratch "
+    "page for masked writes, is excluded).",
+    labelnames=("pool",))
+_IN_USE = _telemetry.gauge(
+    "mxtpu_kv_pages_in_use",
+    "Pages currently owned by live sequence page tables.",
+    labelnames=("pool",))
+_ALLOCATED = _telemetry.counter(
+    "mxtpu_kv_pages_allocated_total",
+    "Pages handed out by reserve() over the pool's lifetime.",
+    labelnames=("pool",))
+_FREED = _telemetry.counter(
+    "mxtpu_kv_pages_freed_total",
+    "Pages returned by free() (sequence finished/cancelled/failed).",
+    labelnames=("pool",))
+_EXHAUSTED = _telemetry.counter(
+    "mxtpu_kv_pool_exhausted_total",
+    "reserve() calls refused for lack of free pages; the scheduler keeps "
+    "the sequence queued, so a climbing rate means the pool is sized below "
+    "the offered concurrency * sequence length.",
+    labelnames=("pool",))
+_DEFRAGS = _telemetry.counter(
+    "mxtpu_kv_defrags_total",
+    "Compaction passes run on the pool.", labelnames=("pool",))
+_DEFRAG_MOVED = _telemetry.counter(
+    "mxtpu_kv_defrag_pages_moved_total",
+    "Physical pages relocated by compaction passes.", labelnames=("pool",))
+
+
+# ---------------------------------------------------------------------------
+# jit-side helpers: pure functions over pool arrays, traced into the
+# prefill / decode-step executables
+# ---------------------------------------------------------------------------
+def write_prefill(pool, vals, table_row, length, page_size: int):
+    """Scatter one sequence's prefill projections into the pool.
+
+    ``pool`` (num_layers, num_pages, page_size, kv_dim); ``vals``
+    (num_layers, S, kv_dim) — per-position K (or V) for positions 0..S-1;
+    ``table_row`` (P,) int32 physical page ids (0-padded); ``length`` scalar
+    int32 — positions >= length are padding and their writes are routed to
+    scratch page 0 (where duplicate slots may land in any order; nothing
+    ever reads page 0 unmasked)."""
+    import jax.numpy as jnp
+    S = vals.shape[1]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    page = table_row[pos // page_size]
+    page = jnp.where(pos < length, page, 0)
+    slot = pos % page_size
+    return pool.at[:, page, slot, :].set(vals)
+
+
+def write_step(pool, vals, tables, positions, valid, page_size: int):
+    """Scatter one decode step's new K (or V) row per sequence.
+
+    ``vals`` (num_layers, B, kv_dim); ``tables`` (B, P) int32;
+    ``positions`` (B,) int32 — the lane each row's new token occupies;
+    ``valid`` (B,) bool — padding rows route to scratch page 0."""
+    import jax.numpy as jnp
+    B = tables.shape[0]
+    page = tables[jnp.arange(B), positions // page_size]
+    page = jnp.where(valid, page, 0)
+    slot = positions % page_size
+    return pool.at[:, page, slot, :].set(vals)
+
+
+def gather_ctx(pool, tables):
+    """Gather each sequence's cached context: (num_layers, num_pages,
+    page_size, kv_dim) x (B, P) -> (num_layers, B, P*page_size, kv_dim),
+    lane j = position j. Padding table entries gather scratch page 0 —
+    masked by the attention length mask before use."""
+    g = pool[:, tables]                      # (L, B, P, page, kv)
+    L, B = g.shape[0], g.shape[1]
+    return g.reshape(L, B, g.shape[2] * g.shape[3], g.shape[4])
+
+
+# ---------------------------------------------------------------------------
+# host-side pool management
+# ---------------------------------------------------------------------------
+class PagedKVPool:
+    """Preallocated paged KV storage plus its free-list allocator.
+
+    Thread-safety: all mutators take the internal lock, but array
+    replacement (``update_arrays``) and ``defrag`` follow the serving
+    single-dispatcher rule — only the decode worker thread runs them, so a
+    step never races a compaction.
+    """
+
+    def __init__(self, name: str, num_layers: int, kv_dim: int,
+                 max_seq_len: int, page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None, dtype="float32"):
+        import jax.numpy as jnp
+        if page_size is None:
+            page_size = int(_config.get("MXNET_KV_PAGE_SIZE"))
+        if num_pages is None:
+            num_pages = int(_config.get("MXNET_KV_POOL_PAGES"))
+        if page_size < 1 or num_pages < 2:
+            raise MXNetError(
+                f"KV pool needs page_size >= 1 and num_pages >= 2 (one "
+                f"scratch + one usable), got page_size={page_size}, "
+                f"num_pages={num_pages}")
+        self.name = name
+        self.num_layers = int(num_layers)
+        self.kv_dim = int(kv_dim)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.max_seq_len = int(max_seq_len)
+        self.pages_per_seq = int(math.ceil(self.max_seq_len / self.page_size))
+        if self.pages_per_seq > self.num_pages - 1:
+            raise MXNetError(
+                f"KV pool {name!r}: one sequence needs {self.pages_per_seq} "
+                f"pages for max_seq_len={max_seq_len} but the pool only has "
+                f"{self.num_pages - 1} usable pages")
+        shape = (self.num_layers, self.num_pages, self.page_size, self.kv_dim)
+        self.k_pool = jnp.zeros(shape, dtype=dtype)
+        self.v_pool = jnp.zeros(shape, dtype=dtype)
+        self._lock = threading.Lock()
+        # LIFO free list, page 0 (scratch) excluded for the pool's lifetime
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._tables: Dict[int, List[int]] = {}
+        self._m_pages = _POOL_PAGES.labels(name)
+        self._m_in_use = _IN_USE.labels(name)
+        self._m_alloc = _ALLOCATED.labels(name)
+        self._m_freed = _FREED.labels(name)
+        self._m_exhausted = _EXHAUSTED.labels(name)
+        self._m_defrags = _DEFRAGS.labels(name)
+        self._m_moved = _DEFRAG_MOVED.labels(name)
+        self._m_pages.set(self.num_pages - 1)
+        self._m_in_use.set(0)
+        from ...telemetry import memstats as _memstats
+        _memstats.register(
+            "serving", f"{name}.kv_pool", owner=self,
+            device=self._device_label(),
+            sizer=lambda p: int(p.k_pool.nbytes) + int(p.v_pool.nbytes))
+
+    def _device_label(self) -> str:
+        try:
+            d = next(iter(self.k_pool.devices()))
+            return f"{d.platform}:{d.id}"
+        except Exception:
+            return ""
+
+    # -- allocation ---------------------------------------------------------
+    def reserve(self, sid: int, total_tokens: int):
+        """Grow ``sid``'s page table to cover ``total_tokens`` positions.
+
+        The decode scheduler reserves a sequence's WHOLE budget
+        (prompt + max_new_tokens) at admission, so exhaustion can only
+        happen here — never mid-decode — and a refused sequence simply
+        stays queued with nothing to unwind. Raises
+        :class:`KVPoolExhausted` when the free list is short (including the
+        injected ``kv_exhausted`` fault, which simulates exactly that)."""
+        if total_tokens > self.max_seq_len:
+            raise MXNetError(
+                f"sequence {sid} wants {total_tokens} tokens, pool "
+                f"{self.name!r} is laid out for max_seq_len="
+                f"{self.max_seq_len}")
+        need = int(math.ceil(total_tokens / self.page_size))
+        try:
+            _faults.check("decode")
+        except _faults.FaultInjected as e:
+            if e.kind == "kv_exhausted":
+                self._m_exhausted.inc()
+                raise KVPoolExhausted(str(e))
+            raise
+        with self._lock:
+            table = self._tables.setdefault(sid, [])
+            delta = need - len(table)
+            if delta <= 0:
+                return
+            if delta > len(self._free):
+                self._m_exhausted.inc()
+                raise KVPoolExhausted(
+                    f"RESOURCE_EXHAUSTED: KV pool {self.name!r} has "
+                    f"{len(self._free)} free pages, sequence {sid} needs "
+                    f"{delta} more (of {need} for {total_tokens} tokens)")
+            for _ in range(delta):
+                table.append(self._free.pop())
+            in_use = (self.num_pages - 1) - len(self._free)
+        self._m_alloc.inc(delta)
+        self._m_in_use.set(in_use)
+
+    def free(self, sid: int) -> int:
+        """Return ``sid``'s pages to the free list; pages are reused by later
+        reservations (the free -> realloc path the oracle test covers)."""
+        with self._lock:
+            table = self._tables.pop(sid, None)
+            if not table:
+                return 0
+            self._free.extend(reversed(table))
+            n = len(table)
+            in_use = (self.num_pages - 1) - len(self._free)
+        self._m_freed.inc(n)
+        self._m_in_use.set(in_use)
+        ratio = float(_config.get("MXNET_KV_DEFRAG_RATIO"))
+        if ratio > 0 and self.spread() > ratio:
+            self.defrag()
+        return n
+
+    def table(self, sid: int) -> onp.ndarray:
+        """``sid``'s page table padded with scratch-page zeros to the fixed
+        (pages_per_seq,) executable shape."""
+        out = onp.zeros((self.pages_per_seq,), onp.int32)
+        with self._lock:
+            pages = self._tables.get(sid, ())
+            out[:len(pages)] = pages
+        return out
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return (self.num_pages - 1) - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of usable pages owned by live sequences (0..1)."""
+        return self.pages_in_use / max(1, self.num_pages - 1)
+
+    def spread(self) -> float:
+        """Fragmentation proxy: highest allocated page id / pages in use.
+        1.0 means perfectly compact; large values mean live pages are
+        scattered across a mostly-empty pool."""
+        with self._lock:
+            used = [p for t in self._tables.values() for p in t]
+            if not used:
+                return 1.0
+            return max(used) / len(used)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            used = (self.num_pages - 1) - len(self._free)
+            return {
+                "pool": self.name,
+                "pages": self.num_pages - 1,
+                "page_size": self.page_size,
+                "in_use": used,
+                "occupancy": used / max(1, self.num_pages - 1),
+                "sequences": len(self._tables),
+                "pages_per_seq": self.pages_per_seq,
+                "bytes": int(self.k_pool.nbytes) + int(self.v_pool.nbytes),
+            }
+
+    # -- engine hooks -------------------------------------------------------
+    def update_arrays(self, k_pool, v_pool):
+        """Install the pool arrays a compiled step returned (worker thread
+        only — the single-dispatcher rule, so no lock: defrag() and this
+        never run concurrently)."""
+        self.k_pool = k_pool    # mxlint: disable=CONC200
+        self.v_pool = v_pool    # mxlint: disable=CONC200
+
+    def defrag(self) -> int:
+        """Compact live pages down to the lowest physical ids.
+
+        Page-granular allocation never *functionally* fragments (any free
+        page serves any reservation), so this is an optional compaction that
+        keeps the high-numbered region of the pool untouched — gathers stay
+        cache-local and the tail could be released to a resize. The move is
+        a single gather+scatter copy (no arithmetic), so decode output
+        stays bitwise identical across a compaction. Worker-thread only.
+        Returns the number of pages moved."""
+        import jax.numpy as jnp
+        with self._lock:
+            order = sorted(
+                (p, sid, i)
+                for sid, t in self._tables.items() for i, p in enumerate(t))
+            moves = [(old, new + 1, sid, i)
+                     for new, (old, sid, i) in enumerate(order)
+                     if old != new + 1]
+            if moves:
+                old_ids = jnp.asarray([m[0] for m in moves], jnp.int32)
+                new_ids = jnp.asarray([m[1] for m in moves], jnp.int32)
+                self.k_pool = self.k_pool.at[:, new_ids].set(
+                    self.k_pool[:, old_ids])
+                self.v_pool = self.v_pool.at[:, new_ids].set(
+                    self.v_pool[:, old_ids])
+                for old, new, sid, i in moves:
+                    self._tables[sid][i] = new
+            n_used = len(order)
+            self._free = list(range(self.num_pages - 1, n_used, -1))
+        self._m_defrags.inc()
+        self._m_moved.inc(len(moves))
+        return len(moves)

@@ -185,6 +185,7 @@ class InferenceServer:
         self._drain_timeout_s = float(
             drain_timeout_s if drain_timeout_s is not None
             else _config.get("MXNET_SERVING_DRAIN_TIMEOUT_S"))
+        self._generators: Dict[str, object] = {}   # name -> DecodeScheduler
 
     # ------------------------------------------------------------------
     # endpoint management
@@ -227,6 +228,49 @@ class InferenceServer:
         if warmup:
             endpoint.warmup()
         return endpoint
+
+    def register_generator(self, engine, warmup: bool = True,
+                           tenants: Optional[Dict[str, float]] = None,
+                           default_slo_ms: Optional[float] = None):
+        """Attach a generative :class:`~.generate.DecodeEndpoint` behind its
+        own continuous-batching DecodeScheduler (the decode loop owns its
+        device work — it does not ride the request-batching worker).
+
+        ``tenants`` maps tenant name -> inter-token SLO in ms/token (a
+        ``default`` tenant always exists). With ``warmup`` every prefill and
+        decode bucket compiles now and the step-cost EWMAs are seeded, so no
+        sequence pays first-compile latency. Starts with the server (or
+        immediately if the server is running); returns the scheduler."""
+        from .generate import DecodeScheduler
+        with self._cond:
+            if engine.name in self._generators:
+                raise MXNetError(
+                    f"generator {engine.name!r} already registered")
+        sched = DecodeScheduler(engine, default_slo_ms=default_slo_ms)
+        for tname, slo_ms in (tenants or {}).items():
+            sched.add_tenant(tname, slo_ms)
+        if warmup:
+            engine.warmup()
+        with self._cond:
+            self._generators[engine.name] = sched
+            running = self._state == _RUNNING
+        if running:
+            sched.start()
+        return sched
+
+    def generate(self, name: str, prompt,
+                 max_new_tokens: Optional[int] = None,
+                 tenant: str = "default", eos_id: Optional[int] = None,
+                 on_token=None):
+        """Stream tokens from a registered generator: returns the
+        :class:`~.generate.TokenStream` for one queued sequence."""
+        with self._cond:
+            sched = self._generators.get(name)
+        if sched is None:
+            raise MXNetError(f"unknown generator {name!r}; registered: "
+                             f"{sorted(self._generators)}")
+        return sched.submit(prompt, max_new_tokens=max_new_tokens,
+                            tenant=tenant, eos_id=eos_id, on_token=on_token)
 
     def endpoints(self):
         with self._cond:
@@ -313,6 +357,9 @@ class InferenceServer:
             self._state = _RUNNING
             self._prepared.clear()
             self._spawn_threads()
+            gens = list(self._generators.values())
+        for g in gens:
+            g.start()
         _debug.attach(self)     # /healthz + /statusz see every live server
         return self
 
@@ -349,6 +396,10 @@ class InferenceServer:
         can hang shutdown or leave a client waiting forever. ``drain=False``
         fails everything immediately."""
         timeout = self._drain_timeout_s if timeout is None else float(timeout)
+        with self._cond:
+            gens = list(self._generators.values())
+        for g in gens:        # decode loops drain independently of the
+            g.stop(drain=drain, timeout=timeout)   # request-batching worker
         with self._cond:
             if self._state == _STOPPED and self._thread is None and \
                     self._prep_thread is None:
@@ -432,11 +483,15 @@ class InferenceServer:
             }
         worst = max((b.state() for b in breakers),
                     key=lambda s: _CIRCUIT_SEVERITY[s])
+        with self._cond:
+            generators = {n: g.snapshot()
+                          for n, g in self._generators.items()}
         return {"state": state,
                 "circuit": worst,
                 "breaker": self._breaker.snapshot(),
                 "tenants": {t.name: t.breaker.snapshot() for t in tenants},
                 "endpoints": endpoints,
+                "generators": generators,
                 "prep_overlap_ratio": self._overlap.ratio(),
                 "watchdog_stalls": self._watchdog.stalls,
                 "worker_epoch": self._epoch,

@@ -26,7 +26,15 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      continuous load with zero client errors plus a corrupt-checkpoint
      rollback.
 
-  4. NUMERICS SCENARIOS (``--scenario {nan_grad,bad_batch,sdc}``) — the r13
+  4. GENERATIVE SCENARIO (``--scenario decode``) — the r16 drill: a
+     decode_stall kills the generation worker with partially-generated
+     sequences in flight and a kv_exhausted bounces a KV reservation; the
+     failover must requeue the partial sequences (pages, position and
+     emitted tokens intact) and finish every stream bitwise-equal to a
+     fault-free serial greedy decode — no duplicated, no dropped tokens —
+     leaving a parseable flight bundle triggered by ``decode_failover``.
+
+  5. NUMERICS SCENARIOS (``--scenario {nan_grad,bad_batch,sdc}``) — the r13
      NumericsGuard drills: a 30-step run with injected NaN gradients must
      end BITWISE equal to a clean run trained on the same batches minus the
      skipped ones (detection is lagged — the guard reads its fused
@@ -624,9 +632,89 @@ def check_sdc(seed, steps=20, p=0.0, bundle_dir=None, in_dim=8, hidden=16,
             "replay_verdicts": verdicts, "ok": bool(ok)}
 
 
+def check_decode(seed, requests=6, p=0.0, max_new=18):
+    """SCENARIO decode: generative serving under mid-generation faults. A
+    ``decode_stall`` (WorkerKilled) takes the decode worker down with
+    partially-generated sequences in flight, and a ``kv_exhausted`` bounces
+    a reservation. The failover must requeue the partial sequences and
+    continue them on the respawned worker with NO duplicated and NO dropped
+    tokens: every stream's output must be bitwise-equal to a fault-free
+    serial greedy decode of the same prompt through the same executables."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import TransformerLM
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving.generate import DecodeEndpoint, DecodeScheduler
+
+    onp.random.seed(seed)
+    rng = onp.random.RandomState(seed)
+    lm = TransformerLM(num_layers=2, units=32, hidden_size=64, num_heads=2,
+                       vocab_size=50, max_length=64)
+    lm.initialize(mx.init.Normal(0.5))
+    eng = DecodeEndpoint(f"chaos_dec_{seed}", lm, max_seq_len=64,
+                         max_batch_size=4, page_size=8, num_pages=64)
+    eng.warmup()
+    prompts = [list(map(int, rng.randint(1, 49, size=rng.randint(1, 6))))
+               for _ in range(requests)]
+    budgets = [int(rng.randint(max_new // 2, max_new + 1))
+               for _ in range(requests)]
+
+    def serial(prompt, budget, sid):
+        eng.pool.reserve(sid, len(prompt) + budget)
+        toks = [eng.prefill(prompt, eng.pool.table(sid))]
+        pos = len(prompt)
+        for _ in range(budget - 1):
+            (t,) = eng.decode_step([(toks[-1], pos, eng.pool.table(sid))])
+            toks.append(t)
+            pos += 1
+        eng.pool.free(sid)
+        return toks
+
+    oracle = [serial(pr, b, 90000 + i)
+              for i, (pr, b) in enumerate(zip(prompts, budgets))]
+
+    sched = DecodeScheduler(eng, poll_s=0.02).add_tenant("gold", 5.0)
+    sched.start()
+    unclassified = []
+    try:
+        with faults.inject("decode_stall", at=(6,), times=1) as stall, \
+                faults.inject("kv_exhausted", at=(2,), times=1) as exh:
+            streams = [
+                sched.submit(pr, max_new_tokens=b,
+                             tenant="gold" if i % 2 else "default")
+                for i, (pr, b) in enumerate(zip(prompts, budgets))]
+            results = [None] * requests
+            for i, s in enumerate(streams):
+                try:
+                    results[i] = s.result(timeout=120)
+                except Exception as e:
+                    unclassified.append(repr(e))
+        counters = eng.stats.snapshot()["counters"]
+        pool_leak = eng.pool.pages_in_use
+    finally:
+        sched.stop()
+    # no dropped tokens (every stream ran to its budget) and no duplicated
+    # tokens (bitwise equality to the serial oracle covers both)
+    complete = all(r is not None and len(r) == b
+                   for r, b in zip(results, budgets))
+    bitwise = results == oracle
+    ok = (stall.fires >= 1 and exh.fires >= 1 and sched.failovers >= 1 and
+          counters["seq_requeued"] >= 1 and not unclassified and
+          complete and bitwise and pool_leak == 0)
+    return {"phase": "decode", "seed": seed, "requests": requests,
+            "stalls_fired": stall.fires, "exhaustions_fired": exh.fires,
+            "failovers": sched.failovers,
+            "requeued": counters["seq_requeued"],
+            "tokens_emitted": counters["tokens"],
+            "unclassified_errors": unclassified,
+            "all_sequences_complete": complete,
+            "outputs_bitwise_equal": bitwise,
+            "kv_pages_leaked": pool_leak, "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
-             "bad_batch": check_bad_batch, "sdc": check_sdc}
+             "bad_batch": check_bad_batch, "sdc": check_sdc,
+             "decode": check_decode}
 
 # the flight-recorder trigger each injected fault must leave behind (a clean
 # hot_swap is a structured event, not a dump trigger, so it has no entry)
@@ -636,6 +724,7 @@ EXPECTED_FLIGHT_TRIGGER = {
     "nan_grad": "numerics_anomaly",
     "bad_batch": "numerics_anomaly",
     "sdc": "sdc_suspect",
+    "decode": "decode_failover",
 }
 
 
@@ -697,6 +786,9 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
             elif name == "sdc":
                 res = check_flight_bundle(name, lambda: check_sdc(
                     seed, steps=max(10, steps)))
+            elif name == "decode":
+                res = check_flight_bundle(name, lambda: check_decode(
+                    seed, requests=max(4, requests // 8)))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

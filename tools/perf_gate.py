@@ -171,7 +171,9 @@ def measure_bench(env):
 
 def measure_loadgen(env):
     """serving_loadgen rows -> serving_img_s_c<N> / serving_p99_ms_c<N>,
-    plus the compile-ledger rollup fields."""
+    the generative-phase decode_tok_s_chip / decode_intertoken_p99_ms
+    (emitted when the env pins SLG_DECODE=1), plus the compile-ledger
+    rollup fields."""
     cmd = [sys.executable, os.path.join("benchmark", "serving_loadgen.py")]
     rc, out, err = _run(cmd, env)
     measured = {}
@@ -182,6 +184,13 @@ def measure_loadgen(env):
             for q in ("p95", "p99"):
                 if row.get(f"{q}_ms") is not None:
                     measured[f"serving_{q}_ms_c{c}"] = float(row[f"{q}_ms"])
+        if row.get("decode") and "tok_s_chip" in row and "tenant" not in row:
+            measured["decode_tok_s_chip"] = float(row["tok_s_chip"])
+            if row.get("intertoken_p99_ms") is not None:
+                measured["decode_intertoken_p99_ms"] = \
+                    float(row["intertoken_p99_ms"])
+            measured["decode_kv_occupancy_peak"] = \
+                float(row.get("kv_occupancy_peak", 0.0))
         if "compile_ledger" in row:
             cl = row["compile_ledger"]
             measured["serving_compile_dup_waste_s"] = float(
