@@ -45,12 +45,25 @@ p50/p95/p99 and KV-pool occupancy — the round-16 gate metrics.
                           [SLG_DEC_NEW/2, SLG_DEC_NEW])
   SLG_DTYPES=none         skip the image sweep (decode-only run)
 
+r17 adds the elasticity benchmark (``--restart``): restart-to-first-request
+time, cold (empty executable cache) vs warm (cache populated by the cold
+run). The harness spawns one subprocess per phase sharing an executable
+cache + compile ledger directory; each child builds the dense endpoint
+(and, with the decode phase enabled, the decode engine), starts an
+InferenceServer and times from process entry to the first served response.
+The parent asserts the warm child performed ZERO fresh compiles (every
+ledger record is a cache hit, the recompile-storm duplicate counter stays
+0) and that first-request outputs are bitwise-identical across phases,
+then emits the gate row ``{"restart_to_first_request_s": <warm>, ...}``.
+
 CLI:
   --tenants N       register N endpoints of the model (t0..tN-1) on ONE
                     server and emit a per-tenant latency table per level
   --mix w0,w1,...   client-traffic weights per tenant (default uniform)
   --slo-ms a,b,...  per-tenant scheduling SLO passed to register()
   --serial          pipeline=False (the pre-r6 prepare-then-step path)
+  --restart         run the cold/warm restart benchmark instead of the
+                    load sweep (uses the SLG_* model/size knobs)
   --conc / --seconds / --img / --max-batch / --timeout-ms / --dtypes
                     override the corresponding SLG_* env knobs
 
@@ -278,6 +291,146 @@ def _run_decode(args):
         print(json.dumps(trow), flush=True)
 
 
+def _run_restart_child(args, phase):
+    """One restart-benchmark phase in THIS process: build the dense (and
+    optionally decode) endpoints, start the server, serve one request each,
+    and report time-from-entry plus the compile-ledger split (fresh
+    compiles vs executable-cache hits). Weights and inputs are seeded so
+    the first-request outputs are bitwise-comparable across phases."""
+    import hashlib
+    t0 = time.perf_counter()
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving, telemetry
+
+    onp.random.seed(0)
+    net = _build_net(args.model, args.classes, args.img, "f32")
+    ep = serving.ModelEndpoint(f"{args.model}_restart", net,
+                               input_shapes=(3, args.img, args.img),
+                               dtype="float32",
+                               max_batch_size=args.max_batch)
+    server = serving.InferenceServer(batch_timeout_ms=args.timeout_ms,
+                                     max_queue=args.max_batch * 8)
+    server.register(ep)          # warmup: compiles cold, deserializes warm
+    server.start()
+    frame = onp.arange(3 * args.img * args.img, dtype="float32") \
+        .reshape(3, args.img, args.img) / (3 * args.img * args.img)
+    out = server.predict(ep.name, frame, timeout=120)
+    dense_t = time.perf_counter() - t0
+    dense_digest = hashlib.sha256(
+        onp.ascontiguousarray(out.asnumpy()).tobytes()).hexdigest()
+
+    dec_t = dec_digest = None
+    if args.decode:
+        from mxnet_tpu.gluon.model_zoo.bert import TransformerLM
+        onp.random.seed(0)
+        lm = TransformerLM(num_layers=2, units=32, hidden_size=64,
+                           num_heads=2, vocab_size=64,
+                           max_length=args.dec_seq)
+        lm.initialize(mx.init.Normal(0.5))
+        eng = serving.DecodeEndpoint("restart_lm", lm,
+                                     max_seq_len=args.dec_seq,
+                                     max_batch_size=2)
+        server.register_generator(eng)
+        toks = list(server.generate("restart_lm", [1, 2, 3, 4],
+                                    max_new_tokens=4))
+        dec_t = time.perf_counter() - t0
+        dec_digest = hashlib.sha256(
+            onp.asarray(toks, "int64").tobytes()).hexdigest()
+
+    cls = telemetry.compile_ledger.summary()
+    server.stop(drain=True)
+    serving.unregister(ep.name)
+    if args.decode:
+        serving.unregister("restart_lm")
+    print(json.dumps({
+        "restart_child": phase,
+        "restart_to_first_request_s": round(max(dense_t, dec_t or 0.0), 3),
+        "dense_first_s": round(dense_t, 3),
+        "decode_first_s": round(dec_t, 3) if dec_t is not None else None,
+        "compiles": cls["compiles"],
+        "cache_hits": cls["cache_hits"],
+        "fresh_compiles": cls["compiles"] - cls["cache_hits"],
+        "duplicates": cls["duplicates"],
+        "dense_digest": dense_digest,
+        "decode_digest": dec_digest,
+    }), flush=True)
+    return 0
+
+
+def _run_restart(args):
+    """Parent half of ``--restart``: run the child phase twice against one
+    shared executable-cache + ledger directory (cold populates, warm must
+    compile nothing) and emit the perf-gate row."""
+    import subprocess
+    import tempfile
+    cache_dir = tempfile.mkdtemp(prefix="slg-exec-cache-")
+    ledger_dir = tempfile.mkdtemp(prefix="slg-ledger-")
+    child_flags = ["--model", args.model, "--img", str(args.img),
+                   "--classes", str(args.classes),
+                   "--max-batch", str(args.max_batch),
+                   "--timeout-ms", str(args.timeout_ms),
+                   "--dec-seq", str(args.dec_seq),
+                   "--dec-new", str(args.dec_new)]
+    rows = {}
+    for phase in ("cold", "warm"):
+        env = dict(os.environ)
+        env["MXNET_EXEC_CACHE_DIR"] = cache_dir
+        env["MXNET_COMPILE_LEDGER_DIR"] = ledger_dir
+        # only AOT serving compiles are the contract; keep the eager jit
+        # cache un-instrumented so op-level compiles don't muddy the count
+        env["MXNET_COMPILE_LEDGER_EAGER"] = "0"
+        env["SLG_DECODE"] = "1" if args.decode else "0"
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--restart-child", phase] + child_flags
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
+        row = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if cand.get("restart_child") == phase:
+                    row = cand
+        if proc.returncode != 0 or row is None:
+            sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+            raise SystemExit(f"restart child ({phase}) failed "
+                             f"rc={proc.returncode}")
+        rows[phase] = row
+        print(json.dumps({"restart": phase,
+                          **{k: row[k] for k in
+                             ("restart_to_first_request_s", "dense_first_s",
+                              "decode_first_s", "compiles", "cache_hits",
+                              "fresh_compiles", "duplicates")}}),
+              flush=True)
+    cold, warm = rows["cold"], rows["warm"]
+    assert warm["fresh_compiles"] == 0, \
+        f"warm restart performed {warm['fresh_compiles']} fresh compiles " \
+        "(executable cache missed)"
+    assert warm["duplicates"] == 0, \
+        "warm restart tripped the recompile-storm counter " \
+        f"({warm['duplicates']} duplicates)"
+    assert warm["cache_hits"] == cold["compiles"], \
+        f"warm hit {warm['cache_hits']} entries but cold compiled " \
+        f"{cold['compiles']}"
+    for k in ("dense_digest", "decode_digest"):
+        assert cold[k] == warm[k], \
+            f"{k}: warm first-request output differs from cold " \
+            f"({cold[k]} vs {warm[k]})"
+    warm_s, cold_s = (warm["restart_to_first_request_s"],
+                      cold["restart_to_first_request_s"])
+    print(json.dumps({
+        "restart_to_first_request_s": warm_s,
+        "restart_cold_s": cold_s,
+        "restart_speedup": round(cold_s / warm_s, 2) if warm_s else None,
+        "warm_fresh_compiles": warm["fresh_compiles"],
+        "warm_cache_hits": warm["cache_hits"],
+        "outputs_bitwise_equal": True,
+    }), flush=True)
+    return 0
+
+
 def _parse_args():
     env = os.environ.get
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -309,11 +462,23 @@ def _parse_args():
                                      env("SLG_SECONDS", 5))))
     p.add_argument("--dec-seq", type=int, default=int(env("SLG_DEC_SEQ", 64)))
     p.add_argument("--dec-new", type=int, default=int(env("SLG_DEC_NEW", 16)))
+    p.add_argument("--restart", action="store_true",
+                   help="cold/warm restart-to-first-request benchmark "
+                        "instead of the load sweep")
+    p.add_argument("--restart-child", default="", help=argparse.SUPPRESS)
     return p.parse_args()
 
 
 def main():
     args = _parse_args()
+    if args.restart_child:
+        return _run_restart_child(args, args.restart_child)
+    if args.restart:
+        return _run_restart(args)
+    return _run_sweep(args)
+
+
+def _run_sweep(args):
     model, img, classes = args.model, args.img, args.classes
     dtypes = [d for d in args.dtypes.split(",")
               if d.strip() and d.strip() != "none"]

@@ -1,0 +1,13 @@
+"""mxnet_tpu.cache — persistent, content-addressed compiled-artifact caches.
+
+The first (and defining) member is :mod:`executable_cache`: serialized XLA
+executables keyed by (StableHLO fingerprint, device topology, runtime
+versions), stored on disk so a restarted or scaled-out replica starts
+compile-free. See ROADMAP item 2 and the "Elastic fleet runbook" in
+RESILIENCE.md.
+"""
+from __future__ import annotations
+
+from . import executable_cache
+
+__all__ = ["executable_cache"]

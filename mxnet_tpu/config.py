@@ -405,3 +405,41 @@ register("MXNET_PERF_WARMUP_N", 50, int,
          "Perf sentinel: observations per stream before the detector "
          "arms — compile-time outliers and cold caches train the "
          "baseline instead of firing it.")
+register("MXNET_EXEC_CACHE_DIR", "", str,
+         "Executable cache: directory for serialized compiled executables "
+         "(content-addressed by StableHLO fingerprint + device topology + "
+         "runtime versions; shareable across processes and hosts). Every "
+         "lower_and_compile() site checks it before compiling and "
+         "populates it after — a warm restart compiles nothing. Empty "
+         "disables the cache.")
+register("MXNET_EXEC_CACHE_MAX_BYTES", 1 << 30, int,
+         "Executable cache: byte budget for the on-disk store. After "
+         "every write the least-recently-used entries (payload mtime, "
+         "touched on hit) are evicted until the store fits. 0 disables "
+         "eviction.")
+register("MXNET_AUTOSCALE_MIN_REPLICAS", 1, int,
+         "Autoscaler: floor on the serving replica count — scale-down "
+         "never drains below it.")
+register("MXNET_AUTOSCALE_MAX_REPLICAS", 4, int,
+         "Autoscaler: ceiling on the serving replica count — scale-up "
+         "stops here however hard the SLO burns.")
+register("MXNET_AUTOSCALE_POLL_S", 1.0, float,
+         "Autoscaler: control-loop poll interval (seconds) between "
+         "signal reads (SLO burn rate + queue depth).")
+register("MXNET_AUTOSCALE_UP_N", 2, int,
+         "Autoscaler hysteresis: consecutive over-pressure polls required "
+         "before a scale-up (one hot poll never scales).")
+register("MXNET_AUTOSCALE_DOWN_N", 5, int,
+         "Autoscaler hysteresis: consecutive idle polls required before "
+         "a scale-down (draining a replica is the expensive direction).")
+register("MXNET_AUTOSCALE_COOLDOWN_S", 10.0, float,
+         "Autoscaler: minimum seconds between scaling actions — the "
+         "fleet settles (queues redistribute, burn windows refill) "
+         "before the next decision.")
+register("MXNET_AUTOSCALE_QUEUE_HIGH", 0.5, float,
+         "Autoscaler: queue-pressure scale-up threshold as a fraction of "
+         "the per-replica queue bound (pending rows / max rows, worst "
+         "endpoint, averaged over replicas).")
+register("MXNET_AUTOSCALE_QUEUE_LOW", 0.05, float,
+         "Autoscaler: queue-pressure floor below which (with no active "
+         "burn alert) idle polls count toward scale-down.")

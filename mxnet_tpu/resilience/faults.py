@@ -19,6 +19,11 @@ named boundaries —
                           a WorkerKilled that takes the decode worker down
                           mid-generation — and ``kv_exhausted`` — a
                           simulated out-of-pages reservation failure)
+    ``exec_cache``        executable_cache.load, before the digest verify
+                          (kind ``cache_poison`` — consumed by the cache:
+                          the entry's on-disk payload is truncated so the
+                          real sha256-verify fallback, not a shortcut,
+                          answers with a recompile)
 
 The ``numerics``/``sdc`` kinds (``nan_grad``, ``loss_spike``, ``bad_batch``,
 ``sdc``) are never raised to user code: the NumericsGuard *consumes* them and
@@ -59,7 +64,8 @@ __all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
 
 #: boundaries where production code calls :func:`check`
 SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
-         "checkpoint_write", "preemption", "numerics", "sdc", "decode")
+         "checkpoint_write", "preemption", "numerics", "sdc", "decode",
+         "exec_cache")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -150,6 +156,9 @@ _KINDS = {
                      "(injected {kind} #{count} at {site})"),
     "kv_exhausted": (("decode",), True,
                      "RESOURCE_EXHAUSTED: KV cache pool out of pages "
+                     "(injected {kind} #{count} at {site})"),
+    "cache_poison": (("exec_cache",), False,
+                     "executable cache entry poisoned on disk "
                      "(injected {kind} #{count} at {site})"),
 }
 

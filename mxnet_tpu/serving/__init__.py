@@ -84,6 +84,7 @@ is bitwise-equal to serial greedy decode (tier-1 oracle).
 """
 from __future__ import annotations
 
+from .autoscaler import Autoscaler, ServingPool
 from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
 from .errors import (HotSwapError, KVPoolExhausted, RequestTimeoutError,
                      ServerClosedError, ServerOverloadError, ServingError)
@@ -100,7 +101,8 @@ __all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
            "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
            "HotSwapError", "KVPoolExhausted", "Router", "StepCostEWMA",
            "Tenant", "bucketing", "generate", "DecodeEndpoint",
-           "DecodeScheduler", "PagedKVPool", "TokenStream"]
+           "DecodeScheduler", "PagedKVPool", "TokenStream", "ServingPool",
+           "Autoscaler"]
 
 
 def stats():

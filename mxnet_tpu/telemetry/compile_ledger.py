@@ -72,7 +72,8 @@ _RING_CAP = 512
 _LOCK = threading.Lock()
 _RING: deque = deque(maxlen=_RING_CAP)
 _SEEN: Dict[str, float] = {}        # fingerprint -> first-seen compile secs
-_SEEDED_DIR: Optional[str] = None   # ledger dir whose files seeded _SEEN
+_SCANNED: Dict[str, int] = {}       # ledger file path -> bytes consumed
+_SCANNED_DIR: Optional[str] = None  # ledger dir the offsets belong to
 _LOC_RE = re.compile(r"\s*loc\([^)]*\)")
 _LAST_ERRORS: Dict[str, str] = {}   # where -> last swallowed error
 
@@ -166,32 +167,44 @@ def _memory_analysis(compiled) -> Dict[str, int]:
     return out
 
 
-def _seed_seen(d: str):
-    """Load fingerprints already written into ``d`` by ANY process (once per
-    directory) so duplicate detection spans process restarts — the recompile
-    waste a cold start pays is visible, not reset."""
-    global _SEEDED_DIR
-    if _SEEDED_DIR == d:
-        return
-    _SEEDED_DIR = d
+def _rescan_seen(d: str):  # mxlint: disable=CONC200
+    """Fold fingerprints written into ``d`` by ANY process into ``_SEEN``
+    (caller holds ``_LOCK``). Incremental: each ledger file is consumed from
+    the byte offset the previous scan reached, so calling this on every
+    fingerprint miss stays O(new bytes) — sibling processes that wrote
+    *after* our first scan are still seen before a compile is (mis)judged
+    fresh. Only complete lines are consumed; a line still being appended is
+    left for the next scan."""
+    global _SCANNED_DIR
+    if _SCANNED_DIR != d:
+        _SCANNED_DIR = d
+        _SCANNED.clear()
     try:
         names = [n for n in os.listdir(d)
                  if n.startswith("ledger-") and n.endswith(".jsonl")]
     except OSError:
         return
     for n in names:
+        path = os.path.join(d, n)
+        off = _SCANNED.get(path, 0)
         try:
-            with open(os.path.join(d, n)) as f:
-                for line in f:
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    fp = rec.get("fingerprint")
-                    if fp and fp not in _SEEN:
-                        _SEEN[fp] = float(rec.get("compile_s", 0.0) or 0.0)
+            with open(path, "rb") as f:
+                f.seek(off)
+                chunk = f.read()
         except OSError:
             continue
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            continue
+        _SCANNED[path] = off + nl + 1
+        for line in chunk[:nl + 1].splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            fp = rec.get("fingerprint")
+            if fp and fp not in _SEEN:
+                _SEEN[fp] = float(rec.get("compile_s", 0.0) or 0.0)
 
 
 def _append_jsonl(d: str, rec: Dict):
@@ -212,25 +225,33 @@ def _append_jsonl(d: str, rec: Dict):
 
 def record(site: str, fingerprint: Optional[str], lower_s: float,
            compile_s: float, key: Optional[Dict[str, Any]] = None,
-           compiled=None) -> CompileRecord:
-    """Emit one CompileRecord (ring + metrics + JSONL). Never raises."""
+           compiled=None, cache_hit: bool = False) -> CompileRecord:
+    """Emit one CompileRecord (ring + metrics + JSONL). Never raises.
+
+    ``cache_hit=True`` marks an executable answered by the persistent cache
+    (``compile_s`` is then the deserialize time): such records are never
+    duplicates and never charge ``mxtpu_compile_duplicate_waste_seconds_total``
+    — nothing was re-spent, the fleet's copy was reused."""
     rec = CompileRecord(
         ts=time.time(), pid=os.getpid(), site=str(site),
         fingerprint=fingerprint,
         lower_s=round(float(lower_s), 6), compile_s=round(float(compile_s), 6),
         key={str(k): v for k, v in (key or {}).items()},
-        duplicate=False,
+        duplicate=False, cache_hit=bool(cache_hit),
     )
     if compiled is not None:
         rec.update(_cost_analysis(compiled))
         rec.update(_memory_analysis(compiled))
     d = ledger_dir()
     with _LOCK:
-        if d:
-            _seed_seen(d)
         if fingerprint is not None:
+            if fingerprint not in _SEEN and d:
+                # miss: re-scan sibling processes' ledger files before
+                # judging this fingerprint fresh (they may have compiled
+                # it after our last scan)
+                _rescan_seen(d)
             if fingerprint in _SEEN:
-                rec["duplicate"] = True
+                rec["duplicate"] = not rec["cache_hit"]
             else:
                 _SEEN[fingerprint] = rec["lower_s"] + rec["compile_s"]
         _RING.append(rec)
@@ -252,9 +273,11 @@ def lower_and_compile(jfn, args, *, site: str,
                       key: Optional[Dict[str, Any]] = None,
                       kwargs: Optional[Dict] = None):
     """The one-stop instrumentation for an AOT compile site: time
-    ``jfn.lower(*args)``, fingerprint the lowered StableHLO, time
-    ``.compile()``, emit the record, return the compiled executable.
-    Ledger failures never fail the compile."""
+    ``jfn.lower(*args)``, fingerprint the lowered StableHLO, consult the
+    persistent executable cache (``MXNET_EXEC_CACHE_DIR``), and only on a
+    miss time ``.compile()`` and populate the cache. Emits the record
+    (``cache_hit`` says which path ran) and returns the executable. Ledger
+    and cache failures never fail the compile."""
     t0 = time.perf_counter()
     lowered = jfn.lower(*args, **(kwargs or {}))
     t1 = time.perf_counter()
@@ -263,12 +286,31 @@ def lower_and_compile(jfn, args, *, site: str,
         fp = fingerprint_text(lowered.as_text())
     except Exception as e:
         _note("fingerprint", e)
+    compiled = None
+    ckey = None
     t2 = time.perf_counter()
-    compiled = lowered.compile()
+    if fp is not None:
+        try:
+            from ..cache import executable_cache as _xcache
+            if _xcache.enabled():
+                ckey = _xcache.build_key(fp, lowered, extra=key)
+                compiled = _xcache.load(ckey)
+        except Exception as e:
+            _note("exec_cache", e)
+            ckey = None
+    cache_hit = compiled is not None
+    if compiled is None:
+        compiled = lowered.compile()
     t3 = time.perf_counter()
+    if not cache_hit and ckey is not None:
+        try:
+            from ..cache import executable_cache as _xcache
+            _xcache.store(ckey, compiled)
+        except Exception as e:
+            _note("exec_cache_store", e)
     try:
         record(site, fp, lower_s=t1 - t0, compile_s=t3 - t2, key=key,
-               compiled=compiled)
+               compiled=compiled, cache_hit=cache_hit)
     except Exception as e:
         _note("record", e)
     return compiled
@@ -329,6 +371,7 @@ def summary() -> Dict[str, float]:
         "duplicates": len(dups),
         "dup_waste_s": round(sum(r["lower_s"] + r["compile_s"]
                                  for r in dups), 6),
+        "cache_hits": sum(1 for r in items if r.get("cache_hit")),
         "lower_s": round(sum(r["lower_s"] for r in items), 6),
         "compile_s": round(sum(r["compile_s"] for r in items), 6),
     }
@@ -357,9 +400,11 @@ def read_ledger(d: Optional[str] = None) -> List[Dict]:
 
 
 def reset():
-    """Forget ring + seen-set (tests; a changed ledger dir re-seeds)."""
-    global _SEEDED_DIR
+    """Forget ring + seen-set + scan offsets (tests; a changed ledger dir
+    re-scans from the top)."""
+    global _SCANNED_DIR
     with _LOCK:
         _RING.clear()
         _SEEN.clear()
-        _SEEDED_DIR = None
+        _SCANNED.clear()
+        _SCANNED_DIR = None

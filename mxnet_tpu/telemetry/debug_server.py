@@ -265,19 +265,36 @@ def compilez(top_n: int = 20) -> str:
     lines.append(
         f"compiles={s['compiles']} distinct={s['distinct_fingerprints']} "
         f"duplicates={s['duplicates']} dup_waste_s={s['dup_waste_s']:.3f} "
+        f"cache_hits={s.get('cache_hits', 0)} "
         f"lower_s={s['lower_s']:.3f} compile_s={s['compile_s']:.3f}")
+    try:
+        from ..cache import executable_cache as _xcache
+        cs = _xcache.stats()
+        if cs["enabled"]:
+            lines.append(
+                f"exec_cache: hits={cs['hits']} misses={cs['misses']} "
+                f"hit_rate={cs['hit_rate'] if cs['hit_rate'] is not None else '-'} "
+                f"stores={cs['stores']} evictions={cs['evictions']} "
+                f"bytes={_fmt_bytes(cs['bytes'])} "
+                f"deserialize_s={cs['deserialize_s']:.3f} dir={cs['dir']}")
+        else:
+            lines.append("exec_cache: disabled (MXNET_EXEC_CACHE_DIR unset)")
+    except Exception:
+        pass
     by_site: Dict[str, Dict[str, float]] = {}
     for r in records:
-        st = by_site.setdefault(r["site"], {"n": 0, "dup": 0, "s": 0.0})
+        st = by_site.setdefault(r["site"], {"n": 0, "dup": 0, "hit": 0,
+                                            "s": 0.0})
         st["n"] += 1
         st["dup"] += 1 if r.get("duplicate") else 0
+        st["hit"] += 1 if r.get("cache_hit") else 0
         st["s"] += r["lower_s"] + r["compile_s"]
     if by_site:
         lines.append("")
         lines.append("== per site ==")
         for site, st in sorted(by_site.items()):
             lines.append(f"  {site}: n={st['n']:.0f} dup={st['dup']:.0f} "
-                         f"wall_s={st['s']:.3f}")
+                         f"cache_hit={st['hit']:.0f} wall_s={st['s']:.3f}")
     ranked = sorted(records, key=lambda r: r["lower_s"] + r["compile_s"],
                     reverse=True)[:top_n]
     if ranked:
@@ -290,10 +307,11 @@ def compilez(top_n: int = 20) -> str:
             ratio = (f" flops/byte={flops / ba:.2f}"
                      if flops and ba else "")
             dup = " DUP" if r.get("duplicate") else ""
+            hit = " HIT" if r.get("cache_hit") else ""
             key = ",".join(f"{k}={v}" for k, v in sorted(r["key"].items()))
             lines.append(
                 f"  {fp} {r['site']:<14} lower={r['lower_s'] * 1e3:8.1f}ms "
-                f"compile={r['compile_s'] * 1e3:8.1f}ms{ratio}{dup} "
+                f"compile={r['compile_s'] * 1e3:8.1f}ms{ratio}{dup}{hit} "
                 f"[{key}]")
     return "\n".join(lines) + "\n"
 

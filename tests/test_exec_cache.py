@@ -1,0 +1,320 @@
+"""Persistent executable cache (ISSUE 13): content-addressed compiled
+executables shared across processes via MXNET_EXEC_CACHE_DIR.
+
+Covers: key construction (device identity + runtime versions + donation +
+trigger key) and digest stability, the store/load round trip through
+``compile_ledger.lower_and_compile`` (hit records flagged, never charged as
+duplicate waste), cross-process reuse (a subprocess populates the store and
+this process deserializes — bitwise-identical outputs), key-mismatch and
+corrupt-entry fallbacks (warn + delete + recompile, never raise), LRU
+eviction under the byte cap, concurrent writers racing on one entry, and
+the ledger's rescan-on-miss fix (records appended by another process after
+this process seeded its duplicate set are still found).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import config
+from mxnet_tpu.cache import executable_cache as xcache
+from mxnet_tpu.telemetry import compile_ledger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(tmp_path):
+    compile_ledger.reset()
+    xcache.reset_stats()
+    config.set("MXNET_EXEC_CACHE_DIR", str(tmp_path / "xcache"))
+    yield
+    config.set("MXNET_EXEC_CACHE_DIR", "")
+    config.set("MXNET_EXEC_CACHE_MAX_BYTES", str(1 << 30))
+    compile_ledger.reset()
+    xcache.reset_stats()
+
+
+def _compile(mul=2.0, shape=(4, 4), site="serving_bucket", key=None):
+    jfn = jax.jit(lambda x: x * mul + 1.0)
+    aval = jax.ShapeDtypeStruct(shape, jnp.float32)
+    return compile_ledger.lower_and_compile(
+        jfn, (aval,), site=site, key=key or {"endpoint": "e", "bucket": 4})
+
+
+# ---------------------------------------------------------------------------
+# keys
+# ---------------------------------------------------------------------------
+
+def test_build_key_covers_identity_and_digest_is_stable():
+    k1 = xcache.build_key("f" * 64, extra={"endpoint": "e", "bucket": 4})
+    k2 = xcache.build_key("f" * 64, extra={"bucket": 4, "endpoint": "e"})
+    assert k1["fingerprint"] == "f" * 64
+    assert k1["platform"] and k1["device_count"] >= 1
+    assert "jax" in k1["versions"]
+    # extra is order-canonicalized: same digest either way
+    assert xcache.key_digest(k1) == xcache.key_digest(k2)
+    # any component change is a different address (a miss, never a wrong hit)
+    for other in (xcache.build_key("a" * 64, extra={"endpoint": "e"}),
+                  xcache.build_key("f" * 64, extra={"endpoint": "other"}),
+                  xcache.build_key("f" * 64)):
+        assert xcache.key_digest(other) != xcache.key_digest(k1)
+
+
+def test_version_or_topology_change_is_a_miss():
+    comp = _compile()
+    key = xcache.build_key("e" * 64)
+    assert xcache.store(key, comp)
+    # same fingerprint on a "different runtime": different digest -> absent
+    stale = dict(key, versions=dict(key["versions"], jax="0.0.1-stale"))
+    before = xcache.stats()["misses"]
+    assert xcache.load(stale) is None
+    assert xcache.stats()["misses"] == before + 1
+    wider = dict(key, device_count=key["device_count"] + 8)
+    assert xcache.load(wider) is None
+    # the genuine key still loads
+    assert xcache.load(key) is not None
+
+
+def test_manifest_key_mismatch_refused():
+    """A digest collision / hand-edited manifest must be refused even though
+    the file is addressed by this key's digest."""
+    comp = _compile(mul=5.0)
+    key = xcache.build_key("d" * 64)
+    assert xcache.store(key, comp)
+    d = xcache.cache_dir()
+    man_path = os.path.join(d, f"ent-{xcache.key_digest(key)}.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    man["key"] = dict(man["key"], fingerprint="0" * 64)
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    assert xcache.load(key) is None
+    assert xcache.stats()["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# hit path through lower_and_compile
+# ---------------------------------------------------------------------------
+
+def test_lower_and_compile_hits_cache_bitwise():
+    x = jnp.asarray(onp.random.RandomState(0).randn(4, 4).astype("float32"))
+    comp1 = _compile(mul=3.0)
+    want = onp.asarray(comp1(x))
+    (rec1,) = compile_ledger.recent()
+    assert not rec1["cache_hit"]
+    assert xcache.stats()["stores"] == 1
+
+    # "restart": forget in-process state, keep the store
+    compile_ledger.reset()
+    comp2 = _compile(mul=3.0)
+    (rec2,) = compile_ledger.recent()
+    assert rec2["cache_hit"], "second process must deserialize, not compile"
+    assert not rec2["duplicate"], "a cache hit is not recompile waste"
+    assert onp.array_equal(onp.asarray(comp2(x)), want), \
+        "deserialized executable must be bitwise-identical"
+    s = xcache.stats()
+    assert s["hits"] == 1 and s["deserialize_s"] > 0
+    assert compile_ledger.summary()["cache_hits"] == 1
+
+
+def test_cache_hit_never_charges_duplicate_waste():
+    _compile(mul=7.0)
+    waste0 = compile_ledger.summary()["dup_waste_s"]
+    compile_ledger.reset()
+    _compile(mul=7.0)                      # hit
+    s = compile_ledger.summary()
+    assert s["cache_hits"] == 1 and s["duplicates"] == 0
+    assert s["dup_waste_s"] == 0.0 <= waste0
+
+
+def test_corrupt_entry_warns_deletes_and_recompiles(caplog):
+    comp = _compile(mul=4.0)
+    key = xcache.build_key("c" * 64)
+    assert xcache.store(key, comp)
+    d = xcache.cache_dir()
+    bin_path = os.path.join(d, f"ent-{xcache.key_digest(key)}.bin")
+    size = os.path.getsize(bin_path)
+    with open(bin_path, "r+b") as f:       # torn write / bit rot
+        f.truncate(size // 2)
+    with caplog.at_level("WARNING", logger="mxnet_tpu.cache"):
+        assert xcache.load(key) is None, "corruption must be a miss"
+    assert any("corrupt" in r.message for r in caplog.records)
+    assert not os.path.exists(bin_path), "corrupt entry must be deleted"
+    # the serving path never sees this: lower_and_compile just recompiles
+    compile_ledger.reset()
+    comp2 = _compile(mul=4.0)
+    assert comp2 is not None
+
+
+def test_lru_eviction_under_byte_cap():
+    import time as _time
+    # compile OUTSIDE the ledger so only the explicit stores hit the dir
+    aval = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+    comps = [jax.jit(lambda x, m=m: x * m).lower(aval).compile()
+             for m in (1.5, 2.5, 3.5)]
+    key_a, key_b, key_c = (xcache.build_key(c * 64) for c in "ab9")
+    assert xcache.store(key_a, comps[0])
+    one = xcache.stats()["bytes"]
+    assert one > 0
+    # budget fits ~2 payloads: the third store evicts the LRU entry
+    config.set("MXNET_EXEC_CACHE_MAX_BYTES", str(int(one * 2.5)))
+    _time.sleep(0.02)                      # distinct payload mtimes
+    assert xcache.store(key_b, comps[1])
+    _time.sleep(0.02)
+    os.utime(os.path.join(xcache.cache_dir(),
+                          f"ent-{xcache.key_digest(key_a)}.bin"))  # touch a
+    _time.sleep(0.02)
+    assert xcache.store(key_c, comps[2])
+    digests = {e["digest"] for e in xcache.entries()}
+    assert xcache.key_digest(key_b) not in digests, \
+        "least-recently-used entry (b: never touched) must go first"
+    assert xcache.key_digest(key_a) in digests, "touched entry survives"
+    assert xcache.key_digest(key_c) in digests
+    assert xcache.stats()["evictions"] >= 1
+    assert xcache.stats()["bytes"] <= int(one * 2.5)
+
+
+def test_concurrent_writers_race_benignly():
+    comp = _compile(mul=6.0)
+    key = xcache.build_key("b" * 64, extra={"race": "1"})
+    errs = []
+
+    def writer():
+        try:
+            for _ in range(5):
+                assert xcache.store(key, comp)
+        except Exception as e:            # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    # no torn entry: the last atomic rename wins and verifies clean
+    loaded = xcache.load(key)
+    assert loaded is not None
+    x = jnp.ones((4, 4), jnp.float32)
+    assert onp.array_equal(onp.asarray(loaded(x)), onp.asarray(comp(x)))
+    assert not [n for n in os.listdir(xcache.cache_dir())
+                if n.startswith(".tmp-")], "no tmp litter left behind"
+
+
+# ---------------------------------------------------------------------------
+# cross-process reuse
+# ---------------------------------------------------------------------------
+
+_SUBPROC_POPULATE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, {repo!r})
+    import hashlib, json
+    import numpy as onp
+    import jax, jax.numpy as jnp
+    from mxnet_tpu import config
+    from mxnet_tpu.telemetry import compile_ledger
+    from mxnet_tpu.cache import executable_cache as xcache
+
+    config.set("MXNET_EXEC_CACHE_DIR", sys.argv[1])
+    jfn = jax.jit(lambda x: jnp.tanh(x @ x) * 2.0 + 1.0)
+    aval = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    comp = compile_ledger.lower_and_compile(
+        jfn, (aval,), site="serving_bucket",
+        key={{"endpoint": "xp", "bucket": 8}})
+    x = jnp.asarray(onp.random.RandomState(5).randn(8, 8).astype("float32"))
+    out = onp.asarray(comp(x))
+    (rec,) = compile_ledger.recent()
+    print(json.dumps({{"cache_hit": rec["cache_hit"],
+                       "stores": xcache.stats()["stores"],
+                       "digest": hashlib.sha256(
+                           onp.ascontiguousarray(out).tobytes()).hexdigest()
+                       }}))
+""").format(repo=REPO)
+
+
+def test_cross_process_reuse_bitwise():
+    """ACCEPTANCE: a subprocess compiles + stores; this process deserializes
+    the same program from disk and produces bitwise-identical outputs."""
+    d = xcache.cache_dir()
+    out = subprocess.run([sys.executable, "-c", _SUBPROC_POPULATE, d],
+                         capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    assert not child["cache_hit"] and child["stores"] == 1
+
+    jfn = jax.jit(lambda x: jnp.tanh(x @ x) * 2.0 + 1.0)
+    aval = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    comp = compile_ledger.lower_and_compile(
+        jfn, (aval,), site="serving_bucket",
+        key={"endpoint": "xp", "bucket": 8})
+    (rec,) = compile_ledger.recent()
+    assert rec["cache_hit"], "parent must hit the subprocess's entry"
+    x = jnp.asarray(onp.random.RandomState(5).randn(8, 8).astype("float32"))
+    import hashlib
+    got = hashlib.sha256(onp.ascontiguousarray(
+        onp.asarray(comp(x))).tobytes()).hexdigest()
+    assert got == child["digest"], "outputs must be bitwise-equal across " \
+                                   "the process boundary"
+
+
+# ---------------------------------------------------------------------------
+# ledger rescan-on-miss (the _SEEN staleness fix)
+# ---------------------------------------------------------------------------
+
+def test_ledger_rescans_for_records_appended_after_seeding(tmp_path):
+    """A fingerprint another process appends AFTER this process first
+    scanned the ledger dir must still be seen as a duplicate (the old
+    seed-once behaviour missed it and undercounted dup waste)."""
+    d = tmp_path / "ledger"
+    d.mkdir()
+    config.set("MXNET_COMPILE_LEDGER_DIR", str(d))
+    try:
+        jfn = jax.jit(lambda x: x - 2.0)
+        aval = jax.ShapeDtypeStruct((3,), jnp.float32)
+        compile_ledger.lower_and_compile(jfn, (aval,), site="train_step")
+
+        # "another process" appends a record for a new fingerprint NOW —
+        # after this process already scanned the directory
+        other = {"site": "train_step", "fingerprint": "9" * 64,
+                 "lower_s": 0.1, "compile_s": 0.4, "pid": 99999,
+                 "key": {}, "cache_hit": False}
+        with open(d / "ledger-99999.jsonl", "a") as f:
+            f.write(json.dumps(other) + "\n")
+
+        compile_ledger.record("train_step", "9" * 64, 0.05, 0.2)
+        rec = compile_ledger.recent()[-1]
+        assert rec["duplicate"], \
+            "rescan-on-miss must find records appended after the first scan"
+    finally:
+        config.set("MXNET_COMPILE_LEDGER_DIR", "")
+
+
+def test_ledger_rescan_ignores_partial_trailing_line(tmp_path):
+    """An in-flight (unterminated) JSONL line from a concurrent writer is
+    not consumed — it is re-read once the newline lands."""
+    d = tmp_path / "ledger"
+    d.mkdir()
+    config.set("MXNET_COMPILE_LEDGER_DIR", str(d))
+    try:
+        partial = json.dumps({"site": "train_step", "fingerprint": "8" * 64,
+                              "lower_s": 0.1, "compile_s": 0.4})
+        with open(d / "ledger-42.jsonl", "w") as f:
+            f.write(partial)               # no newline: torn write in flight
+        compile_ledger.record("train_step", "8" * 64, 0.05, 0.2)
+        assert not compile_ledger.recent()[-1]["duplicate"]
+        with open(d / "ledger-42.jsonl", "a") as f:
+            f.write("\n")                  # the write completes
+        compile_ledger.record("train_step", "8" * 64, 0.05, 0.2)
+        assert compile_ledger.recent()[-1]["duplicate"]
+    finally:
+        config.set("MXNET_COMPILE_LEDGER_DIR", "")

@@ -45,6 +45,14 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      bitwise bar; an injected SDC digest mismatch must write a repro bundle
      that tools/replay_step.py re-executes to the same verdict, twice.
 
+  6. ELASTICITY SCENARIOS (``--scenario {cache_poison,autoscale}``) — the
+     r17 drills: a ``cache_poison`` fault corrupts a persistent
+     executable-cache entry on disk mid-warmup and the sha256-verify
+     fallback must recompile with zero client errors and bitwise outputs;
+     a synthetic SLO burn must scale the Autoscaler's replica pool up to
+     max and recovery back down to min with no dropped requests across
+     any cutover and an ``autoscale_*`` flight event per transition.
+
 Every run prints its seed; a failing seed is a deterministic repro::
 
     python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
@@ -711,10 +719,217 @@ def check_decode(seed, requests=6, p=0.0, max_new=18):
             "kv_pages_leaked": pool_leak, "ok": bool(ok)}
 
 
+def check_cache_poison(seed, requests=16, p=0.0, in_dim=8, out_dim=4):
+    """SCENARIO cache_poison (r17): a prior server populated the persistent
+    executable cache; a ``cache_poison`` fault corrupts one entry ON DISK
+    just as the next server warms from it. The genuine sha256-verify path
+    must detect the corruption, delete the entry and fall back to a live
+    recompile — zero client-visible errors, every served output bitwise
+    equal to the direct forward, and the store healed (the recompile
+    re-stored the entry)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, nd, serving
+    from mxnet_tpu.cache import executable_cache as xcache
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.telemetry.metrics import REGISTRY
+
+    def mlp(s):
+        mx.random.seed(s)
+        onp.random.seed(s)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    d = tempfile.mkdtemp(prefix="chaos-xcache-")
+    prev = config.get("MXNET_EXEC_CACHE_DIR", "")
+    config.set("MXNET_EXEC_CACHE_DIR", d)
+    corrupt_ctr = REGISTRY.counter("mxtpu_exec_cache_misses_total",
+                                   labelnames=("reason",)).labels("corrupt")
+    # both phases register under ONE name: the compile trigger key carries
+    # the endpoint name, so a restarted endpoint must keep its name to hit
+    name_b = f"chaos_cp_{seed}"
+    try:
+        # phase A: the "previous process" — warmup compiles + stores
+        srv_a = serving.InferenceServer(batch_timeout_ms=1.0)
+        srv_a.register(serving.ModelEndpoint(
+            name_b, mlp(seed), input_shapes=(in_dim,), max_batch_size=4))
+        srv_a.start()
+        srv_a.stop()
+        serving.unregister(name_b)
+        stored = len(xcache.entries())
+
+        # phase B: warm restart under poison — first load hits a payload
+        # the fault just truncated on disk
+        before = xcache.stats()
+        corrupt_before = corrupt_ctr.value
+        errors = 0
+        outs = [None] * requests
+        net_b = mlp(seed)
+        with faults.inject("cache_poison", site="exec_cache",
+                           at=(1,)) as inj:
+            ep_b = serving.ModelEndpoint(name_b, net_b,
+                                         input_shapes=(in_dim,),
+                                         max_batch_size=4)
+            srv_b = serving.InferenceServer(
+                batch_timeout_ms=1.0, max_queue=max(64, requests * 2))
+            srv_b.register(ep_b)       # warmup: 1 poisoned, rest cache hits
+            srv_b.start()
+            xs = onp.random.RandomState(seed + 1).randn(
+                requests, in_dim).astype("float32")
+            futs = [srv_b.submit(name_b, xs[i]) for i in range(requests)]
+            for i, f in enumerate(futs):
+                try:
+                    outs[i] = f.result(timeout=120).asnumpy()
+                except Exception:
+                    errors += 1
+        srv_b.stop()
+        serving.unregister(name_b)
+        after = xcache.stats()
+        healed = len(xcache.entries())
+        corrupt_misses = int(corrupt_ctr.value - corrupt_before)
+    finally:
+        config.set("MXNET_EXEC_CACHE_DIR", prev)
+    direct = net_b(nd.array(xs)).asnumpy()
+    bitwise = errors == 0 and all(
+        o is not None and onp.array_equal(o, direct[i])
+        for i, o in enumerate(outs))
+    hits = after["hits"] - before["hits"]
+    ok = (inj.fires >= 1 and corrupt_misses >= 1 and errors == 0 and
+          bitwise and hits >= 1 and stored >= 2 and healed == stored)
+    return {"phase": "cache_poison", "seed": seed, "requests": requests,
+            "faults_fired": inj.fires, "entries_stored_cold": stored,
+            "entries_after_heal": healed, "corrupt_misses": corrupt_misses,
+            "warm_cache_hits": hits, "client_errors": errors,
+            "outputs_bitwise_equal": bitwise, "ok": bool(ok)}
+
+
+def check_autoscale(seed, requests=24, p=0.0, in_dim=8, out_dim=4):
+    """SCENARIO autoscale (r17): under continuous client load through the
+    ServingPool front door, a synthetic SLO burn drives the Autoscaler up
+    to max_replicas and recovery drives it back down to min, with every
+    transition leaving an ``autoscale_*`` flight event. Zero client-visible
+    errors across every cutover (scale-down removes a replica from rotation
+    BEFORE draining it), and served outputs stay bitwise-equal to the
+    direct forward on every replica (identical seeded weights)."""
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.telemetry import flight
+
+    svc = f"chaos_as_{seed}"
+
+    def mlp():
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    nets = {}
+
+    def factory(rid):
+        net = mlp()                   # same seed: replicas serve bitwise-
+        nets[rid] = net               # identical outputs
+        srv = serving.InferenceServer(batch_timeout_ms=1.0, max_queue=128)
+        srv.register(serving.ModelEndpoint(
+            svc, net, input_shapes=(in_dim,), max_batch_size=4))
+        return srv
+
+    class _BurnStub:
+        """Synthetic SLO monitor: one objective whose fast burn we flip."""
+        burn_threshold = 14.0
+
+        def __init__(self):
+            self.burning = False
+
+        def check_all(self):
+            burn = 20.0 if self.burning else 0.0
+            return [{"endpoint": svc, "fast_burn": burn, "slow_burn": burn,
+                     "alert_active": self.burning}]
+
+    mon = _BurnStub()
+    events_before = len(flight.recent_events())
+    pool = serving.ServingPool(factory, initial_replicas=1)
+    asc = serving.Autoscaler(pool, monitor=mon, min_replicas=1,
+                             max_replicas=3, up_n=2, down_n=3,
+                             cooldown_s=0.0, queue_high=0.9, queue_low=0.5)
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    stop_flag = threading.Event()
+    client_errors = []
+    served = {"n": 0}
+    outs = []
+    lock = threading.Lock()
+
+    def load(ci):
+        i = 0
+        while not stop_flag.is_set():
+            try:
+                o = pool.predict(svc, xs[(ci + i) % requests],
+                                 timeout=60).asnumpy()
+                with lock:
+                    outs.append(((ci + i) % requests, o))
+                    served["n"] += 1
+            except Exception as e:
+                client_errors.append(repr(e))
+            i += 1
+
+    sizes = []
+    threads = [threading.Thread(target=load, args=(c,)) for c in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        # synthetic burn: two consecutive over-polls per scale-up
+        mon.burning = True
+        for tick in range(6):
+            asc.tick(now=float(tick))
+            sizes.append(pool.size())
+        peak = pool.size()
+        # recovery: three consecutive idle polls per scale-down
+        mon.burning = False
+        for tick in range(10):
+            asc.tick(now=100.0 + tick)
+            sizes.append(pool.size())
+        settled = pool.size()
+    finally:
+        stop_flag.set()
+        for t in threads:
+            t.join()
+        pool.stop(drain=True)
+        serving.unregister(svc)
+    direct = nets[0](nd.array(xs)).asnumpy()
+    bitwise = all(onp.array_equal(o, direct[i]) for i, o in outs)
+    kinds = [e.get("kind") for e in
+             flight.recent_events()[events_before:]]
+    ups = kinds.count("autoscale_up")
+    downs = kinds.count("autoscale_down")
+    actions = [a["action"] for a in asc.actions]
+    flight_ok = (ups == actions.count("up")
+                 and downs == actions.count("down"))
+    ok = (peak == 3 and settled == 1 and ups >= 2 and downs >= 2 and
+          flight_ok and not client_errors and served["n"] > 0 and bitwise)
+    return {"phase": "autoscale", "seed": seed,
+            "replica_sizes": sizes, "peak_replicas": peak,
+            "settled_replicas": settled, "actions": actions,
+            "flight_up_events": ups, "flight_down_events": downs,
+            "requests_served": served["n"],
+            "client_errors": client_errors[:5],
+            "outputs_bitwise_equal": bitwise, "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
              "bad_batch": check_bad_batch, "sdc": check_sdc,
-             "decode": check_decode}
+             "decode": check_decode, "cache_poison": check_cache_poison,
+             "autoscale": check_autoscale}
 
 # the flight-recorder trigger each injected fault must leave behind (a clean
 # hot_swap is a structured event, not a dump trigger, so it has no entry)
@@ -789,6 +1004,10 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
             elif name == "decode":
                 res = check_flight_bundle(name, lambda: check_decode(
                     seed, requests=max(4, requests // 8)))
+            elif name == "cache_poison":
+                res = check_cache_poison(seed, requests=max(8, requests // 2))
+            elif name == "autoscale":
+                res = check_autoscale(seed, requests=max(8, requests // 2))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

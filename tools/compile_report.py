@@ -36,15 +36,24 @@ def rollup(records):
     """Aggregate a record list into the report dict (also the --json body)."""
     sites = {}
     by_fp = {}
+    cache_hits = 0
+    cache_hit_s = 0.0
     for r in records:
         site = r.get("site", "?")
-        st = sites.setdefault(site, {"n": 0, "dup": 0, "wall_s": 0.0})
+        st = sites.setdefault(site, {"n": 0, "dup": 0, "hit": 0,
+                                     "wall_s": 0.0})
         wall = float(r.get("lower_s", 0.0)) + float(r.get("compile_s", 0.0))
         st["n"] += 1
         st["dup"] += 1 if r.get("duplicate") else 0
+        st["hit"] += 1 if r.get("cache_hit") else 0
         st["wall_s"] += wall
+        if r.get("cache_hit"):
+            # a hit pays lower + deserialize, never an XLA compile: it is
+            # neither a duplicate nor waste, count it separately
+            cache_hits += 1
+            cache_hit_s += wall
         fp = r.get("fingerprint")
-        if fp:
+        if fp and not r.get("cache_hit"):
             f = by_fp.setdefault(fp, {"n": 0, "wall_s": 0.0, "sites": set(),
                                       "first_key": r.get("key", {})})
             f["n"] += 1
@@ -63,7 +72,11 @@ def rollup(records):
         "duplicate_fingerprints": len(dup_fps),
         "wall_s": round(total_wall, 3),
         "dup_waste_s": round(waste_s, 3),
-        "sites": {k: {"n": v["n"], "dup": v["dup"],
+        "cache_hits": cache_hits,
+        "cache_hit_s": round(cache_hit_s, 3),
+        "cache_hit_rate": round(cache_hits / len(records), 4)
+        if records else None,
+        "sites": {k: {"n": v["n"], "dup": v["dup"], "hit": v["hit"],
                       "wall_s": round(v["wall_s"], 3)}
                   for k, v in sorted(sites.items())},
         "dup_fingerprints": {
@@ -83,11 +96,15 @@ def render(records, top=20):
     lines.append(f"  duplicate waste: {agg['duplicate_fingerprints']} "
                  f"programs recompiled, {_fmt_s(agg['dup_waste_s'])} "
                  "re-spent (a persistent executable cache saves this)")
+    if agg["cache_hits"]:
+        lines.append(f"  executable cache: {agg['cache_hits']} compiles "
+                     f"served from the store in {_fmt_s(agg['cache_hit_s'])} "
+                     f"(hit rate {agg['cache_hit_rate']:.1%} of records)")
     lines.append("")
     lines.append("== per site ==")
     for site, st in agg["sites"].items():
         lines.append(f"  {site:<16} n={st['n']:<5} dup={st['dup']:<5} "
-                     f"wall={_fmt_s(st['wall_s'])}")
+                     f"hit={st['hit']:<5} wall={_fmt_s(st['wall_s'])}")
 
     ranked = sorted(records,
                     key=lambda r: r.get("lower_s", 0) + r.get("compile_s", 0),
@@ -101,6 +118,7 @@ def render(records, top=20):
             ba = r.get("bytes_accessed")
             ratio = f" flops/byte={flops / ba:7.2f}" if flops and ba else ""
             dup = " DUP" if r.get("duplicate") else ""
+            dup += " HIT" if r.get("cache_hit") else ""
             key = ",".join(f"{k}={v}" for k, v in
                            sorted(r.get("key", {}).items()))
             lines.append(

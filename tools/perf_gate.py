@@ -29,6 +29,9 @@ Measurement sources (selectable with ``--only``):
             compile-ledger rollup
   eager     in-process p95 eager-dispatch probe (the
             test_eager_latency.py gate, expressed as a budget)
+  restart   serving_loadgen.py --restart in a subprocess: warm
+            restart-to-first-request seconds (the executable-cache
+            elasticity contract — a warm process must compile nothing)
 
 Exit status mirrors tools/mxlint.py --check: 0 clean, 1 findings,
 2 operational error.
@@ -45,7 +48,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_BUDGETS = os.path.join(REPO, "PERF_BUDGETS.json")
-_SOURCES = ("bench", "loadgen", "eager")
+_SOURCES = ("bench", "loadgen", "eager", "restart")
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +202,26 @@ def measure_loadgen(env):
                       "stderr": err[-2000:]}
 
 
+def measure_restart(env):
+    """serving_loadgen --restart final row -> restart_to_first_request_s
+    (the warm phase; the loadgen parent already asserted zero fresh
+    compiles and bitwise-equal first-request outputs, so a row at all
+    means the correctness half of the contract held)."""
+    cmd = [sys.executable, os.path.join("benchmark", "serving_loadgen.py"),
+           "--restart"]
+    rc, out, err = _run(cmd, env)
+    measured = {}
+    for row in _json_lines(out):
+        # the summary row: restart_to_first_request_s without the
+        # per-phase "restart"/"restart_child" tags
+        if "restart_to_first_request_s" in row and "restart" not in row \
+                and "restart_child" not in row:
+            measured["restart_to_first_request_s"] = \
+                float(row["restart_to_first_request_s"])
+    return measured, {"cmd": " ".join(cmd), "rc": rc, "stdout": out,
+                      "stderr": err[-2000:]}
+
+
 def measure_eager():
     """p95 eager dispatch (us) over the representative op set, best of 3
     windows — the test_eager_latency gate as a number."""
@@ -331,6 +354,9 @@ def main(argv=None):
         measured.update(vals)
     if "eager" in sources and "eager" in wanted:
         measured.update(measure_eager())
+    if "restart" in sources and "restart" in wanted:
+        vals, _ = measure_restart(env)
+        measured.update(vals)
 
     # metrics whose source was excluded by --only are reported, not gated
     gated_budgets = {
