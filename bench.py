@@ -205,6 +205,87 @@ def bench_bert():
     _emit("bert_base_pretrain_tok_s_per_chip", tok_s, "tokens/s", None)
 
 
+def bench_dlrm():
+    """DLRM over the vocab-sharded embedding subsystem: embedding lookups/s
+    through the train step, plus the dataloader-wait share of step time with
+    the bare loader vs the streaming DeviceFeed (the staged share is the
+    budgeted one — the feed's whole job is driving it toward zero)."""
+    vocab = int(os.environ.get("BENCH_DLRM_VOCAB", 1 << 14))
+    batch = int(os.environ.get("BENCH_DLRM_BATCH", 256))
+    fields = int(os.environ.get("BENCH_DLRM_FIELDS", 8))
+    steps = int(os.environ.get("BENCH_DLRM_STEPS", 40))
+    dense_in, dim = 13, 16
+
+    import jax
+    from mxnet_tpu import parallel
+    from mxnet_tpu.embedding import (DeviceFeed, DLRMTrainStep,
+                                     ShardedEmbedding,
+                                     synthetic_dlrm_batches)
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    n = len(jax.devices())
+    mesh = parallel.make_mesh({"tp": n})
+    rng = onp.random.RandomState(0)
+    emb = ShardedEmbedding(
+        vocab, dim, mesh, axis="tp",
+        weight=rng.normal(0, 0.01, (vocab, dim)).astype("float32"))
+    step = DLRMTrainStep(emb, dense_in, fields, lr=0.05, seed=0)
+
+    raw = synthetic_dlrm_batches(steps, batch, dense_in, fields, vocab,
+                                 seed=1)
+    dense_all = onp.concatenate([b[0] for b in raw])
+    idx_all = onp.concatenate([b[1] for b in raw])
+    y_all = onp.concatenate([b[2] for b in raw])
+    loader = DataLoader(ArrayDataset(dense_all, idx_all, y_all),
+                        batch_size=batch)
+
+    def tup(b):
+        return (b[0].asnumpy(), b[1].asnumpy(), b[2].asnumpy())
+
+    step(raw[0])  # compile before any timed window
+
+    def run_unstaged():
+        """Consumer-side fetch + dedup + device placement on the step path."""
+        wait, it = 0.0, iter(loader)
+        t0 = time.perf_counter()
+        while True:
+            w0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                break
+            bundle = step.stage(tup(b))
+            wait += time.perf_counter() - w0
+            step(bundle)
+        return wait, time.perf_counter() - t0
+
+    def run_staged():
+        """The stager pre-places batches; the consumer mostly finds one."""
+        feed = DeviceFeed(loader, stage=lambda b: step.stage(tup(b)))
+        wait, it = 0.0, iter(feed)
+        t0 = time.perf_counter()
+        while True:
+            w0 = time.perf_counter()
+            try:
+                bundle = next(it)
+            except StopIteration:
+                break
+            wait += time.perf_counter() - w0
+            step(bundle)
+        return wait, time.perf_counter() - t0
+
+    u_wait, u_wall = run_unstaged()
+    s_wait, s_wall = run_staged()
+    _emit("dlrm_emb_lookups_s", steps * batch * fields / s_wall,
+          "lookups/s", None)
+    _emit("dlrm_step_s_per_chip", steps / s_wall / max(1, n), "steps/s", None)
+    # shares as percent so the 2-decimal _emit rounding keeps resolution
+    _emit("dlrm_dataloader_wait_share_unstaged_pct",
+          100.0 * u_wait / u_wall, "%", None)
+    _emit("dlrm_dataloader_wait_share_pct",
+          100.0 * s_wait / s_wall, "%", None)
+
+
 def _section(name, fn):
     """Isolate one bench section: a crashed section must not take down the
     later ones, and its failure must be VISIBLE in the JSON stream — a
@@ -232,7 +313,7 @@ def main():
     # record (resnet b32 train, bert pretrain) emit before the secondary
     # rows, so a killed run still reports the headline numbers.
     which = os.environ.get("BENCH_ONLY", "").split(",") if \
-        os.environ.get("BENCH_ONLY") else ["resnet", "bert", "infer"]
+        os.environ.get("BENCH_ONLY") else ["resnet", "bert", "infer", "dlrm"]
     ok = True
     if "resnet" in which:
         ok &= _section("resnet50_train", lambda: bench_resnet(batches=(32,)))
@@ -243,6 +324,8 @@ def main():
                        lambda: bench_resnet(batches=(128,)))
     if "infer" in which:
         ok &= _section("resnet50_infer", bench_resnet_inference)
+    if "dlrm" in which:
+        ok &= _section("dlrm", bench_dlrm)
     # the driver records only the TAIL of this output: re-emit JUST the two
     # metrics of record (bert, then resnet b32 last) so they are the final
     # lines, while the priority-first order above still survives an external

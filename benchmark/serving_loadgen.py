@@ -45,6 +45,16 @@ p50/p95/p99 and KV-pool occupancy — the round-16 gate metrics.
                           [SLG_DEC_NEW/2, SLG_DEC_NEW])
   SLG_DTYPES=none         skip the image sweep (decode-only run)
 
+r19 adds the recommendation phase (``--dlrm`` / SLG_DLRM=1): closed-loop
+single-example clients against the model-zoo DLRM behind the dynamic
+batcher — the huge-QPS / tiny-compute serving profile. Reports served
+req/s, embedding lookups/s, the latency/queue-wait decomposition and the
+request stream's hot-row hit rate.
+
+  SLG_DLRM=1              run the DLRM phase after the image sweep
+  SLG_DLRM_CLIENTS=8      closed-loop DLRM clients
+  SLG_DLRM_SECONDS=       measured DLRM window (default SLG_SECONDS)
+
 r17 adds the elasticity benchmark (``--restart``): restart-to-first-request
 time, cold (empty executable cache) vs warm (cache populated by the cold
 run). The harness spawns one subprocess per phase sharing an executable
@@ -291,6 +301,91 @@ def _run_decode(args):
         print(json.dumps(trow), flush=True)
 
 
+def _run_dlrm(args):
+    """Recommendation phase: the model-zoo DLRM behind the dynamic batcher —
+    the huge-QPS / tiny-compute profile (all embedding-memory traffic,
+    almost no FLOPs) that stresses admission/batching from the opposite end
+    of the spectrum from decode. Multi-input endpoint: (dense float32,
+    sparse int32 ids) per request. One aggregate JSON row (``"dlrm": true``)
+    carrying served req/s, embedding lookups/s (req/s x fields), the
+    latency/queue-wait decomposition, and the observed hot-row hit rate of
+    the request stream."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.embedding import HotnessTracker
+    from mxnet_tpu.gluon.model_zoo import dlrm as dlrm_zoo
+
+    conc, seconds = args.dlrm_clients, args.dlrm_seconds
+    vocab, fields, dense_in = 1 << 14, 8, 13
+    onp.random.seed(0)
+    net = dlrm_zoo.dlrm_tiny(vocab_size=vocab, num_fields=fields,
+                             dense_in=dense_in)
+    net.initialize(mx.init.Normal(0.1))
+    server = serving.InferenceServer(batch_timeout_ms=args.timeout_ms,
+                                     max_queue=args.max_batch * 8)
+    ep = serving.ModelEndpoint(
+        "loadgen_dlrm", net, input_shapes=((dense_in,), (fields,)),
+        dtype=("float32", "int32"), max_batch_size=args.max_batch)
+    server.register(ep)
+    compiles_warm = ep.stats.counters["compiles"]
+    server.start()
+
+    # skewed request stream (frequency-sorted vocab head), pre-generated
+    rng = onp.random.default_rng(7)
+    n_frames = 64
+    head = max(1, vocab // 16)
+    hot = rng.integers(0, head, (n_frames, fields))
+    cold = rng.integers(0, vocab, (n_frames, fields))
+    pick = rng.random((n_frames, fields)) < 0.7
+    idx_frames = onp.where(pick, hot, cold).astype("int32")
+    dense_frames = rng.standard_normal(
+        (n_frames, dense_in)).astype("float32")
+    tracker = HotnessTracker("loadgen_dlrm", vocab)
+    tracker.observe(idx_frames)
+
+    lock = threading.Lock()
+    lat_ms, served = [], [0]
+    stop_at = time.perf_counter() + seconds
+
+    def client(ci):
+        i = ci
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            server.predict("loadgen_dlrm",
+                           (dense_frames[i % n_frames],
+                            idx_frames[i % n_frames]), timeout=120)
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lat_ms.append(dt)
+                served[0] += 1
+            i += 1
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(conc)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    server.stop(drain=True)
+    snap = serving.stats()["loadgen_dlrm"]
+    assert snap["counters"]["compiles"] == compiles_warm, \
+        "dlrm traffic recompiled beyond warmup buckets"
+    qps = served[0] / wall
+    row = {"dlrm": True, "clients": conc, "seconds": round(wall, 2),
+           "requests": served[0], "req_s": round(qps, 1),
+           "emb_lookups_s": round(qps * fields, 1),
+           "fields": fields, "vocab": vocab,
+           "hot_row_hit_rate": round(tracker.hot_hit_rate(), 3),
+           "occupancy": round(snap["batch_occupancy"], 3),
+           "compiles": compiles_warm}
+    row.update(_percentiles(lat_ms))
+    row.update(_queue_wait_fields(snap))
+    print(json.dumps(row), flush=True)
+    serving.unregister("loadgen_dlrm")
+
+
 def _run_restart_child(args, phase):
     """One restart-benchmark phase in THIS process: build the dense (and
     optionally decode) endpoints, start the server, serve one request each,
@@ -462,6 +557,15 @@ def _parse_args():
                                      env("SLG_SECONDS", 5))))
     p.add_argument("--dec-seq", type=int, default=int(env("SLG_DEC_SEQ", 64)))
     p.add_argument("--dec-new", type=int, default=int(env("SLG_DEC_NEW", 16)))
+    p.add_argument("--dlrm", action="store_true",
+                   default=env("SLG_DLRM", "") not in ("", "0"),
+                   help="run the DLRM recommendation phase after the image "
+                        "sweep (env SLG_DLRM=1)")
+    p.add_argument("--dlrm-clients", type=int,
+                   default=int(env("SLG_DLRM_CLIENTS", 8)))
+    p.add_argument("--dlrm-seconds", type=float,
+                   default=float(env("SLG_DLRM_SECONDS",
+                                     env("SLG_SECONDS", 5))))
     p.add_argument("--restart", action="store_true",
                    help="cold/warm restart-to-first-request benchmark "
                         "instead of the load sweep")
@@ -570,6 +674,9 @@ def _run_sweep(args):
 
     if args.decode:
         _run_decode(args)
+
+    if args.dlrm:
+        _run_dlrm(args)
 
     # one whole-process telemetry snapshot: serving latency histograms,
     # executable-cache hit/miss/compile-seconds, queue depth / occupancy,

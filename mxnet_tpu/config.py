@@ -443,3 +443,22 @@ register("MXNET_AUTOSCALE_QUEUE_HIGH", 0.5, float,
 register("MXNET_AUTOSCALE_QUEUE_LOW", 0.05, float,
          "Autoscaler: queue-pressure floor below which (with no active "
          "burn alert) idle polls count toward scale-down.")
+register("MXNET_EMB_REPLICATE_MAX_BYTES", 1 << 20, int,
+         "Embedding planner: tables at or under this footprint are "
+         "replicated per shard instead of vocab-partitioned — a full copy "
+         "is cheaper than any exchange for small tables.")
+register("MXNET_EMB_ROWWISE_HOT_FRACTION", 0.25, float,
+         "Embedding planner: when a table's observed top-K hot rows take "
+         "at least this share of lookups, partition it row-wise (cyclic "
+         "layout) so a frequency-sorted vocab's hot head spreads across "
+         "shards instead of concentrating on shard 0.")
+register("MXNET_EMB_HOT_TOPK", 64, int,
+         "Embedding planner: K for the hot-row hit-rate statistic "
+         "(mxtpu_emb_hot_row_hit_rate) the row-wise decision reads.")
+register("MXNET_EMB_HOTNESS_CAP", 1 << 16, int,
+         "Embedding planner: rows of the (frequency-sorted) vocab head "
+         "the HotnessTracker keeps exact counters for; hits past the cap "
+         "count only toward the total.")
+register("MXNET_EMB_FEED_DEPTH", 2, int,
+         "DeviceFeed: staged-batch buffer depth (2 = double-buffered; the "
+         "stager runs at most this many batches ahead of the consumer).")

@@ -1,0 +1,23 @@
+"""mxnet_tpu.embedding — vocab-sharded embedding tables for DLRM-scale work.
+
+The subsystem that makes sparse recommendation models first-class: tables
+partitioned along the vocab axis over a named mesh axis with the lookup and
+the RowSparse update both staying on-mesh as XLA collectives (table.py), a
+per-table placement planner driven by footprint and observed hotness
+(planner.py), a streaming device-feed stager that keeps the chip from
+starving (feed.py), and the DLRM train step that ties them together
+(workload.py). See each module's docstring for the design notes.
+"""
+from .table import ShardedEmbedding, dedup_ids
+from .planner import TableSpec, TablePlan, HotnessTracker, plan_tables
+from .feed import DeviceFeed
+from .workload import (DLRMTrainStep, init_mlp_params, dlrm_forward,
+                       bce_loss, synthetic_dlrm_batches)
+
+__all__ = [
+    "ShardedEmbedding", "dedup_ids",
+    "TableSpec", "TablePlan", "HotnessTracker", "plan_tables",
+    "DeviceFeed",
+    "DLRMTrainStep", "init_mlp_params", "dlrm_forward", "bce_loss",
+    "synthetic_dlrm_batches",
+]

@@ -1,0 +1,187 @@
+"""Streaming device feed: a background stager ahead of the train/serve step.
+
+PR 2 gave the input pipeline its "is the chip starving?" gauge
+(``mxtpu_dataloader_wait_us``): the time the consumer blocks in ``next()``.
+The DataLoader's own prefetcher hides *fetch + batchify*, but for a
+DLRM-shaped step the remaining consumer-side work is exactly the expensive
+part — deduplicating the sparse index bundle and placing everything on
+device — and it rides the critical path between steps.
+
+``DeviceFeed`` moves that work off the path: a background stager thread runs
+ahead of the consumer, applies a ``stage`` function to each batch (for the
+DLRM workload: dedup the indices through the shared jitted kernel and
+device_put dense features, unique ids and the inverse map), and parks the
+staged batches in a small bounded buffer (double-buffered by default). The
+consumer's ``next()`` then usually finds a batch already resident on device;
+the wait gauge is driven toward zero and the stager's headroom is visible as
+``mxtpu_emb_stager_lead``.
+
+Staging must not perturb resume: the stager *consumes ahead* of the training
+loop, so checkpointing the wrapped loader's raw position would replay or
+drop the in-flight batches. ``state_dict`` therefore reports the batches the
+CONSUMER actually took — anchored to the loader's epoch/RNG accounting,
+whose epoch-start RNG snapshot is captured from the stager thread the moment
+the epoch starts — and ``load_state_dict`` hands the loader exactly that
+position, piggybacking on DataLoader's positional-resume machinery. The
+resumed feed re-stages and yields precisely the remaining batches;
+staged-but-unconsumed batches replay instead of being dropped.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from ..base import MXNetError
+from .. import config as _config
+from .. import telemetry as _telemetry
+
+__all__ = ["DeviceFeed"]
+
+_LEAD = _telemetry.gauge(
+    "mxtpu_emb_stager_lead",
+    "Staged batches resident on device when the consumer asked for the "
+    "next one (0 = the chip waited on the stager).")
+_STAGED = _telemetry.counter(
+    "mxtpu_emb_staged_batches_total", "Batches staged ahead by DeviceFeed.")
+
+# the consumer-visible wait rides the same series the bare loader reports
+# into, so "chip starving" dashboards compare staged and unstaged pipelines
+# on one graph
+from ..gluon.data.dataloader import _WAIT as _DL_WAIT  # noqa: E402
+
+
+class _StopStaging(Exception):
+    """Internal: the consumer abandoned the feed; unwind the stager."""
+
+
+class DeviceFeed:
+    """Wrap a DataLoader with an ahead-running device stager.
+
+    Parameters
+    ----------
+    loader : DataLoader
+        The source pipeline. Its epoch/position/RNG accounting is the
+        anchor for exact resume.
+    stage : callable, optional
+        ``stage(batch) -> staged`` runs in the stager thread; put host→HBM
+        transfers and index dedup here. Default: identity.
+    depth : int, optional
+        Staged-batch buffer size (default ``MXNET_EMB_FEED_DEPTH``).
+    """
+
+    def __init__(self, loader, stage: Optional[Callable] = None,
+                 depth: Optional[int] = None):
+        self.loader = loader
+        self._stage = stage if stage is not None else (lambda b: b)
+        self.depth = int(depth if depth is not None
+                         else _config.get("MXNET_EMB_FEED_DEPTH"))
+        if self.depth < 1:
+            raise MXNetError("DeviceFeed depth must be >= 1")
+        # resume accounting: entry anchor = loader state when the current
+        # epoch's iteration was entered (carries the resume offset); live
+        # anchor = loader state captured by the stager right after the
+        # first batch (carries the epoch-start RNG of a fresh epoch);
+        # consumed = batches the CONSUMER took since entry
+        self._entry_anchor = loader.state_dict()
+        self._live_anchor = None
+        self._consumed = 0
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __iter__(self):
+        self._entry_anchor = self.loader.state_dict()
+        self._live_anchor = None
+        self._consumed = 0
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(item):
+            # bounded put that notices an abandoned consumer
+            while True:
+                try:
+                    q.put(item, timeout=0.05)
+                    return
+                except queue.Full:
+                    if stop.is_set():
+                        raise _StopStaging()
+
+        def _work():
+            try:
+                first = True
+                for batch in self.loader:
+                    if first:
+                        # the loader has now captured its epoch-start RNG;
+                        # snapshot it while it is still live (pos is
+                        # overridden by state_dict())
+                        self._live_anchor = self.loader.state_dict()
+                        first = False
+                    _put(("data", self._stage(batch)))
+                    _STAGED.inc()
+                    if stop.is_set():
+                        return
+                _put(("end", None))
+            except _StopStaging:
+                pass
+            except BaseException as e:  # surface in the consumer, promptly
+                try:
+                    _put(("error", e))
+                except _StopStaging:
+                    pass
+
+        t = threading.Thread(target=_work, daemon=True,
+                             name="mxtpu-device-feed")
+        t.start()
+        try:
+            while True:
+                _LEAD.set(q.qsize())
+                t0 = time.perf_counter_ns()
+                kind, item = q.get()
+                _DL_WAIT.observe((time.perf_counter_ns() - t0) // 1000)
+                if kind == "data":
+                    self._consumed += 1
+                    yield item
+                elif kind == "error":
+                    raise item
+                else:
+                    # epoch complete: re-anchor at the loader's new epoch
+                    self._entry_anchor = self.loader.state_dict()
+                    self._live_anchor = None
+                    self._consumed = 0
+                    return
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    # ------------------------------------------------------------------
+    # checkpoint surface (resilience.CheckpointManager capture glue)
+    # ------------------------------------------------------------------
+    def state_dict(self):
+        """Exact-resume snapshot: the consumer position (entry offset +
+        batches taken) over the epoch's RNG anchor. Staged-but-unconsumed
+        batches are deliberately NOT counted — they replay on resume."""
+        base = self._live_anchor if self._live_anchor is not None \
+            else self._entry_anchor
+        st = dict(base)
+        st["kind"] = "DeviceFeed"
+        st["version"] = 1
+        st["pos"] = int(self._entry_anchor.get("pos", 0)) + self._consumed
+        if st["pos"] == 0:
+            # a position-0 state must not carry a stale RNG snapshot: the
+            # loader re-captures at the next epoch start
+            for k in ("rng_name", "rng_keys", "rng_pos", "rng_has_gauss",
+                      "rng_cached"):
+                st.pop(k, None)
+        return st
+
+    def load_state_dict(self, state):
+        if state.get("kind") != "DeviceFeed":
+            raise MXNetError(f"not a DeviceFeed state: {state.get('kind')!r}")
+        inner = dict(state)
+        inner["kind"] = "DataLoader"
+        self.loader.load_state_dict(inner)
+        self._entry_anchor = self.loader.state_dict()
+        self._live_anchor = None
+        self._consumed = 0

@@ -1,0 +1,96 @@
+"""DLRM: the deep-learning recommendation model (Naumov et al. shape).
+
+The serving-side twin of ``mxnet_tpu.embedding.workload``: bottom MLP over
+the dense features ⊕ embedding-bag feature interactions ⊕ top MLP over the
+concatenated pairwise dot products, agreeing with ``workload.dlrm_forward``
+on the factorization (same tower widths, same lower-triangular interaction
+set) so a table trained through the sharded step serves through this block
+unchanged.
+
+As a model-zoo HybridBlock the embedding here is a plain dense
+``gluon.nn.Embedding`` (optionally ``sparse_grad=True`` for host-side
+training through the Trainer/KVStore path) — the single-chip serving
+profile, where DLRM is all memory traffic and almost no FLOPs: huge-QPS /
+tiny-compute, the opposite end of the serving spectrum from decode. At
+DLRM *training* scale the table moves into
+``embedding.ShardedEmbedding`` and this block's MLP towers ride along
+unchanged.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..block import HybridBlock
+from ..nn import Dense, Embedding
+
+__all__ = ["DLRM", "dlrm_tiny"]
+
+
+def _F():
+    from ... import ndarray as nd_mod
+    return nd_mod
+
+
+class DLRM(HybridBlock):
+    """``forward(dense, indices) -> (B, 1)`` click logits.
+
+    Parameters
+    ----------
+    vocab_size : int
+        Sparse id space (one shared table across fields, the common
+        single-table benchmark shape).
+    num_fields : int
+        Sparse fields per example; interactions run over the F+1 vectors
+        (F embeddings + the bottom-MLP output).
+    dense_in : int
+        Dense feature width.
+    embed_dim, bot_hidden, top_hidden : int
+        Tower widths; the bottom MLP projects dense features to
+        ``embed_dim`` so they join the interaction set.
+    sparse_grad : bool
+        Emit RowSparse gradients for the table (gluon Trainer sparse path).
+    """
+
+    def __init__(self, vocab_size, num_fields, dense_in, embed_dim=16,
+                 bot_hidden=64, top_hidden=64, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._vocab = int(vocab_size)
+        self._fields = int(num_fields)
+        self._dim = int(embed_dim)
+        k = self._fields + 1
+        li, lj = onp.tril_indices(k, k=-1)
+        self._inter_idx = (li * k + lj).astype(onp.int32)
+        with self.name_scope():
+            self.embedding = Embedding(vocab_size, embed_dim,
+                                       sparse_grad=sparse_grad)
+            self.bot1 = Dense(bot_hidden, activation="relu",
+                              in_units=dense_in)
+            self.bot2 = Dense(embed_dim, activation="relu",
+                              in_units=bot_hidden)
+            self.top1 = Dense(top_hidden, activation="relu",
+                              in_units=embed_dim + len(self._inter_idx))
+            self.top2 = Dense(1, in_units=top_hidden)
+
+    def forward(self, dense, indices):
+        F = _F()
+        bot = self.bot2(self.bot1(dense))                    # (B, D)
+        emb = self.embedding(indices)                        # (B, F, D)
+        z = F.concat(bot.reshape((-1, 1, self._dim)), emb, dim=1)
+        zz = F.batch_dot(z, z, transpose_b=True)             # (B, F+1, F+1)
+        inter = F.take(zz.reshape((0, -1)),
+                       F.array(self._inter_idx, dtype="int32"), axis=1)
+        top = F.concat(bot, inter, dim=1)
+        return self.top2(self.top1(top))
+
+    def __repr__(self):
+        return (f"DLRM(vocab={self._vocab}, fields={self._fields}, "
+                f"dim={self._dim})")
+
+
+def dlrm_tiny(**kwargs):
+    """The bench/loadgen configuration: small enough to step on one CPU
+    device, interaction-heavy enough to exercise the real profile."""
+    cfg = dict(vocab_size=1 << 14, num_fields=8, dense_in=13, embed_dim=16,
+               bot_hidden=64, top_hidden=64)
+    cfg.update(kwargs)
+    return DLRM(**cfg)

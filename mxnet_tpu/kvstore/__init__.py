@@ -416,6 +416,11 @@ class KVStore(KVStoreBase):
                 self._store[k] = src
             idx = (r.data if isinstance(r, NDArray)
                    else jnp.asarray(onp_asarray(r))).reshape(-1).astype(jnp.int32)
+            if idx.size:
+                # callers may hand duplicate / unsorted row ids (kvstore.h
+                # PullRowSparse tolerates both); gather once per distinct
+                # row, in sorted order — the sparse._dedup_fn convention
+                idx = jnp.unique(idx)
             rows = src.data.at[idx].get(mode="fill", fill_value=0)
             if isinstance(o, RowSparseNDArray):
                 o._assign(idx, rows.astype(o.dtype))

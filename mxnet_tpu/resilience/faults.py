@@ -24,6 +24,12 @@ named boundaries —
                           the entry's on-disk payload is truncated so the
                           real sha256-verify fallback, not a shortcut,
                           answers with a recompile)
+    ``emb_dispatch``      embedding.DLRMTrainStep, before the compiled step
+                          with its on-mesh all_to_all exchange is entered
+                          (kind ``emb_exchange`` — a retryable
+                          RESOURCE_EXHAUSTED, so the retry policy's OOM
+                          classifier fires a flight bundle exactly as a
+                          real exchange-buffer OOM would)
 
 The ``numerics``/``sdc`` kinds (``nan_grad``, ``loss_spike``, ``bad_batch``,
 ``sdc``) are never raised to user code: the NumericsGuard *consumes* them and
@@ -65,7 +71,7 @@ __all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
 #: boundaries where production code calls :func:`check`
 SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
          "checkpoint_write", "preemption", "numerics", "sdc", "decode",
-         "exec_cache")
+         "exec_cache", "emb_dispatch")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -159,6 +165,10 @@ _KINDS = {
                      "(injected {kind} #{count} at {site})"),
     "cache_poison": (("exec_cache",), False,
                      "executable cache entry poisoned on disk "
+                     "(injected {kind} #{count} at {site})"),
+    "emb_exchange": (("emb_dispatch",), True,
+                     "RESOURCE_EXHAUSTED: embedding exchange buffer "
+                     "allocation failed mid-dispatch "
                      "(injected {kind} #{count} at {site})"),
 }
 

@@ -257,6 +257,66 @@ def test_dataset_dataloader():
     assert_almost_equal(t[0][0], X[0] * 2)
 
 
+def test_dataloader_prefetch_error_propagates_promptly():
+    """An exception inside the prefetch worker must reach the consumer as
+    soon as the buffered batches drain — within the iteration, not after
+    the loader's `timeout` expires."""
+    import time
+
+    class Boom(RuntimeError):
+        pass
+
+    class BadDataset(gluon.data.Dataset):
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise Boom(f"poisoned sample {i}")
+            return onp.float32(i)
+
+    loader = gluon.data.DataLoader(BadDataset(), batch_size=2,
+                                   num_workers=2, timeout=120)
+    t0 = time.monotonic()
+    with pytest.raises(Boom):
+        list(loader)
+    # prompt: nowhere near the 120 s timeout
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_dataloader_iter_clean_after_aborted_epoch():
+    """Abandoning an epoch mid-way (error or plain break) must leave the
+    loader able to start a fresh, full epoch."""
+    X = onp.arange(20, dtype="float32")
+    ds = gluon.data.ArrayDataset(X)
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2)
+    it = iter(loader)
+    next(it)
+    del it  # abort mid-epoch
+    again = [b.asnumpy() for b in loader]
+    assert len(again) == 5
+    assert_almost_equal(onp.concatenate([a.reshape(-1) for a in again]), X)
+    # aborted-by-error epoch restarts clean too, and the RNG accounting
+    # does not leak the aborted epoch's position into state_dict
+    flaky = {"arm": True}
+
+    class Flaky(gluon.data.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if flaky["arm"] and i >= 4:
+                raise ValueError("mid-epoch failure")
+            return onp.float32(i)
+
+    loader2 = gluon.data.DataLoader(Flaky(), batch_size=2, num_workers=1)
+    with pytest.raises(ValueError):
+        list(loader2)
+    flaky["arm"] = False
+    assert len(list(loader2)) == 4
+    assert loader2.state_dict()["pos"] == 0
+
+
 def test_split_and_load():
     data = nd.array(onp.arange(8).reshape(4, 2))
     parts = gluon.utils.split_and_load(data, [mx.cpu(0)])

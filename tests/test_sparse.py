@@ -469,3 +469,55 @@ def test_libsvm_iter(tmp_path):
     assert batches[1].pad == 1  # 3 rows, batch 2 -> last batch padded
     it.reset()
     assert len(list(it)) == 2
+
+
+def test_row_sparse_pull_duplicate_unsorted_empty():
+    """row_sparse_pull must tolerate duplicate and unsorted row ids (dedup +
+    sort before the gather, the sparse._dedup_fn convention) and an empty
+    row-id pull (kvstore.h PullRowSparse tolerates all three)."""
+    kv = mx.kv.create("local")
+    w = nd.array(_rand_dense((8, 3), density=1.0, seed=11))
+    kv.init(9, w)
+    # duplicate + unsorted: rows gathered once each, in sorted order
+    out = sparse.zeros("row_sparse", (8, 3))
+    kv.row_sparse_pull(9, out=out, row_ids=nd.array([5, 2, 5, 0, 2],
+                                                    dtype="int32"))
+    idx = out.indices.asnumpy()
+    real = sorted(set(idx[idx < 8].tolist()))
+    assert real == [0, 2, 5]
+    onp.testing.assert_allclose(out.asnumpy()[[0, 2, 5]],
+                                w.asnumpy()[[0, 2, 5]], rtol=1e-6)
+    assert abs(out.asnumpy()[[1, 3, 4, 6, 7]]).sum() == 0
+    # dense out, duplicated ids: each requested row appears exactly once
+    dout = nd.zeros((8, 3))
+    kv.row_sparse_pull(9, out=dout, row_ids=nd.array([4, 4, 4], dtype="int32"))
+    onp.testing.assert_allclose(dout.asnumpy()[4], w.asnumpy()[4], rtol=1e-6)
+    assert abs(dout.asnumpy()[[0, 1, 2, 3, 5, 6, 7]]).sum() == 0
+    # empty pull: no rows travel, out is all-zero
+    eout = nd.zeros((8, 3))
+    kv.row_sparse_pull(9, out=eout, row_ids=nd.array([], dtype="int32"))
+    assert abs(eout.asnumpy()).sum() == 0
+
+
+def test_gluon_embedding_sparse_vs_dense_grad_bitwise():
+    """The sparse_grad=True gradient densifies BITWISE-equal to the dense
+    path: the RowSparse cotangent accumulates duplicate hits in the same
+    positional order as the dense scatter-add."""
+    from mxnet_tpu.gluon import nn
+    rng = onp.random.RandomState(3)
+    w0 = rng.randn(12, 5).astype("float32")
+    x = nd.array(onp.array([[3, 7, 3], [7, 0, 3]]), dtype="int32")
+    scale = nd.array(rng.randn(2, 3, 5).astype("float32"))
+    grads = {}
+    for sg in (False, True):
+        net = nn.Embedding(12, 5, sparse_grad=sg)
+        net.initialize()
+        net.weight.set_data(nd.array(w0))
+        with autograd.record():
+            loss = (net(x) * scale).sum()
+        loss.backward()
+        g = net.weight.grad()
+        if sg:
+            assert isinstance(g, RowSparseNDArray)
+        grads[sg] = g.asnumpy()
+    assert onp.array_equal(grads[True], grads[False])

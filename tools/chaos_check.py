@@ -925,11 +925,78 @@ def check_autoscale(seed, requests=24, p=0.0, in_dim=8, out_dim=4):
             "outputs_bitwise_equal": bitwise, "ok": bool(ok)}
 
 
+def _metric_total(name):
+    """Sum a metric family across its label series (0.0 if unregistered)."""
+    from mxnet_tpu import telemetry
+    fam = telemetry.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(c.value for _, c in fam._series()))
+
+
+def check_dlrm(seed, steps=8, p=0.0):
+    """DLRM over a vocab-sharded embedding: inject a retryable
+    ``emb_exchange`` fault mid-epoch at the ``emb_dispatch`` site and assert
+    the retried run converges BITWISE to the fault-free oracle (the step is
+    functional — weights are inputs, so a replayed attempt is identical),
+    with zero KVStore host-loop traffic while the on-mesh exchange counter
+    moves."""
+    import jax
+    from mxnet_tpu import parallel
+    from mxnet_tpu.embedding import (ShardedEmbedding, DLRMTrainStep,
+                                     synthetic_dlrm_batches)
+    from mxnet_tpu.resilience import RetryPolicy, faults
+
+    n = min(4, len(jax.devices()))
+    V, D, B, F, DIN = 64, 8, 16, 4, 6
+    batches = synthetic_dlrm_batches(steps, B, DIN, F, V, seed=seed)
+    w0 = onp.random.RandomState(seed).normal(0, 0.1, (V, D)).astype("float32")
+
+    def build():
+        mesh = parallel.make_mesh({"tp": n}, devices=jax.devices()[:n])
+        emb = ShardedEmbedding(V, D, mesh, axis="tp", weight=w0)
+        step = DLRMTrainStep(
+            emb, DIN, F, lr=0.1, mode="replicated", seed=seed,
+            retry=RetryPolicy(max_attempts=8, base_ms=1.0, seed=seed))
+        return emb, step
+
+    emb_ref, step_ref = build()
+    ref_losses = [step_ref(b) for b in batches]
+    ref_w = emb_ref.dense_weight()
+
+    kv_before = (_metric_total("mxtpu_kvstore_push_bytes_total"),
+                 _metric_total("mxtpu_kvstore_wire_bytes_total"))
+    ex_before = _metric_total("mxtpu_emb_exchange_bytes_total")
+    emb_c, step_c = build()
+    mid = max(1, steps // 2)
+    inject_kw = {"p": p, "seed": seed} if p else {"at": (mid,)}
+    with faults.inject("emb_exchange", site="emb_dispatch",
+                       **inject_kw) as inj:
+        losses = [step_c(b) for b in batches]
+    chaos_w = emb_c.dense_weight()
+    kv_after = (_metric_total("mxtpu_kvstore_push_bytes_total"),
+                _metric_total("mxtpu_kvstore_wire_bytes_total"))
+    ex_after = _metric_total("mxtpu_emb_exchange_bytes_total")
+
+    loss_ok = losses == ref_losses
+    w_ok = onp.array_equal(ref_w, chaos_w)
+    kv_ok = kv_after == kv_before
+    ex_ok = ex_after > ex_before
+    ok = (loss_ok and w_ok and kv_ok and ex_ok and inj.fires >= 1)
+    return {"phase": "dlrm", "seed": seed, "steps": steps, "shards": n,
+            "faults_fired": inj.fires, "fault_calls": inj.calls,
+            "final_loss": losses[-1], "final_loss_ref": ref_losses[-1],
+            "loss_bitwise_equal": loss_ok, "table_bitwise_equal": w_ok,
+            "kvstore_bytes_flat": kv_ok,
+            "exchange_bytes_moved": float(ex_after - ex_before),
+            "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
              "bad_batch": check_bad_batch, "sdc": check_sdc,
              "decode": check_decode, "cache_poison": check_cache_poison,
-             "autoscale": check_autoscale}
+             "autoscale": check_autoscale, "dlrm": check_dlrm}
 
 # the flight-recorder trigger each injected fault must leave behind (a clean
 # hot_swap is a structured event, not a dump trigger, so it has no entry)
@@ -940,6 +1007,7 @@ EXPECTED_FLIGHT_TRIGGER = {
     "bad_batch": "numerics_anomaly",
     "sdc": "sdc_suspect",
     "decode": "decode_failover",
+    "dlrm": "oom",   # retry's OOM classifier fires on the RESOURCE_EXHAUSTED
 }
 
 
@@ -1004,6 +1072,9 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
             elif name == "decode":
                 res = check_flight_bundle(name, lambda: check_decode(
                     seed, requests=max(4, requests // 8)))
+            elif name == "dlrm":
+                res = check_flight_bundle(name, lambda: check_dlrm(
+                    seed, steps=max(4, steps // 2)))
             elif name == "cache_poison":
                 res = check_cache_poison(seed, requests=max(8, requests // 2))
             elif name == "autoscale":
