@@ -467,6 +467,17 @@ def _run_restart(args):
                    "--dec-seq", str(args.dec_seq),
                    "--dec-new", str(args.dec_new)]
     rows = {}
+    # both restart phases join the parent's trace journey: a child's root
+    # spans adopt MXNET_TRACE_ID, and with a spool dir configured each
+    # phase's spans land in its own spool-<pid>.jsonl next to the parent's
+    from mxnet_tpu import telemetry
+    from mxnet_tpu import config as _config
+    # active span > operator-set MXNET_TRACE_ID > fresh id — so a harness
+    # that pinned a trace id for the whole run keeps one journey
+    trace_id = (telemetry.current_trace_id()
+                or str(_config.get("MXNET_TRACE_ID", "") or "")
+                or telemetry.new_trace_id())
+    spool_dir = str(_config.get("MXNET_SPAN_SPOOL_DIR", "") or "")
     for phase in ("cold", "warm"):
         env = dict(os.environ)
         env["MXNET_EXEC_CACHE_DIR"] = cache_dir
@@ -475,6 +486,9 @@ def _run_restart(args):
         # cache un-instrumented so op-level compiles don't muddy the count
         env["MXNET_COMPILE_LEDGER_EAGER"] = "0"
         env["SLG_DECODE"] = "1" if args.decode else "0"
+        env["MXNET_TRACE_ID"] = trace_id
+        if spool_dir:
+            env["MXNET_SPAN_SPOOL_DIR"] = spool_dir
         cmd = [sys.executable, os.path.abspath(__file__),
                "--restart-child", phase] + child_flags
         proc = subprocess.run(cmd, env=env, capture_output=True, text=True)

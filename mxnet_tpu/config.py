@@ -462,3 +462,36 @@ register("MXNET_EMB_HOTNESS_CAP", 1 << 16, int,
 register("MXNET_EMB_FEED_DEPTH", 2, int,
          "DeviceFeed: staged-batch buffer depth (2 = double-buffered; the "
          "stager runs at most this many batches ahead of the consumer).")
+register("MXNET_SPAN_SPOOL_DIR", "", str,
+         "Span spool: directory for per-pid append-only span JSONL files "
+         "(spool-<pid>.jsonl) — the cross-process raw material "
+         "tools/trace_journey.py assembles into one timeline per trace "
+         "id. Empty (the default) keeps the spool in-memory only: span "
+         "exits pay a bounded buffer append and no file I/O ever runs.")
+register("MXNET_SPAN_SPOOL_MAX_BYTES", 8 << 20, int,
+         "Span spool: size cap per spool file; exceeding it rotates the "
+         "file to spool-<pid>.jsonl.1 (one generation kept) before the "
+         "append. 0 disables rotation.")
+register("MXNET_SPAN_SPOOL_FLUSH_N", 32, int,
+         "Span spool: buffered spans per flush — the spool drains to disk "
+         "in one O_APPEND write every this-many spans (and at interpreter "
+         "exit), never per-span.")
+register("MXNET_TRACE_ID", "", str,
+         "Trace inheritance: a trace id handed to a child process at "
+         "spawn (ServingPool warm restarts, loadgen --restart phases, "
+         "chaos subprocesses). The child's first root span joins this "
+         "trace instead of minting a fresh id, so one logical request is "
+         "one journey across process boundaries. Read once per process.")
+register("MXNET_FLEET_DUMP_GLOB", "", str,
+         "Fleet collector: glob of telemetry snapshot JSON files "
+         "(telemetry.dump() / MXNET_TELEMETRY_DUMP_PATH outputs) from "
+         "sibling processes to merge into the fleet view alongside the "
+         "live in-process registry.")
+register("MXNET_GOODPUT_PEAK_FLOPS", 0.0, float,
+         "Goodput ledger: peak device FLOP/s for the roofline fraction in "
+         "the per-executable utilization estimate (achieved flops/s over "
+         "this). 0 (the default) reports achieved rates only.")
+register("MXNET_GOODPUT_PEAK_GBS", 0.0, float,
+         "Goodput ledger: peak device memory bandwidth (bytes/s) for the "
+         "roofline fraction of the bytes-accessed rate. 0 reports "
+         "achieved rates only.")

@@ -45,6 +45,7 @@ from typing import Callable, List, Optional
 from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
+from ..telemetry import debug_server as _debug
 from ..telemetry import flight as _flight
 from ..telemetry.slo import MONITOR as _SLO_MONITOR
 from .errors import ServerClosedError, ServerOverloadError
@@ -89,6 +90,7 @@ class ServingPool:
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
         self._next_rid = 0
+        _debug.attach_pool(self)      # weak: /statusz + /fleetz render us
         for _ in range(max(int(initial_replicas), 0)):
             self.scale_up()
 
@@ -144,8 +146,13 @@ class ServingPool:
         last_exc: Optional[Exception] = None
         for rep in ranked:
             try:
-                return rep.server.submit(name, inputs,
-                                         deadline_ms=deadline_ms)
+                # the span stamps this attempt's replica into the journey
+                # AND hands its trace id to the request the batcher builds
+                # inside submit() — the replica hop is traceable end to end
+                with _telemetry.span("pool.submit", replica=rep.rid,
+                                     endpoint=name):
+                    return rep.server.submit(name, inputs,
+                                             deadline_ms=deadline_ms)
             except (ServerOverloadError, ServerClosedError) as e:
                 last_exc = e
         raise last_exc
@@ -245,6 +252,7 @@ class Autoscaler:
         self._idle_polls = 0
         self._last_action_ts: Optional[float] = None
         self.actions: list = []      # action report dicts, newest last
+        _debug.attach_autoscaler(self)   # weak: /statusz + /fleetz
 
     # -- knob-backed settings (read live unless pinned) --------------------
     @property
@@ -396,7 +404,16 @@ class Autoscaler:
         with self._lock:
             actions = list(self.actions)
             over, idle = self._over_polls, self._idle_polls
+            last_ts = self._last_action_ts
+        now = self._now()
+        age = (now - last_ts) if last_ts is not None else None
         return {"pool": self.pool.snapshot(), "actions": actions,
                 "over_polls": over, "idle_polls": idle,
+                "up_n": self.up_n, "down_n": self.down_n,
+                "cooldown_s": self.cooldown_s,
+                "last_action_age_s": round(age, 3) if age is not None
+                else None,
+                "in_cooldown": bool(age is not None
+                                    and age < self.cooldown_s),
                 "min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas}

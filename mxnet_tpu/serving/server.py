@@ -419,9 +419,18 @@ class InferenceServer:
                     "server stopped without drain"))
             self._cond.notify_all()
         deadline = time.monotonic() + timeout
-        for t in (prep, worker):
-            if t is not None:
-                t.join(max(deadline - time.monotonic(), 0.0))
+        if drain and (prep is not None or worker is not None):
+            # the span is the goodput ledger's drain bucket: wall time spent
+            # flushing admitted work during scale-down / shutdown
+            with _telemetry.span("serving.drain",
+                                 timeout_s=round(timeout, 3)):
+                for t in (prep, worker):
+                    if t is not None:
+                        t.join(max(deadline - time.monotonic(), 0.0))
+        else:
+            for t in (prep, worker):
+                if t is not None:
+                    t.join(max(deadline - time.monotonic(), 0.0))
         if any(t is not None and t.is_alive() for t in (prep, worker)):
             # drain wedged (hung device step / endpoint queue): abandon.
             # The daemon threads may eventually finish their in-flight call;

@@ -37,24 +37,26 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       DEFAULT_BUCKETS, METRIC_NAME_RE)
 from .flight import FlightRecorder, event
 from .tracing import (Span, span, current_span, current_trace_id,
-                      new_trace_id)
+                      new_trace_id, spool_flush, read_spool, journey)
 from .reporter import (PeriodicReporter, periodic_logger, dump,
                        sample_device_memory, summary_line)
 from .debug_server import DebugServer
 from .slo import SLOMonitor
 from . import flight, debug_server, slo
 from . import compile_ledger, memstats, perf_sentinel
+from . import fleet, goodput
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "DEFAULT_BUCKETS", "METRIC_NAME_RE",
     "Span", "span", "current_span", "current_trace_id", "new_trace_id",
+    "spool_flush", "read_spool", "journey",
     "PeriodicReporter", "periodic_logger", "dump", "sample_device_memory",
     "summary_line",
     "FlightRecorder", "event", "flight",
     "DebugServer", "debug_server",
     "SLOMonitor", "slo",
-    "compile_ledger", "memstats", "perf_sentinel",
+    "compile_ledger", "memstats", "perf_sentinel", "fleet", "goodput",
     "counter", "gauge", "histogram", "snapshot", "snapshot_json",
     "prometheus_text", "lint_names",
 ]

@@ -18,6 +18,14 @@ production RPC server grows eventually:
               waste, recent records ranked by compile seconds
   /memz       HBM attribution: device memory_stats() (refreshed on demand)
               reconciled against the registered holder table
+  /fleetz     fleet plane (JSON): merged per-replica metrics (local registry
+              + MXNET_FLEET_DUMP_GLOB snapshot files), worst-of health
+              rollup across attached servers/pools/autoscalers, and the
+              goodput wall-time attribution + utilization estimates
+
+``/metricsz?json=1`` serves the registry snapshot as JSON — the same shape
+``telemetry.dump()`` writes — so a FleetCollector in another process can
+scrape this one instead of reading its dump file.
 
 The handler only ever *reads* — registry snapshots, ring copies, ``health()``
 dicts — so scraping cannot perturb serving beyond a snapshot's cost, and
@@ -37,7 +45,9 @@ from urllib.parse import parse_qs, urlparse
 from .metrics import REGISTRY
 from . import flight as _flight
 
-__all__ = ["DebugServer", "attach", "detach", "attached_servers"]
+__all__ = ["DebugServer", "attach", "detach", "attached_servers",
+           "attach_pool", "detach_pool", "attached_pools",
+           "attach_autoscaler", "detach_autoscaler", "attached_autoscalers"]
 
 _SCRAPES = REGISTRY.counter(
     "mxtpu_debug_requests_total",
@@ -47,6 +57,13 @@ _SCRAPES = REGISTRY.counter(
 # InferenceServers that want to appear on /healthz + /statusz register here
 # (weakly: a dead server drops off the page instead of pinning memory).
 _ATTACHED: "weakref.WeakValueDictionary[int, object]" = \
+    weakref.WeakValueDictionary()
+# ServingPools and Autoscalers get their own weak registries: a pooled
+# deployment's replica membership and scaling state render on the same
+# pages, and drop off when the pool is garbage-collected.
+_ATTACHED_POOLS: "weakref.WeakValueDictionary[int, object]" = \
+    weakref.WeakValueDictionary()
+_ATTACHED_AUTOSCALERS: "weakref.WeakValueDictionary[int, object]" = \
     weakref.WeakValueDictionary()
 _ATTACH_LOCK = threading.Lock()
 
@@ -75,6 +92,40 @@ def attached_servers() -> List[object]:
         return list(_ATTACHED.values())
 
 
+def attach_pool(pool):
+    """Expose a ServingPool (replica membership, per-replica load) on
+    /healthz, /statusz and /fleetz (idempotent, weak)."""
+    with _ATTACH_LOCK:
+        _ATTACHED_POOLS[id(pool)] = pool
+
+
+def detach_pool(pool):
+    with _ATTACH_LOCK:
+        _ATTACHED_POOLS.pop(id(pool), None)
+
+
+def attached_pools() -> List[object]:
+    with _ATTACH_LOCK:
+        return list(_ATTACHED_POOLS.values())
+
+
+def attach_autoscaler(asc):
+    """Expose an Autoscaler (cooldown, hysteresis poll counts, action
+    history) on /statusz and /fleetz (idempotent, weak)."""
+    with _ATTACH_LOCK:
+        _ATTACHED_AUTOSCALERS[id(asc)] = asc
+
+
+def detach_autoscaler(asc):
+    with _ATTACH_LOCK:
+        _ATTACHED_AUTOSCALERS.pop(id(asc), None)
+
+
+def attached_autoscalers() -> List[object]:
+    with _ATTACH_LOCK:
+        return list(_ATTACHED_AUTOSCALERS.values())
+
+
 # -- page renderers (module functions so tests can call them directly) --------
 
 def healthz() -> "tuple[int, Dict]":
@@ -94,6 +145,22 @@ def healthz() -> "tuple[int, Dict]":
         body["servers"].append(entry)
         if h.get("state") != "running" or h.get("circuit") == "open":
             body["ok"] = False
+    pools = attached_pools()
+    if pools:
+        body["pools"] = []
+        for pool in pools:
+            try:
+                ps = pool.snapshot()
+            except Exception as e:
+                body["pools"].append({"error": repr(e)})
+                body["ok"] = False
+                continue
+            body["pools"].append({
+                "replicas": ps.get("size", 0),
+                "rotation": [r.get("rid") for r in ps.get("replicas", [])],
+                "queue_pressure": ps.get("queue_pressure")})
+            if not ps.get("size"):
+                body["ok"] = False
     return (200 if body["ok"] else 503), body
 
 
@@ -144,6 +211,41 @@ def statusz() -> str:
                 f"rows={ep.get('pending_rows')} "
                 f"slo_ms={ep.get('slo_ms')} "
                 f"weights_epoch={ep.get('weights_epoch')}")
+
+    pools = attached_pools()
+    autoscalers = attached_autoscalers()
+    if pools or autoscalers:
+        lines.append("")
+        lines.append("== serving pool ==")
+        for pool in pools:
+            try:
+                ps = pool.snapshot()
+            except Exception as e:
+                lines.append(f"pool: snapshot() failed: {e!r}")
+                continue
+            lines.append(f"pool: replicas={ps.get('size', 0)} "
+                         f"queue_pressure={ps.get('queue_pressure', 0):.3f}")
+            for r in ps.get("replicas", []):
+                lines.append(f"  replica {r.get('rid')}: "
+                             f"state={r.get('state')} load={r.get('load')}")
+        for asc in autoscalers:
+            try:
+                asnap = asc.snapshot()
+            except Exception as e:
+                lines.append(f"autoscaler: snapshot() failed: {e!r}")
+                continue
+            lines.append(
+                f"autoscaler: replicas "
+                f"[{asnap.get('min_replicas')}..{asnap.get('max_replicas')}] "
+                f"over_polls={asnap.get('over_polls')}/{asnap.get('up_n')} "
+                f"idle_polls={asnap.get('idle_polls')}/{asnap.get('down_n')} "
+                f"cooldown={'yes' if asnap.get('in_cooldown') else 'no'} "
+                f"(cooldown_s={asnap.get('cooldown_s')} "
+                f"last_action_age_s={asnap.get('last_action_age_s')})")
+            for act in asnap.get("actions", [])[-5:]:
+                lines.append(f"  action: {act.get('action')} "
+                             f"rid={act.get('rid')} -> "
+                             f"replicas={act.get('replicas')}")
 
     lat = snap["metrics"].get("mxtpu_serving_request_latency_us")
     if lat and any(s.get("count") for s in lat["series"]):
@@ -349,6 +451,24 @@ def memz() -> str:
     return "\n".join(lines) + "\n"
 
 
+def fleetz() -> Dict:
+    """The fleet pane as one JSON document: merged per-replica metrics
+    (local registry + MXNET_FLEET_DUMP_GLOB snapshot files), the worst-of
+    health rollup, and this process's goodput attribution + per-executable
+    utilization estimates. ``tools/fleet_report.py`` renders the offline
+    equivalent from dump files alone."""
+    from . import fleet as _fleet
+    from . import goodput as _goodput
+    body = _fleet.collect()
+    body["goodput"] = {
+        "wall_s": round(_goodput.wall_seconds(), 3),
+        "buckets": {k: round(v, 3)
+                    for k, v in _goodput.account().items()},
+    }
+    body["utilization"] = _goodput.utilization()
+    return body
+
+
 def _safe_size(p: str) -> Optional[int]:
     import os
     try:
@@ -375,8 +495,18 @@ class _Handler(BaseHTTPRequestHandler):
         page = url.path.rstrip("/") or "/"
         try:
             if page == "/metricsz":
-                from . import prometheus_text
-                self._send(200, prometheus_text())
+                q = parse_qs(url.query)
+                if q.get("json", ["0"])[0] in ("1", "true", "yes"):
+                    # snapshot JSON (the telemetry.dump() shape): the scrape
+                    # form of a reporter dump file, for FleetCollectors in
+                    # other processes
+                    from . import snapshot
+                    self._send(200, json.dumps(snapshot(), indent=1,
+                                               sort_keys=True),
+                               ctype="application/json")
+                else:
+                    from . import prometheus_text
+                    self._send(200, prometheus_text())
             elif page == "/healthz":
                 status, body = healthz()
                 self._send(status, json.dumps(body, indent=1),
@@ -395,11 +525,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, compilez())
             elif page == "/memz":
                 self._send(200, memz())
+            elif page == "/fleetz":
+                self._send(200, json.dumps(fleetz(), indent=1, default=repr),
+                           ctype="application/json")
             elif page == "/":
                 self._send(200, "mxnet_tpu debug server\n"
-                                "pages: /metricsz /healthz /statusz "
-                                "/tracez /flightz[?dump=1] /compilez "
-                                "/memz\n")
+                                "pages: /metricsz[?json=1] /healthz "
+                                "/statusz /tracez /flightz[?dump=1] "
+                                "/compilez /memz /fleetz\n")
             else:
                 self._send(404, f"no such page: {page}\n")
                 return

@@ -1,0 +1,273 @@
+"""Goodput ledger — where did this process's wall clock actually go?
+
+Attributes elapsed wall time into EXCLUSIVE buckets, entirely from series
+the registry already carries (no new instrumentation on any hot path):
+
+  compile           mxtpu_compile_wall_seconds_total (compile-ledger wall
+                    seconds; falls back to mxtpu_serving_compile_seconds_total
+                    when the ledger saw nothing) + executable-cache
+                    deserialize seconds
+  data_wait         mxtpu_dataloader_wait_us histogram sum
+  step              mxtpu_train_step_latency_us + mxtpu_serving_step_latency_us
+                    + mxtpu_decode_step_us + mxtpu_decode_prefill_us sums —
+                    the bucket that IS goodput
+  checkpoint_flush  mxtpu_checkpoint_save_duration_us +
+                    mxtpu_preempt_flush_duration_us sums
+  retry_recovery    mxtpu_span_duration_us sums for the recovery span names
+                    (checkpoint.restore, resilience.retry, serving.failover)
+  drain             mxtpu_span_duration_us{name="serving.drain"} (the span
+                    InferenceServer.stop opens around its drain wait)
+  idle              the residual: elapsed wall minus every active bucket,
+                    clamped at zero
+
+Invariants (pinned by tier-1 tests): buckets are exclusive — each comes
+from disjoint source series; if the active sum exceeds elapsed wall
+(overlapped threads, clock skew) every active bucket is scaled down
+proportionally so the total reconciles; idle is the residual and never
+negative — so the buckets always sum to elapsed wall exactly.
+
+:func:`account` publishes the attribution as
+``mxtpu_goodput_seconds_total{bucket=...}`` (monotone: each call emits the
+delta since the previous accounting) plus the ``mxtpu_goodput_wall_seconds``
+gauge, so snapshot dumps carry their own goodput table and
+``tools/fleet_report.py`` can verify buckets-vs-wall offline.
+
+:func:`utilization` is the roofline half: per-executable achieved FLOP/s
+and bytes/s — compile-ledger ``cost_analysis`` flops/bytes over the
+observed mean step time for that executable's site — optionally as a
+fraction of ``MXNET_GOODPUT_PEAK_FLOPS`` / ``MXNET_GOODPUT_PEAK_GBS``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .metrics import REGISTRY
+
+__all__ = ["BUCKETS", "attribute", "account", "utilization", "reset",
+           "wall_seconds"]
+
+BUCKETS = ("compile", "data_wait", "step", "checkpoint_flush",
+           "retry_recovery", "drain", "idle")
+
+# span names whose durations count as recovery / drain time
+RECOVERY_SPANS = ("checkpoint.restore", "resilience.retry",
+                  "serving.failover")
+DRAIN_SPANS = ("serving.drain",)
+
+_GOODPUT = REGISTRY.counter(
+    "mxtpu_goodput_seconds_total",
+    "Process wall time attributed to exclusive buckets (compile / "
+    "data_wait / step / checkpoint_flush / retry_recovery / drain / idle). "
+    "Buckets sum to mxtpu_goodput_wall_seconds; step is the goodput share.",
+    labelnames=("bucket",))
+_WALL = REGISTRY.gauge(
+    "mxtpu_goodput_wall_seconds",
+    "Elapsed wall seconds the goodput buckets attribute (since process "
+    "start / the last goodput.reset()).")
+
+_LOCK = threading.Lock()
+_T0 = time.perf_counter()
+_LAST: Dict[str, float] = {}       # bucket -> absolute seconds last emitted
+_LAST_WALL = 0.0
+
+
+def _cfg(name, default):
+    try:
+        from .. import config
+        return config.get(name, default)
+    except Exception:
+        return default
+
+
+def _fam_sum(snap: Dict, name: str, value_key: str = "value",
+             label_filter: Optional[Dict[str, str]] = None) -> float:
+    fam = (snap.get("metrics") or {}).get(name)
+    if not fam:
+        return 0.0
+    total = 0.0
+    for s in fam.get("series", []):
+        if label_filter:
+            labels = s.get("labels") or {}
+            if any(labels.get(k) != v for k, v in label_filter.items()):
+                continue
+        total += float(s.get(value_key, 0.0) or 0.0)
+    return total
+
+
+def _span_sum_s(snap: Dict, names) -> float:
+    """Summed duration (seconds) of mxtpu_span_duration_us series whose
+    ``name`` label is in ``names``."""
+    fam = (snap.get("metrics") or {}).get("mxtpu_span_duration_us")
+    if not fam:
+        return 0.0
+    total = 0.0
+    for s in fam.get("series", []):
+        if (s.get("labels") or {}).get("name") in names:
+            total += float(s.get("sum", 0.0) or 0.0)
+    return total / 1e6
+
+
+def attribute(snap: Dict, elapsed_s: Optional[float]) -> Dict[str, float]:
+    """Pure attribution: registry snapshot + elapsed wall -> bucket dict.
+
+    With ``elapsed_s`` None only the active buckets are reported (idle 0,
+    no reconciliation) — the best an offline reader without a wall anchor
+    can do.
+    """
+    compile_s = _fam_sum(snap, "mxtpu_compile_wall_seconds_total")
+    if not compile_s:
+        compile_s = _fam_sum(snap, "mxtpu_serving_compile_seconds_total")
+    compile_s += _fam_sum(snap, "mxtpu_exec_cache_deserialize_seconds_total")
+    buckets = {
+        "compile": compile_s,
+        "data_wait": _fam_sum(snap, "mxtpu_dataloader_wait_us", "sum") / 1e6,
+        "step": (_fam_sum(snap, "mxtpu_train_step_latency_us", "sum")
+                 + _fam_sum(snap, "mxtpu_serving_step_latency_us", "sum")
+                 + _fam_sum(snap, "mxtpu_decode_step_us", "sum")
+                 + _fam_sum(snap, "mxtpu_decode_prefill_us", "sum")) / 1e6,
+        "checkpoint_flush":
+            (_fam_sum(snap, "mxtpu_checkpoint_save_duration_us", "sum")
+             + _fam_sum(snap, "mxtpu_preempt_flush_duration_us", "sum")) / 1e6,
+        "retry_recovery": _span_sum_s(snap, RECOVERY_SPANS),
+        "drain": _span_sum_s(snap, DRAIN_SPANS),
+    }
+    active = sum(buckets.values())
+    if elapsed_s is None:
+        buckets["idle"] = 0.0
+        return buckets
+    elapsed_s = max(0.0, float(elapsed_s))
+    if active > elapsed_s and active > 0.0:
+        # overlapped work (pipelined prep/step threads, N replicas in one
+        # process) can book more active seconds than one wall clock holds;
+        # scale proportionally so the attribution still reconciles
+        scale = elapsed_s / active
+        for k in buckets:
+            buckets[k] *= scale
+        active = elapsed_s
+    buckets["idle"] = max(0.0, elapsed_s - active)
+    return buckets
+
+
+def wall_seconds() -> float:
+    """Elapsed wall this process's goodput attributes over."""
+    return time.perf_counter() - _T0
+
+
+def account(snap: Optional[Dict] = None) -> Dict[str, float]:
+    """Attribute wall time now and publish the result as metrics.
+
+    Emits the per-bucket DELTA since the previous accounting into
+    ``mxtpu_goodput_seconds_total{bucket=...}`` (so the counter stays
+    monotone and its absolute value equals the current attribution) and
+    refreshes ``mxtpu_goodput_wall_seconds``. Returns the absolute bucket
+    attribution. A bucket whose absolute value shrank (proportional
+    rescaling between calls) emits no negative delta — the counter keeps
+    its high-water value and re-converges on the next call.
+    """
+    global _LAST_WALL
+    if snap is None:
+        snap = REGISTRY.snapshot()
+    elapsed = wall_seconds()
+    buckets = attribute(snap, elapsed)
+    with _LOCK:
+        for bucket, absolute in buckets.items():
+            delta = absolute - _LAST.get(bucket, 0.0)
+            if delta > 0:
+                _GOODPUT.labels(bucket).inc(delta)
+                _LAST[bucket] = absolute
+        _WALL.set(elapsed)
+        _LAST_WALL = elapsed
+    return buckets
+
+
+def utilization(snap: Optional[Dict] = None,
+                records: Optional[List[Dict]] = None) -> List[Dict]:
+    """Per-executable achieved-vs-peak utilization estimates.
+
+    For every distinct compile-ledger fingerprint with ``cost_analysis``
+    flops/bytes, the achieved rate is flops (bytes) divided by the observed
+    mean step time of that executable's site — serving sites read their
+    endpoint's ``mxtpu_serving_step_latency_us`` mean, train sites the
+    ``mxtpu_train_step_latency_us`` mean. With MXNET_GOODPUT_PEAK_FLOPS /
+    MXNET_GOODPUT_PEAK_GBS set, each row also carries the roofline
+    fraction. Rows without an observed step (never executed under load)
+    are skipped.
+    """
+    if snap is None:
+        snap = REGISTRY.snapshot()
+    if records is None:
+        from . import compile_ledger
+        records = compile_ledger.recent()
+    peak_flops = float(_cfg("MXNET_GOODPUT_PEAK_FLOPS", 0.0) or 0.0)
+    peak_gbs = float(_cfg("MXNET_GOODPUT_PEAK_GBS", 0.0) or 0.0)
+
+    def _mean_us(name, label_filter=None):
+        fam = (snap.get("metrics") or {}).get(name)
+        if not fam:
+            return 0.0
+        n = total = 0.0
+        for s in fam.get("series", []):
+            if label_filter:
+                labels = s.get("labels") or {}
+                if any(labels.get(k) != v for k, v in label_filter.items()):
+                    continue
+            n += float(s.get("count", 0))
+            total += float(s.get("sum", 0.0))
+        return (total / n) if n else 0.0
+
+    rows: List[Dict] = []
+    seen = set()
+    for rec in records:
+        fp = rec.get("fingerprint")
+        flops = rec.get("flops")
+        nbytes = rec.get("bytes_accessed")
+        if not fp or fp in seen or (not flops and not nbytes):
+            continue
+        seen.add(fp)
+        site = rec.get("site", "?")
+        key = rec.get("key") or {}
+        if site == "serving_bucket" and key.get("endpoint"):
+            step_us = _mean_us("mxtpu_serving_step_latency_us",
+                               {"endpoint": str(key["endpoint"])})
+        elif site.startswith("train"):
+            step_us = _mean_us("mxtpu_train_step_latency_us")
+        else:
+            step_us = 0.0
+        if not step_us:
+            continue
+        step_s = step_us / 1e6
+        row = {"fingerprint": fp[:12], "site": site, "key": key,
+               "step_mean_s": round(step_s, 6)}
+        if flops:
+            row["flops"] = float(flops)
+            row["achieved_flops_s"] = float(flops) / step_s
+            if peak_flops > 0:
+                row["flops_frac_of_peak"] = round(
+                    row["achieved_flops_s"] / peak_flops, 4)
+        if nbytes:
+            row["bytes_accessed"] = float(nbytes)
+            row["achieved_bytes_s"] = float(nbytes) / step_s
+            if peak_gbs > 0:
+                row["bytes_frac_of_peak"] = round(
+                    row["achieved_bytes_s"] / peak_gbs, 4)
+        rows.append(row)
+    return rows
+
+
+def reset(t0: Optional[float] = None):
+    """Restart the attribution clock (tests; a scripted run sets its own t0
+    on the perf_counter timebase). Also zeroes the emitted counter series —
+    the ledger's invariant is "counter == the current attribution since the
+    last reset", so a fresh clock must mean a fresh ledger (otherwise the
+    next :func:`account` would re-add absolutes on top of the old ones and
+    a dump would no longer reconcile against the wall gauge)."""
+    global _T0, _LAST_WALL
+    with _LOCK:
+        _T0 = time.perf_counter() if t0 is None else float(t0)
+        _LAST.clear()
+        _LAST_WALL = 0.0
+        for _labels, child in _GOODPUT._series():
+            child._value = 0.0
+        _WALL.set(0.0)

@@ -52,6 +52,10 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      a synthetic SLO burn must scale the Autoscaler's replica pool up to
      max and recovery back down to min with no dropped requests across
      any cutover and an ``autoscale_*`` flight event per transition.
+     Both drills additionally run under a private span-spool dir and must
+     leave a parseable ``tools/fleet_report.py`` report whose journey for
+     the drill's trace id names >=2 processes/replicas (cache_poison's
+     warmer is a real subprocess; autoscale routes across pool replicas).
 
 Every run prints its seed; a failing seed is a deterministic repro::
 
@@ -719,6 +723,38 @@ def check_decode(seed, requests=6, p=0.0, max_new=18):
             "kv_pages_leaked": pool_leak, "ok": bool(ok)}
 
 
+# phase A of cache_poison, run as a REAL separate process: the "previous
+# server" that populates the executable cache. Its spans join the parent's
+# cross-process journey via the inherited MXNET_TRACE_ID, and its registry
+# snapshot lands next to the parent's for tools/fleet_report.py.
+_CACHE_WARMER_SRC = """\
+import os
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serving, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.telemetry import goodput
+
+mx.random.seed({seed}); onp.random.seed({seed})
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(16, activation="relu"), nn.Dense({out_dim}))
+net.initialize(mx.init.Xavier())
+net(nd.array(onp.zeros((2, {in_dim}), "float32")))
+srv = serving.InferenceServer(batch_timeout_ms=1.0)
+srv.register(serving.ModelEndpoint({name!r}, net,
+                                   input_shapes=({in_dim},), max_batch_size=4))
+srv.start()
+srv.stop()
+serving.unregister({name!r})
+goodput.account()
+dump = os.environ.get("CHAOS_DUMP_PATH", "")
+if dump:
+    telemetry.dump(dump)
+telemetry.spool_flush()
+"""
+
+
 def check_cache_poison(seed, requests=16, p=0.0, in_dim=8, out_dim=4):
     """SCENARIO cache_poison (r17): a prior server populated the persistent
     executable cache; a ``cache_poison`` fault corrupts one entry ON DISK
@@ -726,7 +762,9 @@ def check_cache_poison(seed, requests=16, p=0.0, in_dim=8, out_dim=4):
     must detect the corruption, delete the entry and fall back to a live
     recompile — zero client-visible errors, every served output bitwise
     equal to the direct forward, and the store healed (the recompile
-    re-stored the entry)."""
+    re-stored the entry). The prior server is a genuine subprocess, so the
+    drill's trace journey crosses a real process boundary."""
+    import subprocess
     import mxnet_tpu as mx
     from mxnet_tpu import config, nd, serving
     from mxnet_tpu.cache import executable_cache as xcache
@@ -753,13 +791,20 @@ def check_cache_poison(seed, requests=16, p=0.0, in_dim=8, out_dim=4):
     # the endpoint name, so a restarted endpoint must keep its name to hit
     name_b = f"chaos_cp_{seed}"
     try:
-        # phase A: the "previous process" — warmup compiles + stores
-        srv_a = serving.InferenceServer(batch_timeout_ms=1.0)
-        srv_a.register(serving.ModelEndpoint(
-            name_b, mlp(seed), input_shapes=(in_dim,), max_batch_size=4))
-        srv_a.start()
-        srv_a.stop()
-        serving.unregister(name_b)
+        # phase A: the "previous process" — a real subprocess warms the
+        # shared on-disk cache (compiles + stores) and exits; it inherits
+        # the trace/spool env so its spans land in the same journey
+        env = dict(os.environ)
+        env["MXNET_EXEC_CACHE_DIR"] = d
+        fleet_dir = env.get("CHAOS_FLEET_DIR", "")
+        if fleet_dir:
+            env["CHAOS_DUMP_PATH"] = os.path.join(
+                fleet_dir, "dump-warmer.json")
+        warmer = subprocess.run(
+            [sys.executable, "-c", _CACHE_WARMER_SRC.format(
+                seed=seed, in_dim=in_dim, out_dim=out_dim, name=name_b)],
+            env=env, capture_output=True, text=True)
+        warmer_ok = warmer.returncode == 0
         stored = len(xcache.entries())
 
         # phase B: warm restart under poison — first load hits a payload
@@ -798,9 +843,12 @@ def check_cache_poison(seed, requests=16, p=0.0, in_dim=8, out_dim=4):
         o is not None and onp.array_equal(o, direct[i])
         for i, o in enumerate(outs))
     hits = after["hits"] - before["hits"]
-    ok = (inj.fires >= 1 and corrupt_misses >= 1 and errors == 0 and
-          bitwise and hits >= 1 and stored >= 2 and healed == stored)
+    ok = (warmer_ok and inj.fires >= 1 and corrupt_misses >= 1 and
+          errors == 0 and bitwise and hits >= 1 and stored >= 2 and
+          healed == stored)
     return {"phase": "cache_poison", "seed": seed, "requests": requests,
+            "warmer_subprocess_ok": warmer_ok,
+            "warmer_stderr_tail": "" if warmer_ok else warmer.stderr[-500:],
             "faults_fired": inj.fires, "entries_stored_cold": stored,
             "entries_after_heal": healed, "corrupt_misses": corrupt_misses,
             "warm_cache_hits": hits, "client_errors": errors,
@@ -1044,6 +1092,67 @@ def check_flight_bundle(name, fn):
     return res
 
 
+def check_fleet_report(name, fn):
+    """Run one scenario with a private span-spool + snapshot-dump dir and
+    assert the fleet plane captured the drill: ``tools/fleet_report.py``
+    over the dumps must build a machine-parseable report, and the journey
+    of the scenario's trace id must name at least two distinct
+    processes/replicas — the traced request really crossed a process or
+    replica boundary. The env knobs (not config overrides) carry the trace:
+    subprocesses the scenario spawns inherit them at fork."""
+    import glob as _glob
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import goodput
+    from mxnet_tpu.telemetry import tracing as _tracing
+
+    fdir = tempfile.mkdtemp(prefix=f"chaos-fleet-{name}-")
+    spool = os.path.join(fdir, "spool")
+    trace_id = telemetry.new_trace_id()
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_SPAN_SPOOL_DIR", "MXNET_TRACE_ID", "CHAOS_FLEET_DIR")}
+    os.environ["MXNET_SPAN_SPOOL_DIR"] = spool
+    os.environ["MXNET_TRACE_ID"] = trace_id
+    os.environ["CHAOS_FLEET_DIR"] = fdir
+    _tracing._reset_spool_for_tests()   # re-resolve the inherited trace id
+    try:
+        res = fn()
+    finally:
+        telemetry.spool_flush()
+        goodput.account()
+        telemetry.dump(os.path.join(fdir, f"dump-parent-{os.getpid()}.json"))
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _tracing._reset_spool_for_tests()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import fleet_report
+    finally:
+        sys.path.pop(0)
+    procs = []
+    parse_ok = False
+    try:
+        report = fleet_report.build_report(
+            sorted(_glob.glob(os.path.join(fdir, "dump-*.json"))),
+            spool_dir=spool, trace=trace_id)
+        json.dumps(report)          # parseable end-to-end, no repr leakage
+        procs = report["journey"]["processes"]
+        parse_ok = True
+    except Exception as e:
+        res["fleet_error"] = repr(e)
+    pids = [x for x in procs if x.startswith("pid=")]
+    reps = [x for x in procs if x.startswith("replica=")]
+    fleet_ok = parse_ok and (len(pids) >= 2 or len(reps) >= 2)
+    res["fleet_dir"] = fdir
+    res["fleet_trace"] = trace_id
+    res["fleet_journey_processes"] = procs
+    res["fleet_ok"] = bool(fleet_ok)
+    res["ok"] = bool(res["ok"] and fleet_ok)
+    return res
+
+
 def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
               scenarios=None, out=sys.stdout):
     """Legacy train+serving sweep (scenarios=None), or the elastic scenario
@@ -1076,9 +1185,11 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
                 res = check_flight_bundle(name, lambda: check_dlrm(
                     seed, steps=max(4, steps // 2)))
             elif name == "cache_poison":
-                res = check_cache_poison(seed, requests=max(8, requests // 2))
+                res = check_fleet_report(name, lambda: check_cache_poison(
+                    seed, requests=max(8, requests // 2)))
             elif name == "autoscale":
-                res = check_autoscale(seed, requests=max(8, requests // 2))
+                res = check_fleet_report(name, lambda: check_autoscale(
+                    seed, requests=max(8, requests // 2)))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

@@ -1,0 +1,185 @@
+"""Render a fleet of telemetry snapshot dumps as ONE merged report.
+
+Pairs with ``mxnet_tpu.telemetry.fleet``: every process in a fleet (pool
+replicas, loadgen restart children, chaos subprocesses) exports its registry
+via ``telemetry.dump(path)`` / ``MXNET_TELEMETRY_DUMP_PATH``; this tool
+folds those files — from the outside, no live process needed — into the
+same one-pane view ``/fleetz`` serves live:
+
+    # merged metrics table: every series labeled replica=<file>, plus
+    # replica=ALL rollups (bucket-merged histograms, summed counters)
+    python tools/fleet_report.py /tmp/fleet/*.json
+
+    # + the goodput ledger per process, verified: buckets must sum to the
+    # recorded wall clock within --tol (default 1%); rc 1 when they don't
+    python tools/fleet_report.py /tmp/fleet/*.json --verify
+
+    # + one trace's cross-process journey from the span spools
+    python tools/fleet_report.py /tmp/fleet/*.json \
+        --spool-dir /tmp/spool --trace 4fa1b2c3d4e5f607
+
+    # machine-readable everything (the chaos harness asserts on this)
+    python tools/fleet_report.py /tmp/fleet/*.json --json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+
+
+def _tool(name):
+    sys.path.insert(0, _TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def goodput_from_snapshot(snap):
+    """{wall_s, buckets} as the process recorded them — the
+    ``mxtpu_goodput_seconds_total{bucket=...}`` series plus the
+    ``mxtpu_goodput_wall_seconds`` gauge a ``goodput.account()`` call wrote
+    before the dump. ``wall_s`` is None when the process never accounted."""
+    metrics = snap.get("metrics") or {}
+    buckets = {}
+    fam = metrics.get("mxtpu_goodput_seconds_total")
+    for s in (fam or {}).get("series", []):
+        b = (s.get("labels") or {}).get("bucket")
+        if b:
+            buckets[b] = float(s.get("value", 0.0))
+    wall = None
+    wfam = metrics.get("mxtpu_goodput_wall_seconds")
+    if wfam and wfam.get("series"):
+        wall = float(wfam["series"][0].get("value", 0.0))
+    return {"wall_s": wall, "buckets": buckets}
+
+
+def verify_goodput(gp, tol=0.01):
+    """Buckets-vs-wall reconciliation: sum(buckets) within ``tol`` of the
+    recorded wall clock. A process with no accounting passes vacuously."""
+    if gp["wall_s"] is None or not gp["buckets"]:
+        return True
+    total = sum(gp["buckets"].values())
+    return abs(total - gp["wall_s"]) <= tol * max(gp["wall_s"], 1e-9)
+
+
+def build_report(paths, spool_dir=None, trace=None, tol=0.01):
+    """The whole report as one dict: merged metrics, per-process goodput
+    (with reconciliation verdicts), optional cross-process journey."""
+    from mxnet_tpu.telemetry import fleet
+    metrics_dump = _tool("metrics_dump")
+
+    snaps = {}
+    for p in paths:
+        label = os.path.basename(p)
+        if label in snaps:
+            label = p
+        snaps[label] = metrics_dump.load_snapshot(p)
+
+    goodput = {}
+    for label, snap in sorted(snaps.items()):
+        gp = goodput_from_snapshot(snap)
+        gp["sum_s"] = sum(gp["buckets"].values())
+        gp["reconciles"] = verify_goodput(gp, tol)
+        goodput[label] = gp
+
+    report = {
+        "processes": len(snaps),
+        "sources": sorted(snaps.keys()),
+        "merged": fleet.merge_snapshots(snaps),
+        "goodput": goodput,
+        "goodput_ok": all(gp["reconciles"] for gp in goodput.values()),
+    }
+    if trace:
+        from mxnet_tpu import telemetry
+        trace_journey = _tool("trace_journey")
+        hops = telemetry.journey(trace, spool_dir)
+        report["journey"] = {
+            "trace_id": trace,
+            "hops": hops,
+            "processes": trace_journey.journey_processes(hops),
+        }
+    return report
+
+
+def render(report, include_zero=False):
+    metrics_dump = _tool("metrics_dump")
+    lines = [f"fleet report: {report['processes']} process(es) "
+             f"[{', '.join(report['sources'])}]", ""]
+    lines.append("== merged metrics (replica=ALL rows are the "
+                 "cross-replica rollup) ==")
+    lines.append(metrics_dump.render_table(report["merged"], include_zero))
+
+    gp_rows = {k: v for k, v in report["goodput"].items()
+               if v["wall_s"] is not None or v["buckets"]}
+    if gp_rows:
+        lines.append("")
+        lines.append("== goodput ledger (seconds; buckets must sum to "
+                     "wall) ==")
+        buckets = sorted({b for gp in gp_rows.values()
+                          for b in gp["buckets"]})
+        head = f"{'process':<28}" + "".join(f"{b:>17}" for b in buckets)
+        head += f"{'sum':>10}{'wall':>10}  ok"
+        lines.append(head)
+        for label, gp in sorted(gp_rows.items()):
+            row = f"{label:<28}"
+            for b in buckets:
+                row += f"{gp['buckets'].get(b, 0.0):>17.3f}"
+            wall = f"{gp['wall_s']:.3f}" if gp["wall_s"] is not None else "?"
+            row += (f"{gp['sum_s']:>10.3f}{wall:>10}  "
+                    f"{'ok' if gp['reconciles'] else 'MISMATCH'}")
+            lines.append(row)
+
+    j = report.get("journey")
+    if j is not None:
+        trace_journey = _tool("trace_journey")
+        lines.append("")
+        lines.append("== trace journey ==")
+        lines.append(trace_journey.render_journey(j["trace_id"], j["hops"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Merge telemetry snapshot dumps from a fleet of "
+                    "processes into one report (metrics + goodput + "
+                    "optional trace journey).")
+    ap.add_argument("paths", nargs="+",
+                    help="snapshot JSON files written by telemetry.dump() "
+                         "(shells expand the glob)")
+    ap.add_argument("--spool-dir", default=None,
+                    help="MXNET_SPAN_SPOOL_DIR directory for --trace")
+    ap.add_argument("--trace", metavar="ID", default=None,
+                    help="include this trace id's cross-process journey")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the whole report as JSON")
+    ap.add_argument("--all", action="store_true",
+                    help="include zero-valued series in the metrics table")
+    ap.add_argument("--verify", action="store_true",
+                    help="exit 1 unless every process's goodput buckets "
+                         "sum to its wall clock within --tol")
+    ap.add_argument("--tol", type=float, default=0.01,
+                    help="goodput reconciliation tolerance (default 0.01)")
+    args = ap.parse_args(argv)
+
+    report = build_report(args.paths, spool_dir=args.spool_dir,
+                          trace=args.trace, tol=args.tol)
+    if args.json:
+        print(json.dumps(report, indent=1, sort_keys=True))
+    else:
+        print(render(report, include_zero=args.all))
+    if args.verify and not report["goodput_ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:      # |head closed the pipe — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -15,6 +15,11 @@ the outside and renders what an on-call human asks first:
     python tools/flight_inspect.py DIR --threads     # include thread stacks
     python tools/flight_inspect.py DIR --json        # raw bundle, pretty
 
+    # cross-process journey of one trace id: here PATH is a span-spool
+    # directory (MXNET_SPAN_SPOOL_DIR), not a flight bundle — the same
+    # rendering tools/trace_journey.py gives, reachable mid-post-mortem
+    python tools/flight_inspect.py /tmp/spool --trace 4fa1b2c3d4e5f607
+
 The timeline groups spans by trace id (a serving request's id survives
 submit -> batch assembly -> device step, so one group is one logical
 request), orders groups by first activity, and interleaves the structured
@@ -230,7 +235,25 @@ def main(argv=None):
                     help="include full thread stacks in the rendering")
     ap.add_argument("--max-traces", type=int, default=50,
                     help="max trace groups to render (default 50)")
+    ap.add_argument("--trace", metavar="ID", default=None,
+                    help="treat PATH as a MXNET_SPAN_SPOOL_DIR and render "
+                         "this trace id's cross-process journey")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        try:
+            import trace_journey
+        finally:
+            sys.path.pop(0)
+        from mxnet_tpu import telemetry
+        hops = telemetry.journey(args.trace, args.path)
+        if args.json:
+            print(json.dumps({"trace_id": args.trace, "hops": hops},
+                             indent=1, sort_keys=True))
+        else:
+            print(trace_journey.render_journey(args.trace, hops))
+        return 0 if hops else 1
 
     path = resolve_bundle(args.path)
     bundle = load(path)

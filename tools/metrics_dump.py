@@ -23,6 +23,12 @@ the file every ``MXNET_TELEMETRY_DUMP_INTERVAL`` seconds) or by calling
 
     # include zero-valued series (the full registered catalog)
     python tools/metrics_dump.py /tmp/mxtpu.json --all
+
+    # several snapshot files (a fleet of processes): merged into ONE view
+    # where every series gains a replica=<file> label and histogram /
+    # counter families grow replica=ALL rollup rows (exact cross-replica
+    # quantiles via telemetry.fleet's bucket-count merge)
+    python tools/metrics_dump.py /tmp/fleet/*.json
 """
 import argparse
 import json
@@ -112,11 +118,29 @@ def load_snapshot(path):
             "with telemetry.dump(path) / MXNET_TELEMETRY_DUMP_PATH?") from e
 
 
+def load_merged(paths):
+    """One snapshot-shaped dict from N snapshot files. A single file loads
+    verbatim; several merge through ``telemetry.fleet.merge_snapshots`` —
+    per-replica labeled series plus exact replica=ALL rollups."""
+    if len(paths) == 1:
+        return load_snapshot(paths[0])
+    from mxnet_tpu.telemetry import fleet
+    snaps = {}
+    for p in paths:
+        label = os.path.basename(p)
+        if label in snaps:      # same basename in two dirs: full path wins
+            label = p
+        snaps[label] = load_snapshot(p)
+    return fleet.merge_snapshots(snaps)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Render a mxnet_tpu.telemetry snapshot file.")
-    ap.add_argument("path", help="snapshot JSON written by telemetry.dump() "
-                                 "or the MXNET_TELEMETRY_DUMP_PATH reporter")
+    ap.add_argument("path", nargs="+",
+                    help="snapshot JSON written by telemetry.dump() or the "
+                         "MXNET_TELEMETRY_DUMP_PATH reporter; several files "
+                         "merge into one replica-labeled fleet view")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--prom", action="store_true",
                       help="emit Prometheus text exposition")
@@ -137,17 +161,17 @@ def main(argv=None):
             return json.dumps(snap, indent=1, sort_keys=True)
         ts = snap.get("ts")
         age = f" (snapshot age {time.time() - ts:.1f}s)" if ts else ""
-        return f"# {args.path}{age}\n" + render_table(snap, args.all,
-                                                      rates=rates)
+        return (f"# {' '.join(args.path)}{age}\n"
+                + render_table(snap, args.all, rates=rates))
 
     if args.watch is None:
-        print(render(load_snapshot(args.path)))
+        print(render(load_merged(args.path)))
         return 0
     # watch mode: diff consecutive reads so _total counters also show Δ/s
     prev_totals, prev_ts = None, None
     try:
         while True:
-            snap = load_snapshot(args.path)
+            snap = load_merged(args.path)
             now = snap.get("ts") or time.time()
             totals = counter_totals(snap)
             rates = {}

@@ -1,0 +1,162 @@
+"""Assemble one cross-process timeline for a trace id from span spools.
+
+Pairs with ``mxnet_tpu.telemetry.tracing``: every process running with
+``MXNET_SPAN_SPOOL_DIR`` set spills its finished spans into an append-only
+per-pid ``spool-<pid>.jsonl`` file. A trace id crosses process boundaries
+via the ``MXNET_TRACE_ID`` env knob (parent -> spawned child) and via the
+request field the serving path stamps (submitter -> pool replica -> worker
+thread), so one logical request leaves span lines in *several* processes'
+spools. This tool reads them all from the outside and renders ONE ordered
+journey:
+
+    # every trace id seen in the directory, with hop/process counts
+    python tools/trace_journey.py /tmp/spool --list
+
+    # the ordered timeline of one trace, naming each pid/replica crossed
+    python tools/trace_journey.py /tmp/spool --trace 4fa1b2c3d4e5f607
+
+    # machine-readable (the chaos harness asserts on this)
+    python tools/trace_journey.py /tmp/spool --trace ID --json
+
+``tools/flight_inspect.py --trace ID`` renders the same journey from a
+flight-debugging session.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_us(v):
+    if v is None:
+        return "?"
+    v = float(v)
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}s"
+    if v >= 1e3:
+        return f"{v / 1e3:.2f}ms"
+    return f"{v:.0f}us"
+
+
+def journey_processes(hops):
+    """Distinct process/replica names a journey crossed, in hop order.
+
+    A hop is named by its pid; a span carrying a ``replica`` attr (the
+    ``pool.submit`` span stamps the replica id it routed to) additionally
+    names that replica — so a 1-process, 3-replica pool still yields
+    distinct hop names per replica.
+    """
+    names = []
+    for h in hops:
+        pid = h.get("pid")
+        names.append(f"pid={pid}")
+        rid = (h.get("attrs") or {}).get("replica")
+        if rid is not None:            # replica ids start at 0 — still a hop
+            names.append(f"replica={rid}")
+    out = []
+    for n in names:
+        if n not in out:
+            out.append(n)
+    return out
+
+
+def render_journey(trace_id, hops):
+    """Human timeline: one line per hop, ordered by wall-clock start,
+    naming the pid (and replica, when a span carries one) of each."""
+    if not hops:
+        return f"trace {trace_id}: no spans in spool"
+    procs = journey_processes(hops)
+    t0 = hops[0].get("t0_wall", 0.0)
+    lines = [
+        f"trace {trace_id}: {len(hops)} spans across "
+        f"{sum(1 for p in procs if p.startswith('pid='))} process(es) "
+        f"[{' -> '.join(procs)}]",
+        f"  t0: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(t0))}",
+    ]
+    for h in hops:
+        attrs = dict(h.get("attrs") or {})
+        rid = attrs.pop("replica", None)
+        who = (f"pid={h.get('pid')}"
+               + (f" replica={rid}" if rid is not None else ""))
+        extra = f" {attrs}" if attrs else ""
+        lines.append(
+            f"  +{(h.get('t0_wall', t0) - t0) * 1e3:9.3f}ms "
+            f"{_fmt_us(h.get('dur_us')):>10} "
+            f"[{who:<24}] {h.get('name')}{extra}")
+    return "\n".join(lines)
+
+
+def list_traces(entries):
+    """{trace_id: {"hops", "pids", "first_t0", "names"}} over raw spool
+    lines — the --list index an operator scans for the trace to pull."""
+    traces = {}
+    for e in entries:
+        tid = e.get("trace_id")
+        if not tid:
+            continue
+        t = traces.setdefault(tid, {"hops": 0, "pids": set(),
+                                    "first_t0": None, "names": set()})
+        t["hops"] += 1
+        t["pids"].add(e.get("pid"))
+        t["names"].add(e.get("name"))
+        t0 = e.get("t0_wall")
+        if t0 is not None and (t["first_t0"] is None or t0 < t["first_t0"]):
+            t["first_t0"] = t0
+    return traces
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Assemble a cross-process span journey for a trace id "
+                    "from MXNET_SPAN_SPOOL_DIR spool files.")
+    ap.add_argument("spool_dir", help="directory of spool-<pid>.jsonl files")
+    ap.add_argument("--trace", metavar="ID",
+                    help="render the ordered journey of this trace id")
+    ap.add_argument("--list", action="store_true",
+                    help="list every trace id with hop/process counts")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the journey (or trace index) as JSON")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu import telemetry
+
+    if args.trace:
+        hops = telemetry.journey(args.trace, args.spool_dir)
+        if args.json:
+            print(json.dumps({"trace_id": args.trace, "hops": hops,
+                              "processes": journey_processes(hops)},
+                             indent=1, sort_keys=True))
+        else:
+            print(render_journey(args.trace, hops))
+        return 0 if hops else 1
+
+    entries = telemetry.read_spool(args.spool_dir)
+    traces = list_traces(entries)
+    if args.json:
+        print(json.dumps(
+            {tid: {"hops": t["hops"], "pids": sorted(t["pids"]),
+                   "first_t0": t["first_t0"], "names": sorted(t["names"])}
+             for tid, t in traces.items()}, indent=1, sort_keys=True))
+        return 0
+    if not traces:
+        print(f"no span lines under {args.spool_dir}")
+        return 1
+    print(f"{len(traces)} trace(s) in {args.spool_dir} "
+          f"({len(entries)} spans):")
+    for tid, t in sorted(traces.items(),
+                         key=lambda kv: kv[1]["first_t0"] or 0.0):
+        print(f"  {tid}  hops={t['hops']:<4} "
+              f"pids={','.join(str(p) for p in sorted(t['pids']))}  "
+              f"spans={','.join(sorted(t['names']))}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:      # |head closed the pipe — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
