@@ -66,6 +66,15 @@ ledger record is a cache hit, the recompile-storm duplicate counter stays
 0) and that first-request outputs are bitwise-identical across phases,
 then emits the gate row ``{"restart_to_first_request_s": <warm>, ...}``.
 
+r18 extends the restart benchmark to the serving fabric (``--fabric``):
+each restart child additionally builds a mesh-sharded endpoint
+(``serving.fabric.ShardedEndpoint`` on a 2-device slice) and serves one
+request through it. The sharded compile trigger key carries the mesh
+shape, so the warm child's zero-fresh-compiles assertion now also proves
+a restarted sharded replica with the same slice shape deserializes every
+bucket executable from the cache — and the sharded first-request digest
+must match bitwise across phases.
+
 CLI:
   --tenants N       register N endpoints of the model (t0..tN-1) on ONE
                     server and emit a per-tenant latency table per level
@@ -74,6 +83,8 @@ CLI:
   --serial          pipeline=False (the pre-r6 prepare-then-step path)
   --restart         run the cold/warm restart benchmark instead of the
                     load sweep (uses the SLG_* model/size knobs)
+  --fabric          with --restart: also run a mesh-sharded endpoint
+                    (2-device slice) through both phases
   --conc / --seconds / --img / --max-batch / --timeout-ms / --dtypes
                     override the corresponding SLG_* env knobs
 
@@ -414,6 +425,28 @@ def _run_restart_child(args, phase):
     dense_digest = hashlib.sha256(
         onp.ascontiguousarray(out.asnumpy()).tobytes()).hexdigest()
 
+    fab_t = fab_digest = None
+    if args.fabric:
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.serving.fabric import ShardedEndpoint, plan_slices
+        mx.random.seed(0)
+        onp.random.seed(0)
+        fnet = nn.HybridSequential()
+        with fnet.name_scope():
+            fnet.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        fnet.initialize(mx.init.Xavier())
+        fnet(mx.nd.array(onp.zeros((2, 16), "float32")))
+        sep = ShardedEndpoint("restart_sharded", fnet, input_shapes=(16,),
+                              dtype="float32", max_batch_size=4,
+                              slice_spec=plan_slices([2])[0])
+        server.register(sep)     # warmup: compiles cold, deserializes warm
+        fout = server.predict("restart_sharded",
+                              onp.arange(16, dtype="float32") / 16.0,
+                              timeout=120)
+        fab_t = time.perf_counter() - t0
+        fab_digest = hashlib.sha256(
+            onp.ascontiguousarray(fout.asnumpy()).tobytes()).hexdigest()
+
     dec_t = dec_digest = None
     if args.decode:
         from mxnet_tpu.gluon.model_zoo.bert import TransformerLM
@@ -435,12 +468,17 @@ def _run_restart_child(args, phase):
     cls = telemetry.compile_ledger.summary()
     server.stop(drain=True)
     serving.unregister(ep.name)
+    if args.fabric:
+        serving.unregister("restart_sharded")
     if args.decode:
         serving.unregister("restart_lm")
     print(json.dumps({
         "restart_child": phase,
-        "restart_to_first_request_s": round(max(dense_t, dec_t or 0.0), 3),
+        "restart_to_first_request_s": round(
+            max(dense_t, dec_t or 0.0, fab_t or 0.0), 3),
         "dense_first_s": round(dense_t, 3),
+        "fabric_first_s": round(fab_t, 3) if fab_t is not None else None,
+        "fabric_digest": fab_digest,
         "decode_first_s": round(dec_t, 3) if dec_t is not None else None,
         "compiles": cls["compiles"],
         "cache_hits": cls["cache_hits"],
@@ -466,6 +504,8 @@ def _run_restart(args):
                    "--timeout-ms", str(args.timeout_ms),
                    "--dec-seq", str(args.dec_seq),
                    "--dec-new", str(args.dec_new)]
+    if args.fabric:
+        child_flags.append("--fabric")
     rows = {}
     # both restart phases join the parent's trace journey: a child's root
     # spans adopt MXNET_TRACE_ID, and with a spool dir configured each
@@ -486,6 +526,13 @@ def _run_restart(args):
         # cache un-instrumented so op-level compiles don't muddy the count
         env["MXNET_COMPILE_LEDGER_EAGER"] = "0"
         env["SLG_DECODE"] = "1" if args.decode else "0"
+        if args.fabric and "xla_force_host_platform_device_count" \
+                not in env.get("XLA_FLAGS", ""):
+            # the 2-device slice needs >1 host device; the flag only
+            # multiplies the CPU platform, so it is harmless on real chips
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=8"
+                                ).strip()
         env["MXNET_TRACE_ID"] = trace_id
         if spool_dir:
             env["MXNET_SPAN_SPOOL_DIR"] = spool_dir
@@ -510,7 +557,8 @@ def _run_restart(args):
         print(json.dumps({"restart": phase,
                           **{k: row[k] for k in
                              ("restart_to_first_request_s", "dense_first_s",
-                              "decode_first_s", "compiles", "cache_hits",
+                              "fabric_first_s", "decode_first_s",
+                              "compiles", "cache_hits",
                               "fresh_compiles", "duplicates")}}),
               flush=True)
     cold, warm = rows["cold"], rows["warm"]
@@ -523,7 +571,7 @@ def _run_restart(args):
     assert warm["cache_hits"] == cold["compiles"], \
         f"warm hit {warm['cache_hits']} entries but cold compiled " \
         f"{cold['compiles']}"
-    for k in ("dense_digest", "decode_digest"):
+    for k in ("dense_digest", "fabric_digest", "decode_digest"):
         assert cold[k] == warm[k], \
             f"{k}: warm first-request output differs from cold " \
             f"({cold[k]} vs {warm[k]})"
@@ -583,6 +631,10 @@ def _parse_args():
     p.add_argument("--restart", action="store_true",
                    help="cold/warm restart-to-first-request benchmark "
                         "instead of the load sweep")
+    p.add_argument("--fabric", action="store_true",
+                   default=env("SLG_FABRIC", "0") == "1",
+                   help="with --restart: run a mesh-sharded endpoint "
+                        "(2-device slice) through both phases too")
     p.add_argument("--restart-child", default="", help=argparse.SUPPRESS)
     return p.parse_args()
 

@@ -277,6 +277,30 @@ register("MXNET_SERVING_DRAIN_TIMEOUT_S", 30.0, float,
          "drain; past it pending requests are abandoned (failed with "
          "ServerClosedError, counted in mxtpu_drain_abandoned_total) so a "
          "wedged endpoint can never hang shutdown forever.")
+register("MXNET_SERVING_PIPELINE_DEPTH", 1, int,
+         "InferenceServer prep/execute overlap depth: how many prepared "
+         "batches the prep loop may run ahead of the execute loop. Depth d "
+         "keeps d+1 staging parities alive (host buffers + device inputs); "
+         "1 reproduces classic double-buffering. The serial fallback "
+         "(pipeline=False) ignores it.")
+register("MXNET_SERVING_ZEROCOPY", True, bool,
+         "Batch assembly writes request rows straight into preallocated "
+         "per-(bucket, parity) staging buffers instead of numpy "
+         "concatenate+pad — zero intermediate host copies on the ingest "
+         "path. Off falls back to concat (the bitwise-identical slow "
+         "path).")
+register("MXNET_FABRIC_VNODES", 64, int,
+         "Serving front door: virtual nodes per host on the consistent-"
+         "hash tenant routing ring. More vnodes spread tenants more "
+         "evenly; fewer make the ring cheaper to walk.")
+register("MXNET_FABRIC_HEARTBEAT_S", 0.2, float,
+         "Serving front door: host agent heartbeat/dump cadence (seconds). "
+         "Each tick touches the host's heartbeat file, re-attributes "
+         "goodput and rewrites its telemetry dump for the fleet pane.")
+register("MXNET_FABRIC_HOST_TIMEOUT_S", 2.0, float,
+         "Serving front door: FrontDoor.check_hosts() declares a host dead "
+         "when its agent heartbeat is older than this many seconds (or the "
+         "agent process exited) and fails it over like kill_host().")
 register("MXNET_KV_PAGE_SIZE", 16, int,
          "Paged KV cache: token positions per page. Small pages waste less "
          "tail allocation per sequence but grow page tables; the page size "

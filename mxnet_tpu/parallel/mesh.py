@@ -18,7 +18,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from ..base import MXNetError
 
-__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "replicated", "shard_spec"]
+__all__ = ["DeviceMesh", "make_mesh", "current_mesh", "replicated", "shard_spec",
+           "carve_slices"]
 
 _AXES = ("dp", "fsdp", "pp", "tp", "sp", "ep")  # canonical ordering, outer→inner
 
@@ -129,6 +130,43 @@ def make_mesh(axes: Dict[str, int], devices=None) -> DeviceMesh:
     except Exception:
         dev_array = onp.asarray(devices).reshape(tuple(sizes))
     return DeviceMesh(Mesh(dev_array, tuple(names)))
+
+
+def carve_slices(sizes: Sequence[int], devices=None):
+    """Partition the visible device set into gang-scheduled slices.
+
+    ``sizes`` are per-slice device counts, carved contiguously from
+    ``devices`` (default: ``jax.devices()``) in order — contiguous ids map
+    to the tightest ICI neighborhoods on a real pod slice. Asymmetric sizes
+    are allowed (a 4-chip slice next to two singles), and the sizes need not
+    cover every device: the leftover tail stays uncarved (available for a
+    later ``carve_slices`` call or single-chip replicas). Returns a list of
+    device lists, one per slice.
+
+    Raises MXNetError when a size is < 1 or the sizes oversubscribe the
+    device set — a slice plan that silently wrapped around would
+    gang-schedule two "slices" onto the same chips.
+    """
+    import jax
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = [int(s) for s in sizes]
+    if not sizes:
+        raise MXNetError("carve_slices needs at least one slice size")
+    for s in sizes:
+        if s < 1:
+            raise MXNetError(f"slice sizes must be >= 1, got {s} in {sizes}")
+    if sum(sizes) > len(devices):
+        raise MXNetError(
+            f"slice plan {sizes} needs {sum(sizes)} devices, only "
+            f"{len(devices)} visible — slices must never share chips")
+    out = []
+    off = 0
+    for s in sizes:
+        out.append(devices[off:off + s])
+        off += s
+    return out
 
 
 def current_mesh() -> Optional[DeviceMesh]:

@@ -81,6 +81,16 @@ sequences), and ``server.register_generator(engine)`` /
 ``server.generate(name, prompt)`` expose it behind the InferenceServer
 facade with streaming ``TokenStream`` responses. Batched continuous decode
 is bitwise-equal to serial greedy decode (tier-1 oracle).
+
+r16 adds the serving fabric (``serving.fabric``): mesh-sharded replicas and
+a multi-host front door. ``plan_slices`` carves the visible device set into
+gang-scheduled slices; a ``ShardedEndpoint`` / ``ShardedDecodeEndpoint``
+spans one slice's mesh with NamedSharding-compiled bucket executables
+(bitwise-equal to the single-chip twins; same executable cache, compile
+ledger and warmup contracts), ``ServingPool.submit`` weights placement by
+replica capacity, and ``FrontDoor`` adds consistent-hash tenant→host
+routing with bounded rebalancing plus cross-host failover that replays a
+dead host's in-flight work on survivors — zero client-visible errors.
 """
 from __future__ import annotations
 
@@ -95,6 +105,9 @@ from . import bucketing
 from . import generate
 from .generate import (DecodeEndpoint, DecodeScheduler, PagedKVPool,
                        TokenStream)
+from . import fabric
+from .fabric import (FrontDoor, ShardedDecodeEndpoint, ShardedEndpoint,
+                     SliceSpec, plan_slices)
 
 __all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
            "get_endpoint", "list_endpoints", "unregister", "ServingError",
@@ -102,7 +115,8 @@ __all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
            "HotSwapError", "KVPoolExhausted", "Router", "StepCostEWMA",
            "Tenant", "bucketing", "generate", "DecodeEndpoint",
            "DecodeScheduler", "PagedKVPool", "TokenStream", "ServingPool",
-           "Autoscaler"]
+           "Autoscaler", "fabric", "FrontDoor", "ShardedEndpoint",
+           "ShardedDecodeEndpoint", "SliceSpec", "plan_slices"]
 
 
 def stats():

@@ -60,14 +60,21 @@ _EVENTS = _telemetry.counter(
     "mxtpu_autoscale_events_total",
     "Autoscaler actions taken, by direction (up / down).",
     labelnames=("direction",))
+_CAPACITY_G = _telemetry.gauge(
+    "mxtpu_pool_replica_capacity",
+    "Devices backing each pool replica (a mesh-sharded replica reports its "
+    "slice size; single-chip replicas report 1) — the weight submit() "
+    "divides queue load by.",
+    labelnames=("rid",))
 
 
 class _Replica:
-    __slots__ = ("rid", "server")
+    __slots__ = ("rid", "server", "capacity")
 
-    def __init__(self, rid: int, server: InferenceServer):
+    def __init__(self, rid: int, server: InferenceServer, capacity: int = 1):
         self.rid = rid
         self.server = server
+        self.capacity = max(int(capacity), 1)
 
 
 class ServingPool:
@@ -107,10 +114,14 @@ class ServingPool:
         server = self._factory(rid)
         if server.state != "running":
             server.start()
+        with server._cond:
+            capacity = max((getattr(t.endpoint, "capacity", 1)
+                            for t in server._router.tenants()), default=1)
         with self._lock:
-            self._replicas.append(_Replica(rid, server))
+            self._replicas.append(_Replica(rid, server, capacity))
             n = len(self._replicas)
         _REPLICAS_G.set(n)
+        _CAPACITY_G.labels(str(rid)).set(capacity)
         return rid
 
     def scale_down(self, drain_timeout_s: Optional[float] = None
@@ -136,7 +147,10 @@ class ServingPool:
             return list(self._replicas)
 
     def submit(self, name: str, inputs, deadline_ms: Optional[float] = None):
-        """Route one request to the least-loaded replica in rotation.
+        """Route one request to the least-loaded replica in rotation,
+        where load is queued rows divided by replica capacity — a 4-chip
+        mesh-sharded replica keeps attracting traffic until it holds ~4x a
+        single chip's queue, so heterogeneous pools utilize every chip.
         A replica that sheds (overload / mid-cutover close) falls through
         to the next-least-loaded one before the error reaches the client."""
         replicas = self._rotation()
@@ -162,10 +176,14 @@ class ServingPool:
         return self.submit(name, inputs, deadline_ms).result(timeout=timeout)
 
     @staticmethod
-    def _load_of(rep: _Replica) -> int:
+    def _raw_load(rep: _Replica) -> int:
         srv = rep.server
         with srv._cond:
             return sum(len(t.queue) for t in srv._router.tenants())
+
+    @classmethod
+    def _load_of(cls, rep: _Replica) -> float:
+        return cls._raw_load(rep) / rep.capacity
 
     # ------------------------------------------------------------------
     # signals / lifecycle
@@ -195,7 +213,10 @@ class ServingPool:
     def snapshot(self) -> dict:
         replicas = self._rotation()
         return {"replicas": [{"rid": r.rid, "state": r.server.state,
-                              "load": self._load_of(r)} for r in replicas],
+                              "capacity": r.capacity,
+                              "load": self._raw_load(r),
+                              "weighted_load": round(self._load_of(r), 4)}
+                             for r in replicas],
                 "size": len(replicas),
                 "queue_pressure": round(self.queue_pressure(), 4)}
 

@@ -73,6 +73,11 @@ class ModelEndpoint:
         Device the endpoint serves from (default: current context).
     """
 
+    #: devices one replica of this endpoint occupies — the weight
+    #: ServingPool.submit divides queue load by, so a 4-chip sharded
+    #: replica attracts ~4x a single-chip one's share
+    capacity = 1
+
     def __init__(self, name: str, block, input_shapes, dtype="float32",
                  max_batch_size: int = 32,
                  buckets: Optional[Sequence[int]] = None,
@@ -113,9 +118,15 @@ class ModelEndpoint:
         # so no batch ever sees a half-loaded model
         self._active_params: Optional[Tuple] = None
         self._weights_epoch = 0
-        # double-buffer parity slots: the pipeline's prep stage writes the
-        # input-buffer set for parity p while the executable reads parity 1-p
-        self._parity_bufs: list = [None, None]
+        # parity slots of the host pipeline: the prep stage writes the
+        # input-buffer set for parity p while the executable reads another;
+        # a depth-d pipeline keeps at most d+1 batches alive, so slots are
+        # keyed by parity mod (depth+1) — sized lazily as parities appear
+        self._parity_bufs: Dict[int, tuple] = {}
+        # zero-copy ingest: preallocated host staging buffers, one set per
+        # (bucket, parity slot) — request rows are written in place instead
+        # of concatenated, so steady state allocates nothing per batch
+        self._staging: Dict[tuple, tuple] = {}
         self._probe()
 
         with _REG_LOCK:
@@ -182,7 +193,7 @@ class ModelEndpoint:
             "serving", f"{self.name}.parity_bufs", owner=self,
             device=self._device_label(),
             sizer=lambda ep: _memstats.nbytes_of(
-                [slot[1] for slot in ep._parity_bufs if slot]))
+                [slot[1] for slot in ep._parity_bufs.values() if slot]))
 
     def _device_label(self) -> str:
         """The memstats/ledger device label ('cpu:0', 'tpu:3', ...)."""
@@ -203,9 +214,22 @@ class ModelEndpoint:
         the compiled-once-per-bucket property is preserved."""
         return self.ctx.jax_device().platform in ("tpu", "gpu")
 
+    def _place_inputs(self, arrays):
+        """Host->device placement of one batch's input arrays. The hook a
+        mesh-sharded endpoint overrides (NamedSharding placement); the base
+        endpoint puts everything on its single context device."""
+        import jax
+        dev = self.ctx.jax_device()
+        return tuple(jax.device_put(a, dev) for a in arrays)
+
+    def _jit_infer(self, infer, donate):
+        """Wrap the traced inference function in ``jax.jit``. Sharded
+        endpoints override to pin NamedSharding in/out shardings."""
+        import jax
+        return jax.jit(infer, donate_argnums=donate)
+
     def _infer_fn(self):
         if self._jfn is None:
-            import jax
             from ..gluon.block import pure_apply
             block, plist = self.block, self._params
 
@@ -216,7 +240,7 @@ class ModelEndpoint:
 
             donate = tuple(range(1, 1 + len(self.input_shapes))) \
                 if self._donate_inputs() else ()
-            self._jfn = jax.jit(infer, donate_argnums=donate)
+            self._jfn = self._jit_infer(infer, donate)
         return self._jfn
 
     def _param_datas(self):
@@ -232,6 +256,18 @@ class ModelEndpoint:
     # ------------------------------------------------------------------
     # the shape-bucketed executable cache
     # ------------------------------------------------------------------
+    def _compile_key(self, bucket: int) -> Dict[str, object]:
+        """The compile-ledger / executable-cache trigger key for one bucket.
+        Everything in it must be stable across process restarts that should
+        share cached executables — a sharded endpoint overrides the device
+        entry with its slice *shape* so a restarted replica on the same
+        slice topology hits the fleet cache instead of recompiling."""
+        return {"endpoint": self.name, "bucket": bucket,
+                "dtype": str(self._jnp_dtypes[0].__name__
+                             if hasattr(self._jnp_dtypes[0], "__name__")
+                             else self._jnp_dtypes[0]),
+                "device": self._device_label()}
+
     def _get_executable(self, bucket: int):
         comp = self._execs.get(bucket)
         if comp is not None:
@@ -260,13 +296,8 @@ class ModelEndpoint:
                     for s, dt in zip(self.input_shapes, self._jnp_dtypes))
                 comp = _ledger.lower_and_compile(
                     self._infer_fn(), (param_sds,) + in_sds,
-                    site="serving_bucket",
-                    key={"endpoint": self.name, "bucket": bucket,
-                         "dtype": str(self._jnp_dtypes[0].__name__
-                                      if hasattr(self._jnp_dtypes[0],
-                                                 "__name__")
-                                      else self._jnp_dtypes[0]),
-                         "device": self._device_label()})
+                    site="serving_bucket", key=self._compile_key(bucket))
+            self._adopt_compiled(comp)
             self._execs[bucket] = comp
             # attribute the executable's own device footprint (output +
             # scratch + generated code; arguments belong to params/inputs)
@@ -293,15 +324,39 @@ class ModelEndpoint:
             if fresh:
                 n += 1
                 if execute:
-                    ins = tuple(a.data for a in self._zeros_batch(b))
+                    ins = self._warmup_inputs(b)
                     t0 = _now_us()
                     jax.block_until_ready(comp(self._param_datas(), *ins))
                     self.step_cost.observe(b, _now_us() - t0)
         return n
 
+    def _warmup_inputs(self, bucket: int):
+        """Zero inputs for one warmup execution of ``bucket``."""
+        return tuple(a.data for a in self._zeros_batch(bucket))
+
+    def _adopt_compiled(self, comp):
+        """Hook: inspect a just-obtained executable before first use.
+        Sharded endpoints adopt a cache-deserialized executable's device
+        assignment here; the single-device path needs nothing."""
+
     # ------------------------------------------------------------------
     # execution: prepare (host half) / execute (device half)
     # ------------------------------------------------------------------
+    def staging_buffers(self, bucket: int, parity: int):
+        """Preallocated host staging buffers for one (bucket, parity slot):
+        the zero-copy prep path writes request rows straight into these and
+        zeroes the padding tail, instead of concat + pad allocating per
+        batch. The parity discipline that protects the device-side buffer
+        sets protects these too — the slot being written is never the slot
+        an in-flight batch still references."""
+        key = (int(bucket), int(parity))
+        bufs = self._staging.get(key)
+        if bufs is None:
+            bufs = tuple(onp.zeros((bucket,) + s, dt)
+                         for s, dt in zip(self.input_shapes, self.np_dtypes))
+            self._staging[key] = bufs
+        return bufs
+
     def prepare(self, host_inputs: Sequence[onp.ndarray], rows: int,
                 parity: int = 0):
         """Host half of one batch step: pad pre-concatenated host inputs to
@@ -312,12 +367,10 @@ class ModelEndpoint:
         Returns ``(device_inputs, bucket, padded_host)``; ``padded_host`` is
         kept with the prepared batch so a retry can rebuild donated buffers.
         """
-        import jax
         bucket = bucketing.bucket_for(rows, self.buckets)
         padded = tuple(bucketing.pad_rows(a, bucket) for a in host_inputs)
-        dev = self.ctx.jax_device()
-        ins = tuple(jax.device_put(a, dev) for a in padded)
-        self._parity_bufs[parity % 2] = (bucket, ins)
+        ins = self._place_inputs(padded)
+        self._parity_bufs[parity] = (bucket, ins)
         return ins, bucket, padded
 
     def execute(self, device_inputs, bucket: int, rows: int,
@@ -334,8 +387,7 @@ class ModelEndpoint:
         if padded_host is not None and any(
                 getattr(a, "is_deleted", lambda: False)()
                 for a in device_inputs):
-            dev = self.ctx.jax_device()
-            device_inputs = tuple(jax.device_put(a, dev) for a in padded_host)
+            device_inputs = self._place_inputs(padded_host)
         # child of the caller's serving.batch span (same thread): the trace
         # id stamped at submit reaches the compiled device step
         with telemetry.span("serving.device_step", endpoint=self.name,
@@ -384,8 +436,7 @@ class ModelEndpoint:
             for s, dt in zip(self.input_shapes, self.np_dtypes))
         import jax
         comp = self._get_executable(bucket)
-        dev = self.ctx.jax_device()
-        ins = tuple(jax.device_put(a, dev) for a in probe_in)
+        ins = self._place_inputs(probe_in)
         outs = comp(self._param_datas(), *ins)
         jax.block_until_ready(outs)
         state = capture_state(block=self.block, include_rng=False)
@@ -468,17 +519,21 @@ class ModelEndpoint:
         probe = state.get("serving")
         return host, probe, label
 
+    def _place_params(self, arrays):
+        """Host->device placement of a full weight set (hot-swap staging).
+        Sharded endpoints override with their per-param NamedShardings."""
+        import jax
+        dev = self.ctx.jax_device()
+        return tuple(jax.device_put(a, dev) for a in arrays)
+
     def stage_weights(self, host_params):
         """Transfer new weights into fresh device buffers (the off-parity
         set: in-flight steps keep reading the old arrays untouched). Host
         work only — safe off the worker thread."""
-        import jax
-        dev = self.ctx.jax_device()
-        return tuple(
-            jax.device_put(a.astype(p.data(self.ctx).data.dtype, copy=False)
-                           if onp.dtype(a.dtype) != p.data(self.ctx).data.dtype
-                           else a, dev)
-            for a, p in zip(host_params, self._params))
+        return self._place_params(tuple(
+            a.astype(p.data(self.ctx).data.dtype, copy=False)
+            if onp.dtype(a.dtype) != p.data(self.ctx).data.dtype else a
+            for a, p in zip(host_params, self._params)))
 
     def validate_and_commit(self, staged, probe=None) -> dict:
         """Dispatcher-thread half of a hot-swap: run the validation probe
@@ -500,8 +555,7 @@ class ModelEndpoint:
                      for s, dt in zip(self.input_shapes, self.np_dtypes)]
             expected = None
         comp = self._get_executable(bucket)
-        dev = self.ctx.jax_device()
-        ins = tuple(jax.device_put(a, dev) for a in ins_h)
+        ins = self._place_inputs(ins_h)
         try:
             outs = comp(staged, *ins)
             jax.block_until_ready(outs)

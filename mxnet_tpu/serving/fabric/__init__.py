@@ -1,0 +1,39 @@
+"""mxnet_tpu.serving.fabric — mesh-sharded replicas and a multi-host front
+door.
+
+PRs 12–15 made serving a *fleet* (replica pools, SLO autoscaling, one-pane
+observability) while every replica stayed one chip in one process. This
+package is the missing layer between "a replica" and "a chip":
+
+- **slices** (:mod:`.slices`): a slice planner that partitions the visible
+  device set into gang-scheduled slices (``parallel.mesh.carve_slices``) —
+  each slice backs one logical replica with ``capacity == len(devices)``,
+  so a 4-chip sharded replica and a single-chip one coexist in one
+  ``ServingPool`` with capacity-weighted placement.
+- **sharded** (:mod:`.sharded`): :class:`ShardedEndpoint` /
+  :class:`ShardedDecodeEndpoint` — drop-in endpoint twins whose bucket
+  executables compile through the same ``compile_ledger.lower_and_compile``
+  hook with NamedSharding in/out shardings over a slice's mesh. One logical
+  replica spans N chips; the executable cache, compile ledger, warmup and
+  StepCostEWMA contracts are unchanged. Outputs are BITWISE equal to the
+  single-chip reference endpoint: only the batch (row) axis is ever
+  sharded, and parameters shard along their leading axis where divisible —
+  placements and all-gathers move exact bytes, no cross-device reduction
+  ever reorders a floating-point sum.
+- **frontdoor** (:mod:`.frontdoor`): a multi-host serving front door —
+  per-host serving planes with subprocess-simulated process-group
+  membership (heartbeats + telemetry dumps per host agent, the CPU stand-in
+  for ``jax.distributed``), consistent-hash tenant->host routing with
+  bounded rebalancing (a dead host's tenants move, nobody else's), and
+  cross-host failover that resubmits a dead host's in-flight work on the
+  survivors behind the client future — zero client-visible errors. The
+  PR 15 fleet collector is the one pane of glass over every host's dump.
+"""
+from __future__ import annotations
+
+from .slices import SliceSpec, plan_slices
+from .sharded import ShardedDecodeEndpoint, ShardedEndpoint
+from .frontdoor import FrontDoor
+
+__all__ = ["SliceSpec", "plan_slices", "ShardedEndpoint",
+           "ShardedDecodeEndpoint", "FrontDoor"]

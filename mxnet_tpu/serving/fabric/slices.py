@@ -1,0 +1,103 @@
+"""Slice planner: partition the visible device set into gang-scheduled
+slices, each backing one logical serving replica.
+
+A :class:`SliceSpec` is the scheduling unit the fabric gangs devices by: a
+contiguous run of devices (``parallel.mesh.carve_slices`` keeps contiguous
+ids together — the tightest ICI neighborhoods on a real pod slice), a named
+mesh layout over them, and a ``capacity`` equal to its device count — the
+weight ``ServingPool.submit`` divides queue load by, so heterogeneous
+replicas (one 4-chip slice next to two singles) each attract their fair
+share of traffic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ... import telemetry as _telemetry
+from ...base import MXNetError
+from ...parallel import mesh as _mesh
+
+__all__ = ["SliceSpec", "plan_slices"]
+
+_SLICES_G = _telemetry.gauge(
+    "mxtpu_fabric_slices",
+    "Gang-scheduled device slices in the last plan, by slice size "
+    "(devices per slice).",
+    labelnames=("size",))
+
+
+class SliceSpec:
+    """One gang-scheduled device slice: the devices, the mesh axis layout
+    over them, and the replica capacity they add up to.
+
+    ``axes`` defaults to ``{"dp": n}`` — the batch-axis layout whose
+    sharded executables are bitwise-equal to a single chip's (row sharding
+    never reorders a reduction). Pass a different layout for tp/fsdp-style
+    experiments; the bitwise contract is only pinned for the default.
+    """
+
+    __slots__ = ("index", "devices", "axes", "_mesh")
+
+    def __init__(self, index: int, devices: Sequence,
+                 axes: Optional[Dict[str, int]] = None):
+        self.index = int(index)
+        self.devices = list(devices)
+        if not self.devices:
+            raise MXNetError("a slice needs at least one device")
+        n = len(self.devices)
+        if axes is None:
+            axes = {"dp": n}
+        sizes = 1
+        for s in axes.values():
+            sizes *= int(s)
+        if sizes != n:
+            raise MXNetError(f"slice axes {axes} need {sizes} devices, "
+                             f"slice has {n}")
+        self.axes = dict(axes)
+        self._mesh: Optional[_mesh.DeviceMesh] = None
+
+    @property
+    def capacity(self) -> int:
+        """Devices this slice gangs — the replica's load weight."""
+        return len(self.devices)
+
+    @property
+    def name(self) -> str:
+        """Topology-stable label: axis layout, not concrete device ids —
+        the same string on any restart that lands an equal-shaped slice."""
+        return "slice[" + ",".join(f"{a}={s}" for a, s in
+                                   sorted(self.axes.items())) + "]"
+
+    def make_mesh(self) -> _mesh.DeviceMesh:
+        """The slice's DeviceMesh (built once, cached)."""
+        if self._mesh is None:
+            self._mesh = _mesh.make_mesh(self.axes, devices=self.devices)
+        return self._mesh
+
+    def __repr__(self):
+        ids = [getattr(d, "id", d) for d in self.devices]
+        return f"SliceSpec(#{self.index} {self.name} devices={ids})"
+
+
+def plan_slices(sizes: Sequence[int], devices=None,
+                axes: Optional[Sequence[Dict[str, int]]] = None
+                ) -> List[SliceSpec]:
+    """Carve ``devices`` (default: all visible) into gang-scheduled slices.
+
+    ``sizes`` follows ``carve_slices``: asymmetric sizes are fine, leftover
+    devices stay uncarved for single-chip replicas, oversubscription raises.
+    ``axes`` optionally gives each slice its own mesh layout (one dict per
+    size; default ``{"dp": size}``). Publishes ``mxtpu_fabric_slices``.
+    """
+    if axes is not None and len(axes) != len(sizes):
+        raise MXNetError(f"axes ({len(axes)}) must match sizes "
+                         f"({len(sizes)}) one-to-one")
+    carved = _mesh.carve_slices(sizes, devices=devices)
+    specs = [SliceSpec(i, devs, axes[i] if axes is not None else None)
+             for i, devs in enumerate(carved)]
+    by_size: Dict[int, int] = {}
+    for sp in specs:
+        by_size[sp.capacity] = by_size.get(sp.capacity, 0) + 1
+    for size, count in by_size.items():
+        _SLICES_G.labels(str(size)).set(count)
+    return specs

@@ -143,6 +143,21 @@ class DecodeEndpoint:
     def _param_datas(self):
         return tuple(p.data(self.ctx).data for p in self._params)
 
+    def _adopt_compiled(self, comp):
+        """Hook: inspect a just-obtained executable before first use.
+        Sharded twins adopt a cache-deserialized executable's device
+        assignment here; the single-device path needs nothing."""
+
+    def _jit_prefill(self, fn, donate):
+        """Wrap the traced prefill; sharded twins add in/out shardings."""
+        import jax
+        return jax.jit(fn, donate_argnums=donate)
+
+    def _jit_decode(self, fn, donate):
+        """Wrap the traced decode step; sharded twins add shardings."""
+        import jax
+        return jax.jit(fn, donate_argnums=donate)
+
     # ------------------------------------------------------------------
     # traced programs
     # ------------------------------------------------------------------
@@ -171,7 +186,7 @@ class DecodeEndpoint:
                 return next_id.reshape(1), k_pool, v_pool
 
             donate = (4, 5) if self._donate_pools() else ()
-            self._pf_jfn = jax.jit(prefill, donate_argnums=donate)
+            self._pf_jfn = self._jit_prefill(prefill, donate)
         return self._pf_jfn
 
     def _decode_fn(self):
@@ -204,7 +219,7 @@ class DecodeEndpoint:
                 return next_ids, k_pool, v_pool
 
             donate = (5, 6) if self._donate_pools() else ()
-            self._dec_jfn = jax.jit(decode, donate_argnums=donate)
+            self._dec_jfn = self._jit_decode(decode, donate)
         return self._dec_jfn
 
     # ------------------------------------------------------------------
@@ -250,6 +265,7 @@ class DecodeEndpoint:
                          "bucket": bucket,
                          "dtype": str(self.pool_dtype),
                          "device": self._device_label()})
+            self._adopt_compiled(comp)
             cache[bucket] = comp
             mem = _ledger._memory_analysis(comp)
             _memstats.register(

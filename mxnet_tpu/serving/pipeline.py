@@ -5,10 +5,15 @@ path of every batch: the device idles while the host prepares, and the host
 idles while the device executes. This module is the overlap half of the
 rebuilt dispatch path (the host/device overlap discipline of TensorFlow's
 dataflow executor, PAPERS.md): a dedicated *prep stage* assembles the next
-batch — concatenating request rows, padding to the shape bucket, and
+batch — writing request rows straight into preallocated per-(bucket, parity)
+staging buffers (``MXNET_SERVING_ZEROCOPY``; concat+pad is the fallback) and
 ``device_put``-ing into the input-buffer set for the next *parity* — while
 the worker thread executes the current one. Host time disappears from the
 critical path once steady state is reached.
+
+Depth: ``MXNET_SERVING_PIPELINE_DEPTH`` (or ``InferenceServer(pipeline_depth=)``)
+lets prep run d batches ahead; parities cycle over d+1 slots so the slot
+being written is never one an in-flight or queued batch still references.
 
 Parity (the double buffer): prepared batches alternate between two
 input-buffer sets (parity 0 / parity 1, tracked per endpoint). Because the
@@ -34,8 +39,10 @@ import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
+from .. import config as _config
 from .. import telemetry as _telemetry
 from ..resilience import faults as _faults
+from . import bucketing
 from .batcher import Request, concat_inputs
 from .stats import set_prep_overlap_ratio
 
@@ -135,7 +142,26 @@ def prepare_batch(tenant, requests: List[Request], parity: int,
 
     def run_prep():
         _faults.check("serving_prep")
-        host_inputs = concat_inputs(requests, len(ep.input_shapes))
+        if _config.get("MXNET_SERVING_ZEROCOPY"):
+            # zero-copy assembly: write each request's rows straight into
+            # the endpoint's per-(bucket, parity) staging buffers. Already
+            # bucket-sized, so the pad step inside prepare() is a no-op
+            # view — the only copy left on the ingest path is the
+            # device_put itself. The parity discipline that protects the
+            # device buffer sets protects the staging set equally: a slot
+            # is rewritten only after its batch fully retires.
+            bucket = bucketing.bucket_for(rows, ep.buckets)
+            bufs = ep.staging_buffers(bucket, parity)
+            off = 0
+            for r in requests:
+                for i in range(len(bufs)):
+                    bufs[i][off:off + r.rows] = r.inputs[i]
+                off += r.rows
+            for b in bufs:
+                b[rows:bucket] = 0       # stale tail rows would leak into
+            host_inputs = bufs           # the padded region
+        else:
+            host_inputs = concat_inputs(requests, len(ep.input_shapes))
         return ep.prepare(host_inputs, rows, parity=parity)
 
     t0 = _now_us()

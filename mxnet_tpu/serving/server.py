@@ -142,10 +142,14 @@ class InferenceServer:
         overlaps batch k+1's concat/pad/device_put with device step k.
         False: serial prepare-then-step in the worker thread (bitwise
         reference path; same scheduler, same executables).
+    pipeline_depth : int, optional
+        Prepared batches allowed to wait for the worker. Depth d cycles
+        d+1 staging/input parities, so the slot prep writes is never one a
+        queued or in-flight batch still references; 1 (the default, via
+        ``MXNET_SERVING_PIPELINE_DEPTH``) is classic double-buffering.
     """
 
-    #: prepared batches allowed to wait for the worker (1 + the in-flight
-    #: batch = the two parities of the double buffer)
+    #: class default; instances resolve pipeline_depth/config in __init__
     _PIPELINE_DEPTH = 1
 
     def __init__(self, batch_timeout_ms: float = 2.0, max_queue: int = 256,
@@ -153,10 +157,16 @@ class InferenceServer:
                  breaker: Optional[CircuitBreaker] = None,
                  watchdog_stall_s: Optional[float] = None,
                  drain_timeout_s: Optional[float] = None,
-                 pipeline: bool = True):
+                 pipeline: bool = True,
+                 pipeline_depth: Optional[int] = None):
         self._batch_timeout_us = int(batch_timeout_ms * 1000)
         self._max_queue_rows = int(max_queue)
         self._pipeline = bool(pipeline)
+        depth = int(pipeline_depth if pipeline_depth is not None
+                    else _config.get("MXNET_SERVING_PIPELINE_DEPTH"))
+        if depth < 1:
+            raise MXNetError(f"pipeline_depth must be >= 1, got {depth}")
+        self._PIPELINE_DEPTH = depth
         self._router = Router(self._batch_timeout_us)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -823,7 +833,9 @@ class InferenceServer:
                     self._preparing = None
             if pb is None:
                 continue                  # prep failed; futures already failed
-            parity ^= 1                   # flip the double-buffer parity
+            # cycle over depth+1 parities: with d batches queued ahead plus
+            # one in flight, the slot being rewritten is always retired
+            parity = (parity + 1) % (self._PIPELINE_DEPTH + 1)
             with self._cond:
                 if self._epoch != epoch:
                     # superseded mid-prepare: hand the rows back to their

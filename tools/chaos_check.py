@@ -57,6 +57,17 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      the drill's trace id names >=2 processes/replicas (cache_poison's
      warmer is a real subprocess; autoscale routes across pool replicas).
 
+  7. FABRIC SCENARIO (``--scenario host_down``) — the r18 drill: a
+     two-host serving-fabric FrontDoor under continuous client load loses
+     one whole host (agent SIGKILLed, serving plane failed without drain).
+     The consistent-hash ring must move exactly the dead host's tenants,
+     the wrapper futures must replay the dead host's in-flight work on the
+     survivor — zero client-visible errors, outputs bitwise-equal to the
+     direct forward — and the post-mortem pane must hold: a ``host_down``
+     flight bundle, a fleet report whose journey names both host agents,
+     the collector still listing the dead host's last dump, and per-host
+     goodput ledgers reconciling within 1%.
+
 Every run prints its seed; a failing seed is a deterministic repro::
 
     python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
@@ -1040,11 +1051,164 @@ def check_dlrm(seed, steps=8, p=0.0):
             "ok": bool(ok)}
 
 
+def check_host_down(seed, requests=24, p=0.0, in_dim=8, out_dim=4):
+    """SCENARIO host_down (r18): the serving-fabric FrontDoor loses a whole
+    host mid-load. Clients keep submitting through the consistent-hash ring
+    while the victim (the host owning the most tenants) is taken out: its
+    agent subprocess SIGKILLed, its serving plane failed with drain=False so
+    queued work raises ServerClosedError — which the front door's wrapper
+    futures must replay on survivors. Acceptance: zero client-visible
+    errors, every output bitwise-equal to the direct forward, rebalancing
+    bounded to exactly the victim's tenants, and the post-mortem pane
+    intact — the fleet collector still names BOTH hosts (the dead agent
+    left a recent dump behind) and every host's goodput ledger reconciles
+    buckets-to-wall within 1%."""
+    import threading
+    import time
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving.fabric import FrontDoor
+
+    tenants = [f"chaos_fab_{seed}_{i}" for i in range(4)]
+
+    def mlp():
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    ref = mlp()
+    weights = [prm.data().asnumpy() for prm in ref.collect_params().values()]
+
+    def factory(name):
+        net = mlp()
+        for prm, w in zip(net.collect_params().values(), weights):
+            prm.set_data(nd.array(w))      # hosts serve identical weights
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, requests * 8))
+        for i, t in enumerate(tenants):
+            srv.register(serving.ModelEndpoint(
+                t, net, input_shapes=(in_dim,), max_batch_size=4),
+                warmup=(i == 0))
+        srv.start()
+        return srv
+
+    # host-agent dumps land in the fleet dir (dump-host-*.json), so
+    # tools/fleet_report.py and the collector read the pane the drill
+    # leaves behind
+    workdir = os.environ.get("CHAOS_FLEET_DIR") or tempfile.mkdtemp(
+        prefix="chaos-fabric-")
+    resub_before = _metric_total("mxtpu_fabric_resubmits_total")
+    fd = FrontDoor(["alpha", "beta"], factory, workdir=workdir)
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    stop_flag = threading.Event()
+    client_errors = []
+    outs = []
+    lock = threading.Lock()
+
+    def load(ci):
+        i = 0
+        while not stop_flag.is_set():
+            t = tenants[(ci + i) % len(tenants)]
+            k = (ci + i) % requests
+            try:
+                o = fd.submit(t, xs[k]).result(timeout=120)
+                with lock:
+                    outs.append((k, o.asnumpy()))
+            except Exception as e:
+                client_errors.append(repr(e))
+            i += 1
+
+    threads = [threading.Thread(target=load, args=(c,)) for c in range(3)]
+    agents_seen = False
+    burst_errors = 0
+    try:
+        owner_before = {t: fd.route(t) for t in tenants}
+        by_host = {n: [t for t in tenants if owner_before[t] == n]
+                   for n in fd.hosts()}
+        victim = max(by_host, key=lambda n: len(by_host[n]))
+        survivor = next(n for n in fd.hosts() if n != victim)
+        for t in threads:
+            t.start()
+        # the dead host must leave a dump for the post-mortem pane: wait
+        # for both agents to boot and write one (spans flush just before)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(
+                    workdir, f"dump-host-{n}.json")) for n in fd.hosts()):
+                agents_seen = True
+                break
+            time.sleep(0.1)
+        # burst the victim's tenants so its queue is non-empty at the
+        # kill, then take the host out mid-load
+        burst = [fd.submit(by_host[victim][i % len(by_host[victim])],
+                           xs[i % requests]) for i in range(requests * 2)]
+        rep = fd.kill_host(victim)
+        for i, f in enumerate(burst):
+            try:
+                o = f.result(timeout=120)
+                with lock:
+                    outs.append((i % requests, o.asnumpy()))
+            except Exception:
+                burst_errors += 1
+        time.sleep(0.5)               # post-kill load rides the survivor
+        owner_after = {t: fd.route(t) for t in tenants}
+        rep2 = fd.kill_host(victim)   # idempotent: no double failover
+        # let the survivor's agent write one more dump cycle
+        time.sleep(max(0.3, 2 * float(
+            config.get("MXNET_FABRIC_HEARTBEAT_S"))))
+        pane = fd.fleet_collect()
+        ledgers = fd.goodput_reconcile(tol=0.01)
+    finally:
+        stop_flag.set()
+        for t in threads:
+            t.join()
+        fd.stop(drain=True)
+        for t in tenants:
+            serving.unregister(t)
+    resubmits = _metric_total("mxtpu_fabric_resubmits_total") - resub_before
+    direct = ref(nd.array(xs)).asnumpy()
+    bitwise = bool(outs) and all(
+        onp.array_equal(o, direct[k]) for k, o in outs)
+    # bounded rebalance: exactly the victim's tenants moved, to survivors
+    bounded = all(
+        (owner_after[t] == owner_before[t]) if owner_before[t] != victim
+        else owner_after[t] != victim for t in tenants)
+    moved_ok = rep["moved"] == len(by_host[victim])
+    idempotent = bool(rep2.get("already_down")) and rep2["moved"] == 0
+    pane_hosts = [s for s in pane["sources"] if s.startswith("host-")]
+    pane_ok = {f"host-{n}" for n in fd.hosts()} <= set(pane["sources"])
+    ledgers_ok = (set(ledgers) == set(fd.hosts())
+                  and all(v["ok"] for v in ledgers.values()))
+    ok = (agents_seen and not client_errors and burst_errors == 0 and
+          bitwise and bounded and moved_ok and idempotent and
+          resubmits >= 1 and rep["survivors"] == [survivor] and
+          pane_ok and ledgers_ok)
+    return {"phase": "host_down", "seed": seed, "hosts": fd.hosts(),
+            "victim": victim, "survivor": survivor,
+            "tenants_on_victim": len(by_host[victim]),
+            "tenants_moved": rep["moved"], "rebalance_bounded": bounded,
+            "resubmits": resubmits, "requests_served": len(outs),
+            "client_errors": client_errors[:5] + (
+                [f"burst_errors={burst_errors}"] if burst_errors else []),
+            "outputs_bitwise_equal": bitwise,
+            "kill_idempotent": idempotent, "agents_seen": agents_seen,
+            "fleet_pane_sources": pane_hosts,
+            "goodput_ledgers": ledgers, "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
              "bad_batch": check_bad_batch, "sdc": check_sdc,
              "decode": check_decode, "cache_poison": check_cache_poison,
-             "autoscale": check_autoscale, "dlrm": check_dlrm}
+             "autoscale": check_autoscale, "dlrm": check_dlrm,
+             "host_down": check_host_down}
 
 # the flight-recorder trigger each injected fault must leave behind (a clean
 # hot_swap is a structured event, not a dump trigger, so it has no entry)
@@ -1056,6 +1220,7 @@ EXPECTED_FLIGHT_TRIGGER = {
     "sdc": "sdc_suspect",
     "decode": "decode_failover",
     "dlrm": "oom",   # retry's OOM classifier fires on the RESOURCE_EXHAUSTED
+    "host_down": "host_down",
 }
 
 
@@ -1190,6 +1355,10 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
             elif name == "autoscale":
                 res = check_fleet_report(name, lambda: check_autoscale(
                     seed, requests=max(8, requests // 2)))
+            elif name == "host_down":
+                res = check_fleet_report(name, lambda: check_flight_bundle(
+                    name, lambda: check_host_down(
+                        seed, requests=max(8, requests // 2))))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

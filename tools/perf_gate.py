@@ -29,9 +29,15 @@ Measurement sources (selectable with ``--only``):
             compile-ledger rollup
   eager     in-process p95 eager-dispatch probe (the
             test_eager_latency.py gate, expressed as a budget)
-  restart   serving_loadgen.py --restart in a subprocess: warm
+  restart   serving_loadgen.py --restart --fabric in a subprocess: warm
             restart-to-first-request seconds (the executable-cache
-            elasticity contract — a warm process must compile nothing)
+            elasticity contract — a warm process must compile nothing,
+            including the mesh-sharded fabric endpoint's bucket
+            executables)
+  fabric    benchmark/fabric_scaling.py in a subprocess: the sharded-
+            serving scaling sweep's top-slice served throughput
+            (``fabric_sharded_img_s``), valid only when every slice size
+            served bitwise-equal to the single-chip reference
 
 Exit status mirrors tools/mxlint.py --check: 0 clean, 1 findings,
 2 operational error.
@@ -48,7 +54,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_BUDGETS = os.path.join(REPO, "PERF_BUDGETS.json")
-_SOURCES = ("bench", "loadgen", "eager", "restart")
+_SOURCES = ("bench", "loadgen", "eager", "restart", "fabric")
 
 
 # ---------------------------------------------------------------------------
@@ -208,7 +214,7 @@ def measure_restart(env):
     compiles and bitwise-equal first-request outputs, so a row at all
     means the correctness half of the contract held)."""
     cmd = [sys.executable, os.path.join("benchmark", "serving_loadgen.py"),
-           "--restart"]
+           "--restart", "--fabric"]
     rc, out, err = _run(cmd, env)
     measured = {}
     for row in _json_lines(out):
@@ -218,6 +224,24 @@ def measure_restart(env):
                 and "restart_child" not in row:
             measured["restart_to_first_request_s"] = \
                 float(row["restart_to_first_request_s"])
+    return measured, {"cmd": " ".join(cmd), "rc": rc, "stdout": out,
+                      "stderr": err[-2000:]}
+
+
+def measure_fabric(env):
+    """benchmark/fabric_scaling.py summary row -> fabric_sharded_img_s
+    (the largest slice's served throughput). The metric is only reported
+    when the sweep's own acceptance held — every slice size bitwise-equal
+    to the single-chip reference with zero client errors — so a numerics
+    or reliability break gates as 'not measured'."""
+    cmd = [sys.executable, os.path.join("benchmark", "fabric_scaling.py")]
+    rc, out, err = _run(cmd, env)
+    measured = {}
+    for row in _json_lines(out):
+        if row.get("summary") and row.get("ok") \
+                and row.get("fabric_sharded_img_s") is not None:
+            measured["fabric_sharded_img_s"] = \
+                float(row["fabric_sharded_img_s"])
     return measured, {"cmd": " ".join(cmd), "rc": rc, "stdout": out,
                       "stderr": err[-2000:]}
 
@@ -356,6 +380,9 @@ def main(argv=None):
         measured.update(measure_eager())
     if "restart" in sources and "restart" in wanted:
         vals, _ = measure_restart(env)
+        measured.update(vals)
+    if "fabric" in sources and "fabric" in wanted:
+        vals, _ = measure_fabric(env)
         measured.update(vals)
 
     # metrics whose source was excluded by --only are reported, not gated
