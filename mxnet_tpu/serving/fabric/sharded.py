@@ -165,6 +165,10 @@ class ShardedEndpoint(ModelEndpoint):
         return f"{platform}:{_mesh_label(self._dmesh)}"
 
     def _compile_key(self, bucket: int) -> Dict[str, object]:
+        # the mesh label rides into the compile ledger AND the cost-model
+        # prior: a cold bucket on a 4-chip slice is priced by predictions
+        # trained on that topology, so fabric admission (step_cost.estimate
+        # behind ServingPool's capacity-weighted routing) is per-slice
         key = super()._compile_key(bucket)
         key["mesh"] = _mesh_label(self._dmesh)
         return key
@@ -274,6 +278,13 @@ class ShardedDecodeEndpoint(DecodeEndpoint):
         except Exception:
             platform = "?"
         return f"{platform}:{_mesh_label(self._dmesh)}"
+
+    def _cost_key(self, kind: str, bucket: int) -> Dict[str, object]:
+        # mirror the dense twin: slice topology reaches the ledger and the
+        # cost-model prior, so decode admission prices per-slice
+        key = super()._cost_key(kind, bucket)
+        key["mesh"] = _mesh_label(self._dmesh)
+        return key
 
     def _adopt_compiled(self, comp):
         m = _compiled_mesh(comp)

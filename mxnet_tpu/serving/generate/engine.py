@@ -87,8 +87,18 @@ class DecodeEndpoint:
                 f"position-embedding table ({max_len})")
 
         self.stats = DecodeStats(name)
-        self.step_cost = StepCostEWMA()      # per decode batch bucket, us
-        self.prefill_cost = StepCostEWMA()   # per prefill seq bucket, us
+        # per-bucket cost models (us): measured EWMA with the learned cost
+        # model as the cold-bucket prior. The key closures read self lazily
+        # — the KV pool (whose dtype the key carries) is built below.
+        from ...telemetry import costmodel as _costmodel
+        self.step_cost = StepCostEWMA(      # per decode batch bucket
+            name=f"{name}.decode",
+            prior=_costmodel.make_prior(
+                "decode_step", lambda b: self._cost_key("step", b)))
+        self.prefill_cost = StepCostEWMA(   # per prefill seq bucket
+            name=f"{name}.prefill",
+            prior=_costmodel.make_prior(
+                "decode_prefill", lambda b: self._cost_key("prefill", b)))
         self._lock = threading.Lock()
         self._prefill_execs: Dict[int, object] = {}
         self._decode_execs: Dict[int, object] = {}
@@ -238,6 +248,26 @@ class DecodeEndpoint:
     def pool_dtype(self):
         return self.pool.k_pool.dtype
 
+    def _cost_key(self, kind: str, bucket: int) -> Dict[str, object]:
+        """The compile-ledger / cost-model trigger key for one (kind,
+        bucket) executable — also what the cold-bucket prior featurizes."""
+        return {"endpoint": self.name, "kind": kind, "bucket": bucket,
+                "dtype": str(self.pool_dtype),
+                "device": self._device_label()}
+
+    def _observe_cost(self, ewma, kind: str, site: str, bucket: int,
+                      us: float, rows: Optional[int] = None):
+        """Feed one measured wall: the scheduling EWMA always, plus the
+        cost observatory (step ledger record + residual vs the prior)."""
+        ewma.observe(bucket, us)
+        try:
+            from ...telemetry import costmodel as _costmodel
+            _costmodel.on_step_observed(site, self._cost_key(kind, bucket),
+                                        bucket, us, rows=rows,
+                                        prior_us=ewma.prior(bucket))
+        except Exception:
+            pass
+
     def _compile(self, cache, bucket, jfn, arg_sds, kind):
         comp = cache.get(bucket)
         if comp is not None:
@@ -261,10 +291,7 @@ class DecodeEndpoint:
                 comp = _ledger.lower_and_compile(
                     jfn, (param_sds,) + arg_sds,
                     site=f"decode_{kind}",
-                    key={"endpoint": self.name, "kind": kind,
-                         "bucket": bucket,
-                         "dtype": str(self.pool_dtype),
-                         "device": self._device_label()})
+                    key=self._cost_key(kind, bucket))
             self._adopt_compiled(comp)
             cache[bucket] = comp
             mem = _ledger._memory_analysis(comp)
@@ -321,7 +348,8 @@ class DecodeEndpoint:
                                self.pool.k_pool, self.pool.v_pool)
                     jax.block_until_ready(out)
                     self.pool.update_arrays(out[1], out[2])
-                    self.prefill_cost.observe(b, _now_us() - t0)
+                    self._observe_cost(self.prefill_cost, "prefill",
+                                       "decode_prefill", b, _now_us() - t0)
         for b in self.decode_buckets:
             fresh = b not in self._decode_execs
             comp = self._get_decode(b)
@@ -337,7 +365,8 @@ class DecodeEndpoint:
                                self.pool.k_pool, self.pool.v_pool)
                     jax.block_until_ready(out)
                     self.pool.update_arrays(out[1], out[2])
-                    self.step_cost.observe(b, _now_us() - t0)
+                    self._observe_cost(self.step_cost, "step",
+                                       "decode_step", b, _now_us() - t0)
         return n
 
     # ------------------------------------------------------------------
@@ -361,7 +390,8 @@ class DecodeEndpoint:
         out = int(onp.asarray(next_id)[0])     # sync point
         self.pool.update_arrays(k, v)
         dt = _now_us() - t0
-        self.prefill_cost.observe(S, dt)
+        self._observe_cost(self.prefill_cost, "prefill", "decode_prefill",
+                           S, dt, rows=n)
         self.stats.record_prefill(dt)
         return out
 
@@ -390,7 +420,8 @@ class DecodeEndpoint:
         out = onp.asarray(next_ids)            # sync point
         self.pool.update_arrays(k, v)
         dt = _now_us() - t0
-        self.step_cost.observe(B, dt)
+        self._observe_cost(self.step_cost, "step", "decode_step",
+                           B, dt, rows=n)
         self.stats.record_step(dt, n, B)
         return tuple(int(x) for x in out[:n])
 

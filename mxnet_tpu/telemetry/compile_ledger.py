@@ -42,9 +42,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .metrics import REGISTRY
 
-__all__ = ["CompileRecord", "fingerprint_text", "lower_and_compile",
-           "record", "recent", "summary", "instrument_eager_jit",
-           "eager_active", "ledger_dir", "read_ledger", "reset"]
+__all__ = ["CompileRecord", "fingerprint_text", "op_histogram",
+           "lower_and_compile", "record", "recent", "summary",
+           "instrument_eager_jit", "eager_active", "ledger_dir",
+           "read_ledger", "reset"]
 
 _RECORDS = REGISTRY.counter(
     "mxtpu_compile_records_total",
@@ -75,6 +76,7 @@ _SEEN: Dict[str, float] = {}        # fingerprint -> first-seen compile secs
 _SCANNED: Dict[str, int] = {}       # ledger file path -> bytes consumed
 _SCANNED_DIR: Optional[str] = None  # ledger dir the offsets belong to
 _LOC_RE = re.compile(r"\s*loc\([^)]*\)")
+_OP_RE = re.compile(r"\b(?:stablehlo|mhlo|chlo)\.([a-z0-9_]+)\b")
 _LAST_ERRORS: Dict[str, str] = {}   # where -> last swallowed error
 
 
@@ -125,6 +127,23 @@ def fingerprint_text(text: str) -> str:
     lines = [ln for ln in text.splitlines() if not ln.lstrip().startswith("#loc")]
     canon = "\n".join(_LOC_RE.sub("", ln) for ln in lines)
     return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+
+
+def op_histogram(text: str, cap: int = 64) -> Dict[str, int]:
+    """Opcode histogram of a StableHLO module text: ``{op_name: count}``
+    over the ``stablehlo.*`` / ``mhlo.*`` mnemonics. This is the paper's
+    program featurization (op counts over the canonicalized program), and
+    it is captured at compile time because the ledger stores only the
+    sha256 *fingerprint* of the text — the histogram cannot be recovered
+    later. Capped to the ``cap`` most frequent ops to bound record size."""
+    hist: Dict[str, int] = {}
+    for m in _OP_RE.finditer(text):
+        op = m.group(1)
+        hist[op] = hist.get(op, 0) + 1
+    if len(hist) > cap:
+        keep = sorted(hist.items(), key=lambda kv: (-kv[1], kv[0]))[:cap]
+        hist = dict(keep)
+    return hist
 
 
 def _cost_analysis(compiled) -> Dict[str, float]:
@@ -225,13 +244,16 @@ def _append_jsonl(d: str, rec: Dict):
 
 def record(site: str, fingerprint: Optional[str], lower_s: float,
            compile_s: float, key: Optional[Dict[str, Any]] = None,
-           compiled=None, cache_hit: bool = False) -> CompileRecord:
+           compiled=None, cache_hit: bool = False,
+           ops: Optional[Dict[str, int]] = None) -> CompileRecord:
     """Emit one CompileRecord (ring + metrics + JSONL). Never raises.
 
     ``cache_hit=True`` marks an executable answered by the persistent cache
     (``compile_s`` is then the deserialize time): such records are never
     duplicates and never charge ``mxtpu_compile_duplicate_waste_seconds_total``
-    — nothing was re-spent, the fleet's copy was reused."""
+    — nothing was re-spent, the fleet's copy was reused. ``ops`` is the
+    optional :func:`op_histogram` of the lowered module — the cost model's
+    program features."""
     rec = CompileRecord(
         ts=time.time(), pid=os.getpid(), site=str(site),
         fingerprint=fingerprint,
@@ -239,6 +261,8 @@ def record(site: str, fingerprint: Optional[str], lower_s: float,
         key={str(k): v for k, v in (key or {}).items()},
         duplicate=False, cache_hit=bool(cache_hit),
     )
+    if ops:
+        rec["ops"] = {str(k): int(v) for k, v in ops.items()}
     if compiled is not None:
         rec.update(_cost_analysis(compiled))
         rec.update(_memory_analysis(compiled))
@@ -282,8 +306,11 @@ def lower_and_compile(jfn, args, *, site: str,
     lowered = jfn.lower(*args, **(kwargs or {}))
     t1 = time.perf_counter()
     fp = None
+    ops = None
     try:
-        fp = fingerprint_text(lowered.as_text())
+        text = lowered.as_text()
+        fp = fingerprint_text(text)
+        ops = op_histogram(text)
     except Exception as e:
         _note("fingerprint", e)
     compiled = None
@@ -310,7 +337,7 @@ def lower_and_compile(jfn, args, *, site: str,
             _note("exec_cache_store", e)
     try:
         record(site, fp, lower_s=t1 - t0, compile_s=t3 - t2, key=key,
-               compiled=compiled, cache_hit=cache_hit)
+               compiled=compiled, cache_hit=cache_hit, ops=ops)
     except Exception as e:
         _note("record", e)
     return compiled

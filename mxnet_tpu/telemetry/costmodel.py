@@ -1,0 +1,792 @@
+"""Learned cost model over the compile ledger — the observatory half of
+ROADMAP item 3.
+
+PR 10's compile ledger records, fleet-wide, exactly the corpus "A Learned
+Performance Model for Tensor Processing Units" was built from: op
+histograms of the canonicalized StableHLO, ``cost_analysis()`` flops and
+bytes, the trigger key (endpoint, bucket, dtype, device, mesh slice), and
+measured compile wall. This module closes the loop:
+
+* ``kind="step"`` records — measured step wall per (site, key, bucket) —
+  are appended into the *same* ``ledger-<pid>.jsonl`` files (rate-limited
+  to power-of-two observation counts so steady state costs one line per
+  doubling). They carry no ``fingerprint`` so the duplicate-compile
+  accounting never sees them.
+* :func:`train` fits a small ridge regressor (log-space normal
+  equations — numpy only, no new deps) from any ledger directory to two
+  targets, ``step_us`` and ``compile_s``, with an honest holdout split,
+  and persists a versioned, sha256-sealed JSON artifact via atomic
+  write (:meth:`CostModel.save` / :func:`load`).
+* :func:`predict_step_us` / :func:`predict_compile_s` serve the active
+  model (``MXNET_COSTMODEL_PATH``) as the **prior** for cold
+  ``StepCostEWMA`` buckets (serving router EDF pricing, decode admission,
+  fabric per-slice admission) and for the autoscaler's predicted warm-up
+  lead time. Measured values always win once observed — the EWMA blends
+  the prior out over ``MXNET_COSTMODEL_BLEND_N`` observations, never the
+  other way around.
+* Every prediction is accountable: ``mxtpu_cost_predicted_us`` /
+  ``mxtpu_cost_residual_ratio`` per (site, bucket), and a latched
+  residual drift detector (the perf_sentinel pattern) fires a single
+  ``cost_model_drift`` flight event per episode of sustained
+  out-of-band |residual| — the stale-model alarm.
+
+Everything here is telemetry: no function in this module may raise into
+a serving or training step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from .metrics import REGISTRY
+
+__all__ = [
+    "CostModelError", "CostModel", "featurize", "build_corpus",
+    "row_ratio_estimator", "train", "train_from_dir", "load",
+    "set_active", "active_model", "prior_enabled", "make_prior",
+    "predict_step_us", "predict_compile_s", "on_step_observed",
+    "read_steps", "export_rows", "snapshot", "reset",
+]
+
+SCHEMA = 1
+TARGETS = ("step_us", "compile_s")
+
+_PREDICTED_G = REGISTRY.gauge(
+    "mxtpu_cost_predicted_us",
+    "Cost-model predicted step wall per (site, bucket) — the prior a cold "
+    "StepCostEWMA prices with before any measurement exists.",
+    labelnames=("site", "bucket"))
+_RESIDUAL_G = REGISTRY.gauge(
+    "mxtpu_cost_residual_ratio",
+    "measured / predicted step wall per (site, bucket); 1.0 is a perfect "
+    "prediction, sustained excursions out of the drift band fire "
+    "cost_model_drift.",
+    labelnames=("site", "bucket"))
+_PRIOR_USED = REGISTRY.counter(
+    "mxtpu_cost_prior_used_total",
+    "Cold (never-measured) buckets priced by the learned prior instead of "
+    "the row-ratio fallback, per site.",
+    labelnames=("site",))
+_DRIFT_C = REGISTRY.counter(
+    "mxtpu_cost_model_drift_total",
+    "Latched cost_model_drift episodes (sustained out-of-band residual "
+    "ratio) per site — a firing means the committed model is stale for "
+    "this workload.",
+    labelnames=("site",))
+
+
+def _cfg(name, default):
+    try:
+        from .. import config
+        return config.get(name, default)
+    except Exception:
+        return default
+
+
+class CostModelError(Exception):
+    """Raised on an unusable corpus or a corrupt/stale model artifact."""
+
+
+# ---------------------------------------------------------------------------
+# featurization
+# ---------------------------------------------------------------------------
+
+_COST_FIELDS = (
+    ("flops", "log_flops"),
+    ("bytes_accessed", "log_bytes_accessed"),
+    ("argument_bytes", "log_argument_bytes"),
+    ("output_bytes", "log_output_bytes"),
+    ("temp_bytes", "log_temp_bytes"),
+    ("code_bytes", "log_code_bytes"),
+)
+
+
+def _mesh_size(label: Any) -> float:
+    """Total devices in a mesh label like ``dp=4`` or ``dp=2,tp=2``."""
+    total = 1.0
+    try:
+        for part in str(label).split(","):
+            if "=" in part:
+                total *= max(1.0, float(part.split("=", 1)[1]))
+    except (TypeError, ValueError):
+        return 1.0
+    return total
+
+
+def featurize(key: Optional[Dict[str, Any]], site: str = "",
+              rows: Optional[float] = None,
+              comp: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+    """One sparse feature dict (name -> float) shared by training and
+    prediction. ``key`` is a ledger trigger key (endpoint/bucket/dtype/
+    device/mesh/kind), ``comp`` an optional joined CompileRecord providing
+    the program features (op histogram, cost_analysis flops/bytes)."""
+    key = key or {}
+    f: Dict[str, float] = {"bias": 1.0}
+    bucket = key.get("bucket")
+    try:
+        if bucket is not None and float(bucket) > 0:
+            f["log_bucket"] = math.log1p(float(bucket))
+    except (TypeError, ValueError):
+        bucket = None
+    if rows is None:
+        rows = bucket
+    try:
+        if rows is not None and float(rows) > 0:
+            f["log_rows"] = math.log1p(float(rows))
+    except (TypeError, ValueError):
+        pass
+    pages = key.get("pages")
+    try:
+        if pages is not None and float(pages) > 0:
+            f["log_pages"] = math.log1p(float(pages))
+    except (TypeError, ValueError):
+        pass
+    if key.get("dtype"):
+        f["dtype:%s" % key["dtype"]] = 1.0
+    device = str(key.get("device") or "")
+    if device:
+        f["device:%s" % device.split(":", 1)[0]] = 1.0
+    mesh = key.get("mesh")
+    if mesh:
+        f["mesh:%s" % mesh] = 1.0
+        f["log_mesh_size"] = math.log1p(_mesh_size(mesh))
+    if key.get("kind"):
+        f["kind:%s" % key["kind"]] = 1.0
+    if key.get("endpoint"):
+        f["endpoint:%s" % key["endpoint"]] = 1.0
+    if key.get("op"):
+        f["op_name:%s" % key["op"]] = 1.0
+    if site:
+        f["site:%s" % site] = 1.0
+    if comp:
+        for src, name in _COST_FIELDS:
+            v = comp.get(src)
+            try:
+                if v and float(v) > 0:
+                    f[name] = math.log1p(float(v))
+            except (TypeError, ValueError):
+                pass
+        fl, ba = comp.get("flops"), comp.get("bytes_accessed")
+        try:
+            if fl and ba and float(ba) > 0:
+                f["flops_per_byte"] = min(float(fl) / float(ba), 1e4)
+        except (TypeError, ValueError):
+            pass
+        for op, n in sorted((comp.get("ops") or {}).items()):
+            try:
+                f["op:%s" % op] = math.log1p(float(n))
+            except (TypeError, ValueError):
+                pass
+    return f
+
+
+def _key_id(key: Dict[str, Any]) -> str:
+    return json.dumps(key or {}, sort_keys=True, default=str)
+
+
+def _compile_index(records: Sequence[Dict]) -> Dict[Any, Dict]:
+    """Index compile records for the step-record join: exact trigger-key
+    match first, (endpoint, bucket, kind) fallback. Later records win —
+    they carry the freshest cost_analysis."""
+    idx: Dict[Any, Dict] = {}
+    for r in records:
+        if r.get("kind") == "step" or not isinstance(r.get("key"), dict):
+            continue
+        k = r["key"]
+        idx[_key_id(k)] = r
+        if k.get("endpoint") is not None and k.get("bucket") is not None:
+            idx[(k.get("endpoint"), k.get("bucket"), k.get("kind"))] = r
+    return idx
+
+
+def _join(key: Dict[str, Any], idx: Dict[Any, Dict]) -> Optional[Dict]:
+    got = idx.get(_key_id(key))
+    if got is None and key.get("endpoint") is not None:
+        got = idx.get((key.get("endpoint"), key.get("bucket"),
+                       key.get("kind")))
+    return got
+
+
+def build_corpus(records: Sequence[Dict]) -> List[Dict]:
+    """Featurized training samples from raw ledger records.
+
+    Each sample: ``{"target", "y", "x", "site", "endpoint", "bucket"}``.
+    Step records train the ``step_us`` target (joined to their compile
+    record for program features); non-cache-hit compile records train
+    ``compile_s`` (target = lower_s + compile_s; cache hits are excluded —
+    their wall is deserialize time, a different quantity)."""
+    idx = _compile_index(records)
+    out: List[Dict] = []
+    for r in records:
+        try:
+            key = r.get("key") if isinstance(r.get("key"), dict) else {}
+            if r.get("kind") == "step":
+                y = float(r.get("step_us", 0.0) or 0.0)
+                if y <= 0:
+                    continue
+                comp = _join(key, idx)
+                x = featurize(key, str(r.get("site", "")),
+                              rows=r.get("rows"), comp=comp)
+                target = "step_us"
+            else:
+                if r.get("cache_hit"):
+                    continue
+                y = float(r.get("lower_s", 0.0) or 0.0) + \
+                    float(r.get("compile_s", 0.0) or 0.0)
+                if y <= 0:
+                    continue
+                x = featurize(key, str(r.get("site", "")), comp=r)
+                target = "compile_s"
+            out.append({
+                "target": target, "y": y, "x": x,
+                "site": str(r.get("site", "")),
+                "endpoint": key.get("endpoint"),
+                "bucket": key.get("bucket"),
+            })
+        except (TypeError, ValueError, KeyError):
+            continue
+    return out
+
+
+def row_ratio_estimator(samples: Sequence[Dict]) -> Callable[[Dict], float]:
+    """The pre-model fallback as an offline estimator: mean measured cost
+    per (endpoint, site) at each bucket, nearest-bucket linear row-ratio
+    for unseen buckets — exactly ``StepCostEWMA.estimate``'s shape. The
+    baseline the learned model must beat on never-observed buckets."""
+    table: Dict[Tuple, Dict[float, List[float]]] = {}
+    for s in samples:
+        b = s.get("bucket")
+        if b is None:
+            continue
+        g = table.setdefault((s.get("endpoint"), s.get("site")), {})
+        g.setdefault(float(b), []).append(float(s["y"]))
+    means = {gk: {b: sum(v) / len(v) for b, v in g.items()}
+             for gk, g in table.items()}
+
+    def estimate(sample: Dict) -> float:
+        b = sample.get("bucket")
+        g = means.get((sample.get("endpoint"), sample.get("site")))
+        if not g or b is None:
+            all_y = [y for gg in means.values() for y in gg.values()]
+            return sum(all_y) / len(all_y) if all_y else 0.0
+        b = float(b)
+        if b in g:
+            return g[b]
+        nearest = min(g, key=lambda x: abs(x - b))
+        return g[nearest] * (b / nearest)
+
+    return estimate
+
+
+# ---------------------------------------------------------------------------
+# model: ridge in log space, JSON artifact
+# ---------------------------------------------------------------------------
+
+def _fit_ridge(samples: Sequence[Dict], lam: float) -> Dict[str, float]:
+    names = sorted({n for s in samples for n in s["x"]})
+    X = onp.zeros((len(samples), len(names)))
+    cols = {n: j for j, n in enumerate(names)}
+    for i, s in enumerate(samples):
+        for n, v in s["x"].items():
+            X[i, cols[n]] = v
+    y = onp.array([math.log1p(float(s["y"])) for s in samples])
+    A = X.T @ X + float(lam) * onp.eye(len(names))
+    w = onp.linalg.solve(A, X.T @ y)
+    return {n: float(w[cols[n]]) for n in names}
+
+
+def _predict_raw(weights: Dict[str, float], x: Dict[str, float]) -> float:
+    z = 0.0
+    for n, v in x.items():
+        wn = weights.get(n)
+        if wn is not None:
+            z += wn * v
+    return math.expm1(min(z, 60.0))  # cap: never overflow on a wild input
+
+
+def _mape(pairs: Sequence[Tuple[float, float]]) -> Optional[float]:
+    errs = [abs(p - y) / y for p, y in pairs if y > 0]
+    return (sum(errs) / len(errs)) if errs else None
+
+
+class CostModel:
+    """A trained (or loaded) cost model: per-target ridge weights over
+    the sparse feature space, plus training metadata."""
+
+    def __init__(self, payload: Dict[str, Any]):
+        self.payload = payload
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def version(self) -> str:
+        return str(self.payload.get("sha256", ""))[:12] or "unsealed"
+
+    @property
+    def schema(self) -> int:
+        return int(self.payload.get("schema", 0))
+
+    def metrics(self, target: str) -> Dict[str, Any]:
+        return dict(self.payload.get("targets", {}).get(target, {}
+                                                        ).get("metrics", {}))
+
+    # -- inference --------------------------------------------------------
+    def predict(self, target: str, x: Dict[str, float]) -> Optional[float]:
+        t = self.payload.get("targets", {}).get(target)
+        if not t:
+            return None
+        v = _predict_raw(t.get("weights", {}), x)
+        if not math.isfinite(v) or v <= 0:
+            return None
+        return v
+
+    def importances(self, target: str, top: int = 16) -> List[Tuple[str, float]]:
+        """|weight| ranked — in log space every feature is O(log scale),
+        so raw magnitude is a fair importance proxy."""
+        t = self.payload.get("targets", {}).get(target, {})
+        w = t.get("weights", {})
+        ranked = sorted(w.items(), key=lambda kv: -abs(kv[1]))
+        return [(n, float(v)) for n, v in ranked[:top]]
+
+    # -- artifact ---------------------------------------------------------
+    def _sealed(self) -> Dict[str, Any]:
+        body = {k: v for k, v in self.payload.items() if k != "sha256"}
+        digest = hashlib.sha256(
+            json.dumps(body, sort_keys=True).encode("utf-8")).hexdigest()
+        body["sha256"] = digest
+        return body
+
+    def save(self, path: str) -> str:
+        """Atomic write (tmp + rename) of the sha256-sealed artifact."""
+        self.payload = self._sealed()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.payload, f, sort_keys=True, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        return self.payload["sha256"]
+
+
+def load(path: str) -> CostModel:
+    """Load + verify an artifact: schema version gate and sha256 seal —
+    a corrupt or hand-edited model is worse than no model."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CostModelError("unreadable cost model %s: %s" % (path, e))
+    if not isinstance(payload, dict):
+        raise CostModelError("cost model %s: not a JSON object" % path)
+    if int(payload.get("schema", -1)) != SCHEMA:
+        raise CostModelError(
+            "cost model %s: schema %r != %d (stale artifact)"
+            % (path, payload.get("schema"), SCHEMA))
+    want = payload.get("sha256")
+    body = {k: v for k, v in payload.items() if k != "sha256"}
+    got = hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode("utf-8")).hexdigest()
+    if not want or want != got:
+        raise CostModelError(
+            "cost model %s: sha256 mismatch (corrupt artifact)" % path)
+    return CostModel(payload)
+
+
+def train(records: Sequence[Dict], lam: float = 1.0,
+          holdout: float = 0.2, seed: int = 13, source: str = "",
+          holdout_buckets: Optional[set] = None) -> CostModel:
+    """Fit both targets from raw ledger records. Raises
+    :class:`CostModelError` on an empty corpus — the predictor refuses to
+    exist rather than return garbage, and every EWMA keeps its row-ratio
+    fallback. Holdout MAPE (and the row-ratio baseline MAPE for
+    ``step_us``) is computed only when a target has >= 10 samples.
+
+    ``holdout_buckets`` — a set of ``(endpoint, bucket)`` pairs — replaces
+    the random row split with a *bucket-level* holdout: every sample of a
+    held-out bucket leaves the training set, so the reported MAPEs measure
+    generalization to never-observed buckets (the cold-start case the
+    prior exists for), not interpolation within seen ones."""
+    corpus = build_corpus(records)
+    if not corpus:
+        raise CostModelError(
+            "empty ledger: no trainable records (step or compile) — "
+            "EWMA fallback stays in effect")
+    targets: Dict[str, Any] = {}
+    rng = onp.random.RandomState(seed)
+    for target in TARGETS:
+        samples = [s for s in corpus if s["target"] == target]
+        if not samples:
+            continue
+        if holdout_buckets is not None:
+            held = [s for s in samples
+                    if (s.get("endpoint"), s.get("bucket"))
+                    in holdout_buckets]
+            fit = [s for s in samples
+                   if (s.get("endpoint"), s.get("bucket"))
+                   not in holdout_buckets]
+            if not fit:
+                continue
+        else:
+            order = rng.permutation(len(samples)).tolist()
+            samples = [samples[i] for i in order]
+            n_hold = int(len(samples) * holdout) if len(samples) >= 10 else 0
+            held, fit = samples[:n_hold], samples[n_hold:]
+        weights = _fit_ridge(fit, lam)
+        metrics: Dict[str, Any] = {
+            "n_train": len(fit), "n_holdout": len(held),
+        }
+        if held:
+            preds = [(_predict_raw(weights, s["x"]), float(s["y"]))
+                     for s in held]
+            m = _mape(preds)
+            if m is not None:
+                metrics["holdout_mape"] = round(m, 4)
+                metrics["check_budget_mape"] = round(m * 1.5 + 0.1, 4)
+            if target == "step_us":
+                base = row_ratio_estimator(fit)
+                bm = _mape([(base(s), float(s["y"])) for s in held])
+                if bm is not None:
+                    metrics["row_ratio_mape"] = round(bm, 4)
+        targets[target] = {"weights": weights, "metrics": metrics}
+    if not targets:
+        raise CostModelError("no target had any trainable samples")
+    model = CostModel({
+        "schema": SCHEMA,
+        "created": round(time.time(), 3),
+        "source": str(source),
+        "n_records": len(records),
+        "n_samples": len(corpus),
+        "lambda": float(lam),
+        "seed": int(seed),
+        "targets": targets,
+    })
+    model.payload = model._sealed()
+    return model
+
+
+def train_from_dir(d: str, **kw) -> CostModel:
+    from . import compile_ledger
+    records = compile_ledger.read_ledger(d)
+    kw.setdefault("source", d)
+    return train(records, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the active model + live predictions
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional[CostModel] = None
+_ACTIVE_PINNED = False              # set_active() wins over the knob
+_ACTIVE_SRC: Optional[Tuple[str, float]] = None   # (path, mtime) cache
+_ACTIVE_ERR: Optional[str] = None
+
+
+def set_active(model: Optional[CostModel]):
+    """Pin the in-process model (tests / programmatic use). ``None``
+    unpins and returns control to ``MXNET_COSTMODEL_PATH``."""
+    global _ACTIVE, _ACTIVE_PINNED, _ACTIVE_SRC, _ACTIVE_ERR
+    with _LOCK:
+        _ACTIVE = model
+        _ACTIVE_PINNED = model is not None
+        _ACTIVE_SRC = None
+        _ACTIVE_ERR = None
+
+
+def active_model() -> Optional[CostModel]:
+    """The serving model: pinned one if set, else a lazy, mtime-cached
+    load of ``MXNET_COSTMODEL_PATH``. Load failures are remembered (and
+    surfaced on /costz) instead of retried every call."""
+    global _ACTIVE, _ACTIVE_SRC, _ACTIVE_ERR
+    with _LOCK:
+        if _ACTIVE_PINNED:
+            return _ACTIVE
+        path = str(_cfg("MXNET_COSTMODEL_PATH", "") or "")
+        if not path:
+            _ACTIVE, _ACTIVE_SRC = None, None
+            return None
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError as e:
+            _ACTIVE, _ACTIVE_SRC, _ACTIVE_ERR = None, None, str(e)
+            return None
+        if _ACTIVE_SRC == (path, mtime):
+            return _ACTIVE
+        try:
+            _ACTIVE = load(path)
+            _ACTIVE_ERR = None
+        except CostModelError as e:
+            _ACTIVE = None
+            _ACTIVE_ERR = str(e)
+        _ACTIVE_SRC = (path, mtime)
+        return _ACTIVE
+
+
+def prior_enabled() -> bool:
+    try:
+        return bool(_cfg("MXNET_COSTMODEL_PRIOR", True))
+    except Exception:
+        return True
+
+
+def _recent_compile_index() -> Dict[Any, Dict]:
+    try:
+        from . import compile_ledger
+        return _compile_index(compile_ledger.recent(512))
+    except Exception:
+        return {}
+
+
+def predict_step_us(key: Optional[Dict[str, Any]], site: str = "",
+                    rows: Optional[float] = None) -> Optional[float]:
+    """Predicted step wall (us) for a trigger key, or None without a
+    usable model. Joins the in-memory compile ring for program features
+    (warmup compiles before any step executes, so the join hits)."""
+    try:
+        m = active_model()
+        if m is None:
+            return None
+        comp = _join(key or {}, _recent_compile_index())
+        v = m.predict("step_us", featurize(key, site, rows=rows, comp=comp))
+        return v
+    except Exception:
+        return None
+
+
+def predict_compile_s(key: Optional[Dict[str, Any]],
+                      site: str = "") -> Optional[float]:
+    """Predicted cold-compile wall (s) for a trigger key, or None."""
+    try:
+        m = active_model()
+        if m is None:
+            return None
+        comp = _join(key or {}, _recent_compile_index())
+        return m.predict("compile_s", featurize(key, site, comp=comp))
+    except Exception:
+        return None
+
+
+def make_prior(site: str, key_fn: Callable[[int], Dict[str, Any]]
+               ) -> Callable[[int], Optional[float]]:
+    """A ``StepCostEWMA(prior=...)`` hook: prices bucket -> predicted us
+    via the active model, counting prior-priced cold buckets and
+    exporting the prediction gauge. ``key_fn`` builds the endpoint's
+    trigger key for a bucket (so mesh topology rides along for sharded
+    endpoints). Never raises; returns None when no model is active."""
+    def prior(bucket: int) -> Optional[float]:
+        try:
+            if not prior_enabled():
+                return None
+            v = predict_step_us(key_fn(bucket), site)
+            if v is None:
+                return None
+            _PRIOR_USED.labels(site).inc()
+            _PREDICTED_G.labels(site, str(bucket)).set(v)
+            return v
+        except Exception:
+            return None
+    return prior
+
+
+# ---------------------------------------------------------------------------
+# step records + residual drift
+# ---------------------------------------------------------------------------
+
+_STEP_COUNTS: Dict[Tuple[str, str], int] = {}
+
+
+def _should_log_step(n: int) -> bool:
+    # every observation while rare (powers of two), one per 256 steady-state
+    return n & (n - 1) == 0 or n % 256 == 0
+
+
+class _SiteResiduals:
+    """Latched residual drift state for one site (perf_sentinel pattern:
+    streak of out-of-band ratios -> one flight event per episode)."""
+
+    __slots__ = ("band", "sustain_n", "streak", "latched", "fired",
+                 "buckets")
+
+    def __init__(self, band: float, sustain_n: int):
+        self.band = max(1.01, float(band))
+        self.sustain_n = max(1, int(sustain_n))
+        self.streak = 0
+        self.latched = False
+        self.fired = 0
+        self.buckets: Dict[int, Dict[str, float]] = {}
+
+
+_RESIDUALS: Dict[str, _SiteResiduals] = {}
+
+
+def on_step_observed(site: str, key: Optional[Dict[str, Any]], bucket: int,
+                     measured_us: float, rows: Optional[float] = None,
+                     prior_us: Optional[float] = None):
+    """The measured side of predicted-vs-measured. Called from endpoint /
+    decode execute paths after each observed step: appends a rate-limited
+    ``kind="step"`` ledger record (the training corpus), and when a prior
+    exists for this bucket, exports the residual ratio and feeds the
+    latched drift detector. Never raises."""
+    try:
+        _maybe_record_step(site, key, bucket, measured_us, rows)
+    except Exception:
+        pass
+    try:
+        if prior_us and prior_us > 0 and measured_us > 0:
+            _observe_residual(site, int(bucket), float(prior_us),
+                              float(measured_us))
+    except Exception:
+        pass
+
+
+def _maybe_record_step(site, key, bucket, measured_us, rows):
+    from . import compile_ledger
+    d = compile_ledger.ledger_dir()
+    if not d or not bool(_cfg("MXNET_COSTMODEL_STEP_RECORDS", True)):
+        return
+    key = {str(k): v for k, v in (key or {}).items()}
+    ck = (str(site), _key_id(key))
+    with _LOCK:
+        n = _STEP_COUNTS.get(ck, 0) + 1
+        _STEP_COUNTS[ck] = n
+    if not _should_log_step(n):
+        return
+    rec = {
+        "kind": "step", "ts": round(time.time(), 3), "pid": os.getpid(),
+        "site": str(site), "key": key,
+        "step_us": round(float(measured_us), 3), "n": n,
+    }
+    if rows:
+        rec["rows"] = float(rows)
+    compile_ledger._append_jsonl(d, rec)
+
+
+def _observe_residual(site: str, bucket: int, prior_us: float,
+                      measured_us: float):
+    ratio = measured_us / prior_us
+    _RESIDUAL_G.labels(site, str(bucket)).set(ratio)
+    fire = None
+    with _LOCK:
+        st = _RESIDUALS.get(site)
+        if st is None:
+            st = _RESIDUALS[site] = _SiteResiduals(
+                band=float(_cfg("MXNET_COSTMODEL_DRIFT_BAND", 4.0)),
+                sustain_n=int(_cfg("MXNET_COSTMODEL_DRIFT_SUSTAIN_N", 8)))
+        b = st.buckets.setdefault(bucket, {"n": 0.0, "measured_us": 0.0})
+        b["n"] += 1
+        b["predicted_us"] = prior_us
+        prev = b["measured_us"]
+        b["measured_us"] = measured_us if b["n"] <= 1 else \
+            prev + 0.25 * (measured_us - prev)
+        b["ratio"] = ratio
+        out = ratio > st.band or ratio < 1.0 / st.band
+        if out:
+            st.streak += 1
+            if not st.latched and st.streak >= st.sustain_n:
+                # one event per episode: latch until a sample returns
+                # in-band
+                st.latched = True
+                st.fired += 1
+                fire = dict(site=site, bucket=bucket,
+                            predicted_us=round(prior_us, 3),
+                            measured_us=round(measured_us, 3),
+                            ratio=round(ratio, 4), band=st.band,
+                            sustain_n=st.sustain_n, episode=st.fired)
+        else:
+            st.streak = 0
+            st.latched = False
+    if fire is not None:
+        try:
+            _DRIFT_C.labels(site).inc()
+            m = active_model()
+            fire["model_version"] = m.version if m else None
+            from . import flight as _flight
+            _flight.trigger("cost_model_drift", **fire)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# corpus export / introspection
+# ---------------------------------------------------------------------------
+
+def read_steps(d: Optional[str] = None) -> List[Dict]:
+    """All ``kind="step"`` records from a ledger directory."""
+    from . import compile_ledger
+    return [r for r in compile_ledger.read_ledger(d)
+            if r.get("kind") == "step"]
+
+
+def export_rows(records: Sequence[Dict]
+                ) -> Tuple[List[str], List[Dict[str, Any]]]:
+    """The featurized corpus as flat rows for --features export:
+    (ordered column names, row dicts). Meta columns first, then the
+    sorted union of feature names."""
+    corpus = build_corpus(records)
+    names = sorted({n for s in corpus for n in s["x"]})
+    meta = ["target", "y", "site", "endpoint", "bucket"]
+    rows = []
+    for s in corpus:
+        row = {m: s.get(m) for m in meta}
+        row.update({n: s["x"].get(n, 0.0) for n in names})
+        rows.append(row)
+    return meta + names, rows
+
+
+def snapshot() -> Dict[str, Any]:
+    """Everything /costz renders: active model identity + per-target
+    metrics, load error if any, and per-site residual state."""
+    with _LOCK:
+        err = _ACTIVE_ERR
+        res = {
+            site: {
+                "band": st.band, "sustain_n": st.sustain_n,
+                "streak": st.streak, "latched": st.latched,
+                "fired": st.fired,
+                "buckets": {
+                    str(b): {k: (round(v, 3) if isinstance(v, float) else v)
+                             for k, v in info.items()}
+                    for b, info in sorted(st.buckets.items())},
+            }
+            for site, st in sorted(_RESIDUALS.items())
+        }
+    m = active_model()
+    info = None
+    if m is not None:
+        info = {
+            "version": m.version,
+            "schema": m.schema,
+            "created": m.payload.get("created"),
+            "source": m.payload.get("source"),
+            "n_samples": m.payload.get("n_samples"),
+            "targets": {t: m.metrics(t)
+                        for t in m.payload.get("targets", {})},
+        }
+    return {
+        "model": info,
+        "error": err,
+        "path": str(_cfg("MXNET_COSTMODEL_PATH", "") or "") or None,
+        "prior_enabled": prior_enabled(),
+        "residuals": res,
+    }
+
+
+def reset():
+    """Test hook: drop the active model, residual state and step-record
+    rate limiter."""
+    global _ACTIVE, _ACTIVE_PINNED, _ACTIVE_SRC, _ACTIVE_ERR
+    with _LOCK:
+        _ACTIVE = None
+        _ACTIVE_PINNED = False
+        _ACTIVE_SRC = None
+        _ACTIVE_ERR = None
+        _RESIDUALS.clear()
+        _STEP_COUNTS.clear()

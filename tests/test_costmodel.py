@@ -1,0 +1,421 @@
+"""Cost observatory (PR 17): learned performance model over the compile
+ledger, predicted-vs-measured everywhere, and ledger-replay auto-tuning.
+
+Covers: training on the committed fixture ledger with a *bucket-level*
+holdout (the learned model must beat the row-ratio fallback on buckets it
+never observed — the cold-start case the prior exists for), empty-ledger
+refusal with the EWMA fallback intact, single-record corpora, artifact
+sealing (sha256 + schema gates reject corrupt/stale models), the
+StepCostEWMA prior -> blend -> measured convergence, the MXNET_COSTMODEL_
+PRIOR kill switch, the latched residual drift detector (one
+``cost_model_drift`` flight bundle per episode), rate-limited kind="step"
+ledger records, ``tools/autotune.py`` --check/--model/--train against the
+committed fixture (perf_gate rc contract), ``tools/compile_report.py
+--features`` corpus export, the bitwise serving oracle with the prior
+enabled, and the /costz debug page.
+"""
+import csv
+import io
+import json
+import math
+import os
+import sys
+from contextlib import redirect_stdout
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd, serving
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving.router import StepCostEWMA
+from mxnet_tpu.telemetry import compile_ledger, costmodel, flight
+from mxnet_tpu.telemetry import debug_server as dbg
+from mxnet_tpu.telemetry.metrics import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+FIX = os.path.join(REPO, "tests", "fixtures", "costmodel")
+LEDGER = os.path.join(FIX, "ledger")
+MODEL = os.path.join(FIX, "model.json")
+
+
+def _import_tool(name):
+    sys.path.insert(0, TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _counter_value(name, **labels):
+    fam = REGISTRY.snapshot()["metrics"].get(name, {})
+    for s in fam.get("series", []):
+        if s.get("labels", {}) == labels:
+            return s.get("value", 0.0)
+    return 0.0
+
+
+def _mlp(seed=0, in_dim=16):
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(10))
+    net.initialize()
+    net(nd.array(onp.random.randn(2, in_dim).astype("float32")))
+    return net
+
+
+@pytest.fixture(autouse=True)
+def _clean_costmodel():
+    yield
+    costmodel.reset()
+    config.set("MXNET_COSTMODEL_PATH", "")
+    config.set("MXNET_COSTMODEL_PRIOR", True)
+
+
+# ---------------------------------------------------------------------------
+# training: the fixture corpus and its honest metrics
+# ---------------------------------------------------------------------------
+
+def test_model_beats_row_ratio_on_never_observed_buckets():
+    """The PR's acceptance gate: hold out whole (endpoint, bucket) pairs —
+    every sample of those buckets leaves the training set — and the learned
+    predictor must beat the row-ratio fallback on them."""
+    records = compile_ledger.read_ledger(LEDGER)
+    held = {("fx_small", 16), ("fx_mid", 4), ("fx_wide", 32)}
+    model = costmodel.train(records, holdout_buckets=held)
+    met = model.metrics("step_us")
+    print(f"never-observed buckets {sorted(held)}: "
+          f"model MAPE={met['holdout_mape']} "
+          f"row-ratio MAPE={met['row_ratio_mape']}")
+    assert met["n_holdout"] > 0
+    assert met["holdout_mape"] < met["row_ratio_mape"], (
+        "learned model does not beat the row-ratio baseline on "
+        "never-observed buckets")
+    # the held-out buckets really were excluded from the fit
+    assert met["n_train"] + met["n_holdout"] == sum(
+        1 for s in costmodel.build_corpus(records)
+        if s["target"] == "step_us")
+
+
+def test_empty_ledger_refused_and_ewma_fallback_intact():
+    """No corpus -> the predictor refuses to exist (no garbage model) and
+    a prior-less StepCostEWMA keeps its exact legacy behavior."""
+    with pytest.raises(costmodel.CostModelError):
+        costmodel.train([])
+    m = StepCostEWMA(alpha=0.5)
+    assert m.estimate(8) == 0.0                 # empty table: pure EDF
+    m.observe(8, 1000.0)
+    m.observe(8, 2000.0)
+    assert m.estimate(8) == 1500.0
+    assert m.estimate(4) == pytest.approx(750.0)  # nearest-bucket row ratio
+    assert m.snapshot() == {8: 1500.0}          # legacy shape pinned
+
+
+def test_single_record_corpus_trains():
+    rec = {"kind": "step", "site": "s", "step_us": 1234.0,
+           "key": {"endpoint": "e", "bucket": 4}}
+    model = costmodel.train([rec])
+    met = model.metrics("step_us")
+    assert met["n_train"] == 1 and met["n_holdout"] == 0
+    x = costmodel.featurize({"endpoint": "e", "bucket": 4}, "s", rows=4)
+    assert model.predict("step_us", x) > 0
+
+
+# ---------------------------------------------------------------------------
+# artifact: sealed, versioned, atomic
+# ---------------------------------------------------------------------------
+
+def test_artifact_roundtrip_and_corrupt_or_stale_rejected(tmp_path):
+    committed = costmodel.load(MODEL)           # the committed fixture loads
+    assert committed.schema == costmodel.SCHEMA
+    assert committed.version == committed.payload["sha256"][:12]
+
+    records = compile_ledger.read_ledger(LEDGER)
+    model = costmodel.train(records, source="unit")
+    p = str(tmp_path / "m.json")
+    sha = model.save(p)
+    loaded = costmodel.load(p)
+    assert loaded.version == sha[:12]
+    x = costmodel.featurize({"endpoint": "fx_mid", "bucket": 8}, "serving_step")
+    assert loaded.predict("step_us", x) == model.predict("step_us", x)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    # corrupt: a tampered weight breaks the sha256 seal
+    payload = json.loads(open(p).read())
+    payload["targets"]["step_us"]["weights"]["bias"] += 0.5
+    bad = str(tmp_path / "bad.json")
+    open(bad, "w").write(json.dumps(payload))
+    with pytest.raises(costmodel.CostModelError, match="sha256"):
+        costmodel.load(bad)
+
+    # stale: a schema from another era is refused before any sha check
+    payload = json.loads(open(p).read())
+    payload["schema"] = costmodel.SCHEMA + 1
+    stale = str(tmp_path / "stale.json")
+    open(stale, "w").write(json.dumps(payload))
+    with pytest.raises(costmodel.CostModelError, match="schema"):
+        costmodel.load(stale)
+
+    with pytest.raises(costmodel.CostModelError):
+        costmodel.load(str(tmp_path / "missing.json"))
+
+
+# ---------------------------------------------------------------------------
+# the prior: cold pricing, blending, kill switch
+# ---------------------------------------------------------------------------
+
+def test_cold_bucket_priced_by_prior_then_converges_to_measured():
+    calls = []
+
+    def prior(bucket):
+        calls.append(bucket)
+        return 8000.0
+
+    m = StepCostEWMA(alpha=1.0, prior=prior, blend_n=4)
+    assert m.estimate(8) == 8000.0              # cold: the prediction
+    for n, want in ((1, 6250.0), (2, 4500.0), (3, 2750.0)):
+        m.observe(8, 1000.0)
+        assert m.estimate(8) == pytest.approx(want), f"blend at n={n}"
+    m.observe(8, 1000.0)
+    assert m.estimate(8) == 1000.0              # n >= blend_n: measured only
+    assert calls.count(8) == 1                  # prior consulted once, cached
+    assert m.snapshot() == {8: 1000.0}          # legacy shape untouched
+    d = m.snapshot_detail()
+    assert d["prior"] is True and d["blend_n"] == 4
+    assert d["buckets"][8] == {"measured_us": 1000.0, "n": 4,
+                               "prior_us": 8000.0, "est_us": 1000.0}
+
+
+def test_prior_kill_switch():
+    costmodel.set_active(costmodel.load(MODEL))
+    key_fn = lambda b: {"endpoint": "fx_small", "bucket": b,
+                        "dtype": "float32", "device": "cpu"}
+    p = costmodel.make_prior("serving_step", key_fn)
+    assert p(8) > 0
+    config.set("MXNET_COSTMODEL_PRIOR", False)
+    assert p(8) is None
+    m = StepCostEWMA(prior=p)
+    assert m.estimate(8) == 0.0                 # legacy cold behavior back
+    config.set("MXNET_COSTMODEL_PRIOR", True)
+
+
+def test_active_model_from_knob_and_stale_path_remembered(tmp_path):
+    records = compile_ledger.read_ledger(LEDGER)
+    model = costmodel.train(records)
+    p = str(tmp_path / "knob.json")
+    model.save(p)
+    config.set("MXNET_COSTMODEL_PATH", p)
+    got = costmodel.active_model()
+    assert got is not None and got.version == model.version
+    # corrupt the file in place: the mtime-cached loader re-reads, rejects,
+    # and /costz surfaces the error instead of silently serving garbage
+    open(p, "w").write("{not json")
+    os.utime(p, (0, 0))
+    assert costmodel.active_model() is None
+    assert "unreadable" in (costmodel.snapshot()["error"] or "")
+
+
+# ---------------------------------------------------------------------------
+# predicted-vs-measured: residual drift, step records
+# ---------------------------------------------------------------------------
+
+def test_scaled_artifact_mispredict_fires_one_drift_event(tmp_path):
+    """The injected-mispredict acceptance drill: scale the committed
+    artifact (a bias shift in log space multiplies every prediction),
+    reseal it, and serve it as the prior. Sustained out-of-band residuals
+    must trip exactly one ``cost_model_drift`` flight event per episode,
+    with a parseable bundle."""
+    scale = 50.0
+    payload = json.loads(open(MODEL).read())
+    payload.pop("sha256")
+    payload["targets"]["step_us"]["weights"]["bias"] -= math.log(scale)
+    p = str(tmp_path / "scaled.json")
+    costmodel.CostModel(payload).save(p)        # reseals: load() accepts it
+    costmodel.set_active(costmodel.load(p))
+
+    site = "t_drift_site"
+    key = {"endpoint": "fx_small", "bucket": 8, "dtype": "float32",
+           "device": "cpu"}
+    # join the fixture's compile record for program features, the way the
+    # live path joins the in-memory compile ring
+    comp_idx = costmodel._compile_index(compile_ledger.read_ledger(LEDGER))
+    x = costmodel.featurize(key, site, comp=costmodel._join(key, comp_idx))
+    pred = costmodel.active_model().predict("step_us", x)
+    honest = costmodel.load(MODEL).predict("step_us", x)
+    assert pred > 0 and honest / pred > 4.0     # mispredict clears the band
+
+    fdir = str(tmp_path / "flight")
+    config.set("MXNET_FLIGHT_DIR", fdir)
+    flight.RECORDER.reset_rate_limit()
+    before = _counter_value("mxtpu_cost_model_drift_total", site=site)
+    try:
+        # "measured" wall is what the honest model expects; the scaled
+        # artifact underpredicts ~50x, sustained -> latch after
+        # MXNET_COSTMODEL_DRIFT_SUSTAIN_N (8) and fire exactly once
+        for _ in range(20):
+            costmodel.on_step_observed(site, key, 8,
+                                       measured_us=honest, prior_us=pred)
+        assert _counter_value("mxtpu_cost_model_drift_total",
+                              site=site) == before + 1
+        bundles = flight.list_bundles(fdir)
+        assert len(bundles) == 1
+        b = flight.load_bundle(bundles[0])
+        assert b["trigger"]["kind"] == "cost_model_drift"
+        at = b["trigger"]["attrs"]
+        assert at["site"] == site and at["bucket"] == 8
+        assert at["ratio"] == pytest.approx(honest / pred, rel=1e-3)
+        assert at["band"] == 4.0 and at["episode"] == 1
+        assert at["model_version"] == costmodel.active_model().version
+        # an in-band sample clears the latch; a new excursion is a new
+        # episode (counter moves again)
+        costmodel.on_step_observed(site, key, 8, measured_us=honest,
+                                   prior_us=honest)
+        for _ in range(10):
+            costmodel.on_step_observed(site, key, 8,
+                                       measured_us=honest, prior_us=pred)
+        assert _counter_value("mxtpu_cost_model_drift_total",
+                              site=site) == before + 2
+        snap = costmodel.snapshot()["residuals"][site]
+        assert snap["fired"] == 2 and snap["latched"] is True
+    finally:
+        config.set("MXNET_FLIGHT_DIR", "")
+
+
+def test_step_records_rate_limited_to_powers_of_two(tmp_path):
+    config.set("MXNET_COMPILE_LEDGER_DIR", str(tmp_path))
+    try:
+        key = {"endpoint": "t_rl", "bucket": 2}
+        for _ in range(10):
+            costmodel.on_step_observed("t_rl_site", key, 2, 1000.0, rows=2)
+        steps = costmodel.read_steps(str(tmp_path))
+        assert [s["n"] for s in steps] == [1, 2, 4, 8]
+        assert all(s["kind"] == "step" and "fingerprint" not in s
+                   for s in steps)
+        # the compile rollup never sees them
+        cr = _import_tool("compile_report")
+        agg = cr.rollup(compile_ledger.read_ledger(str(tmp_path)))
+        assert agg["records"] == 0
+    finally:
+        config.set("MXNET_COMPILE_LEDGER_DIR", "")
+
+
+# ---------------------------------------------------------------------------
+# tools: autotune (train / replay / check), compile_report --features
+# ---------------------------------------------------------------------------
+
+def test_autotune_check_follows_perf_gate_rc_contract(tmp_path):
+    at = _import_tool("autotune")
+    assert at.main([LEDGER, "--check", MODEL]) == 0   # committed pair clean
+
+    payload = json.loads(open(MODEL).read())
+    payload["targets"]["step_us"]["weights"]["bias"] += 1.0
+    bad = str(tmp_path / "tampered.json")
+    open(bad, "w").write(json.dumps(payload))
+    with redirect_stdout(io.StringIO()) as out:
+        rc = at.main([LEDGER, "--check", bad])
+    assert rc == 1 and "VIOLATION" in out.getvalue()  # seal broken
+
+    assert at.main([LEDGER, "--check",
+                    str(tmp_path / "missing.json")]) == 2  # operational
+
+
+def test_autotune_train_then_replay_emits_tuned_config(tmp_path):
+    at = _import_tool("autotune")
+    trained = str(tmp_path / "trained.json")
+    with redirect_stdout(io.StringIO()):
+        assert at.main([LEDGER, "--train", trained]) == 0
+    tuned_p = str(tmp_path / "tuned.json")
+    with redirect_stdout(io.StringIO()):
+        assert at.main([LEDGER, "--model", trained, "--out", tuned_p]) == 0
+    tuned = json.loads(open(tuned_p).read())
+    rep = tuned["report"]
+    assert rep["predicted_vs_measured"] and rep["holdout_mape"] is not None
+    for row in rep["predicted_vs_measured"]:
+        assert row["measured_us"] > 0 and row["predicted_us"] is not None
+    # every fixture endpoint got a ladder + batch cap from predicted
+    # cost-per-row
+    for ep in ("fx_small", "fx_mid", "fx_wide"):
+        lad = tuned["bucket_ladders"][f"serving_step/{ep}"]
+        assert lad["buckets"] and lad["max_batch_size"] in lad["buckets"]
+    # sections the ledger cannot support are skipped, never silently tuned
+    assert "skipped" in tuned["kv_pages"]
+    assert tuned["autoscale"]["predicted_replica_warmup_s"] > 0
+    assert set(tuned["autoscale"]["env"]) == {"MXNET_AUTOSCALE_UP_N",
+                                              "MXNET_AUTOSCALE_COOLDOWN_S"}
+
+
+def test_compile_report_features_export(tmp_path):
+    cr = _import_tool("compile_report")
+    records = compile_ledger.read_ledger(LEDGER)
+    n_samples = len(costmodel.build_corpus(records))
+
+    out_csv = str(tmp_path / "corpus.csv")
+    with redirect_stdout(io.StringIO()):
+        assert cr.main([LEDGER, "--features", "--out", out_csv]) == 0
+    rows = list(csv.DictReader(open(out_csv)))
+    assert len(rows) == n_samples
+    assert {"target", "y", "site", "endpoint", "bucket"} <= set(rows[0])
+    assert any(c.startswith("op:") for c in rows[0])   # op histogram rode in
+    assert {r["target"] for r in rows} == {"step_us", "compile_s"}
+
+    out_jl = str(tmp_path / "corpus.jsonl")
+    with redirect_stdout(io.StringIO()):
+        assert cr.main([LEDGER, "--features", "--format", "jsonl",
+                        "--out", out_jl]) == 0
+    lines = [json.loads(l) for l in open(out_jl)]
+    assert len(lines) == n_samples and all("y" in l for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# serving with the prior enabled: bitwise oracle + observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_serving_bitwise_unchanged_with_prior_enabled():
+    """The prior only re-prices the scheduler; outputs must stay
+    byte-identical to the direct forward."""
+    costmodel.set_active(costmodel.load(MODEL))
+    net = _mlp(seed=7)
+    x = onp.random.RandomState(3).randn(5, 16).astype("float32")
+    direct = net(nd.array(x)).asnumpy()
+    ep = serving.ModelEndpoint("t_cost_prior", net, input_shapes=(16,),
+                               max_batch_size=8)
+    srv = serving.InferenceServer(batch_timeout_ms=1.0, max_queue=64)
+    srv.register(ep)
+    srv.start()
+    try:
+        got = srv.predict("t_cost_prior", x, timeout=60).asnumpy()
+        assert got.tobytes() == direct.tobytes()
+    finally:
+        srv.stop()
+        serving.unregister("t_cost_prior")
+    d = ep.step_cost.snapshot_detail()
+    assert d["prior"] is True
+    # warmup measured every bucket; the est gauge is live per bucket
+    assert all(info["measured_us"] > 0 for info in d["buckets"].values())
+    fam = REGISTRY.snapshot()["metrics"]["mxtpu_step_cost_est_us"]
+    eps = {s["labels"]["endpoint"] for s in fam["series"]}
+    assert "t_cost_prior" in eps
+
+
+def test_predicted_warmup_s_prices_fresh_replicas():
+    costmodel.set_active(costmodel.load(MODEL))
+    net = _mlp(seed=9)
+    ep = serving.ModelEndpoint("t_cost_warm", net, input_shapes=(16,),
+                               max_batch_size=8)
+    lead = ep.predicted_warmup_s()
+    assert lead > 0                             # every bucket priced
+    costmodel.reset()
+    assert ep.predicted_warmup_s() == 0.0       # no model -> no lead
+
+
+def test_costz_page_renders_model_and_residuals():
+    costmodel.set_active(costmodel.load(MODEL))
+    costmodel.on_step_observed("t_costz_site", {"endpoint": "e", "bucket": 4},
+                               4, measured_us=2000.0, prior_us=1000.0)
+    page = dbg.costz()
+    assert costmodel.active_model().version in page
+    assert "t_costz_site" in page
+    assert "step_us" in page

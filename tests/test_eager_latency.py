@@ -30,6 +30,21 @@ def _median_ms(f, n=15, warmup=5):
     return ts[len(ts) // 2]
 
 
+def _best_median_ms(f, threshold_ms, windows=3, n=15, warmup=5):
+    """Best-of-N measurement windows (the perf_gate discipline): one window
+    can land entirely inside a GC pause or a CI neighbor's CPU burst when
+    the full suite runs, and a latency *gate* asks whether the fast path
+    exists, not whether the host was quiet. Early-exits as soon as a window
+    is comfortably under the gate so the common case stays one window."""
+    best = None
+    for _ in range(windows):
+        med = _median_ms(f, n=n, warmup=warmup)
+        best = med if best is None else min(best, med)
+        if best < threshold_ms * 0.5:
+            break
+    return best
+
+
 def test_backward_stays_on_head_device():
     """Cotangents must be created on the heads' device, not the global default.
 
@@ -108,7 +123,7 @@ def test_eager_backward_latency_gate():
         y.backward()
         return float(x.grad.data.ravel()[0])
 
-    med = _median_ms(bwd)
+    med = _best_median_ms(bwd, 60.0)
     assert med < 60.0, f"eager exp backward regressed: {med:.1f} ms/call"
 
 
@@ -122,7 +137,7 @@ def test_eager_jit_op_latency_gate():
         out = mx.nd.Convolution(d, w, b, kernel=(3, 3), num_filter=8, pad=(1, 1))
         return float(out.data.ravel()[0])
 
-    med = _median_ms(conv)
+    med = _best_median_ms(conv, 60.0)
     assert med < 60.0, f"eager conv dispatch regressed: {med:.1f} ms/call"
 
 

@@ -1,0 +1,381 @@
+"""Ledger-replay auto-tuning: turn compile-ledger exhaust into config.
+
+The TVM/AutoTVM loop (PAPERS.md) applied to this fleet's own telemetry:
+``MXNET_COMPILE_LEDGER_DIR`` already holds measured compile wall per
+trigger key and (since the cost observatory) measured step wall per
+(site, key, bucket). This tool replays that corpus offline —
+
+    python tools/autotune.py DIR --train model.json
+        fit the cost model (telemetry.costmodel.train) and write the
+        sha256-sealed artifact; prints holdout metrics
+
+    python tools/autotune.py DIR --model model.json [--out tuned.json]
+        replay the ledger through the model and emit a tuned config +
+        predicted-vs-measured report:
+          * per-endpoint bucket ladder: drop buckets whose predicted
+            cost-per-row saves less than --ladder-tol vs padding into the
+            next bucket (a bucket must earn its executable)
+          * per-endpoint batch cap: the largest bucket still improving
+            predicted cost-per-row by more than --cap-tol
+          * decode KV page size: predicted decode-step cost per candidate
+            page count, when the corpus has paged decode records
+          * autoscale hysteresis: MXNET_AUTOSCALE_UP_N / COOLDOWN_S sized
+            from the predicted replica warm-up wall
+        Sections the ledger cannot support are reported as skipped, never
+        silently tuned.
+
+    python tools/autotune.py DIR --check model.json
+        validate a committed artifact against a committed ledger the way
+        ``perf_gate --check`` validates budgets: the artifact must load
+        (sha256 + schema), and its full-corpus MAPE per target must stay
+        within the check budget sealed at training time.
+        rc 0 clean / 1 violation (corrupt, stale, or drifted) / 2
+        operational error.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_records(d):
+    from mxnet_tpu.telemetry import compile_ledger
+    records = compile_ledger.read_ledger(d)
+    if not records:
+        raise SystemExit(f"no ledger-*.jsonl records under {d}")
+    return records
+
+
+def _measured_by_bucket(samples):
+    """Mean measured step_us per (site, endpoint) per bucket."""
+    acc = {}
+    for s in samples:
+        if s["target"] != "step_us" or s.get("bucket") is None:
+            continue
+        g = acc.setdefault((s["site"], s["endpoint"]), {})
+        g.setdefault(float(s["bucket"]), []).append(float(s["y"]))
+    return {gk: {b: sum(v) / len(v) for b, v in sorted(g.items())}
+            for gk, g in acc.items()}
+
+
+def _predict_table(model, samples):
+    """Predicted-vs-measured per (site, endpoint, bucket) + the model's
+    in-sample MAPE on step_us. (The honest out-of-sample comparison
+    against the row-ratio baseline lives in the artifact's training
+    metrics — an in-sample row-ratio baseline memorizes bucket means and
+    scores a meaningless 0.)"""
+    measured = _measured_by_bucket(samples)
+    table = []
+    errs_model = []
+    for (site, ep), buckets in sorted(measured.items()):
+        for b, meas in buckets.items():
+            sample = next(s for s in samples
+                          if s["target"] == "step_us" and s["site"] == site
+                          and s["endpoint"] == ep
+                          and float(s["bucket"]) == b)
+            pred = model.predict("step_us", sample["x"])
+            row = {"site": site, "endpoint": ep, "bucket": int(b),
+                   "measured_us": round(meas, 1),
+                   "predicted_us": round(pred, 1) if pred else None}
+            if pred and meas > 0:
+                row["residual_ratio"] = round(meas / pred, 3)
+                errs_model.append(abs(pred - meas) / meas)
+            table.append(row)
+    mape = (round(sum(errs_model) / len(errs_model), 4)
+            if errs_model else None)
+    return table, mape
+
+
+def _predict_step(model, site, key, comp_idx):
+    from mxnet_tpu.telemetry import costmodel
+    comp = costmodel._join(key, comp_idx)
+    return model.predict("step_us",
+                         costmodel.featurize(key, site, comp=comp))
+
+
+def _tune_ladders(model, records, ladder_tol, cap_tol):
+    """Per-endpoint bucket ladder + batch cap from predicted cost-per-row.
+
+    A bucket stays in the ladder when running rows at it is more than
+    ``ladder_tol`` cheaper per row than padding them into the next-larger
+    kept bucket. The batch cap is the largest bucket whose predicted
+    cost-per-row still improves on the previous bucket's by ``cap_tol``."""
+    from mxnet_tpu.telemetry import costmodel
+    comp_idx = costmodel._compile_index(records)
+    # candidate keys: distinct (site, endpoint) with their observed key
+    # shape; ladder candidates are the buckets seen in the ledger
+    seen = {}
+    for r in records:
+        key = r.get("key") if isinstance(r.get("key"), dict) else {}
+        if r.get("kind") != "step" or key.get("bucket") is None:
+            continue
+        g = seen.setdefault((r.get("site"), key.get("endpoint")), {})
+        g[int(key["bucket"])] = key
+    out = {}
+    for (site, ep), buckets in sorted(seen.items()):
+        ladder = sorted(buckets)
+        preds = {}
+        for b in ladder:
+            v = _predict_step(model, site, dict(buckets[b], bucket=b),
+                              comp_idx)
+            if v:
+                preds[b] = v
+        if len(preds) < 2:
+            out[f"{site}/{ep}"] = {"skipped":
+                                   "fewer than 2 predictable buckets"}
+            continue
+        # walk large -> small: keep a bucket iff its per-row cost beats
+        # padding into the next kept (larger) bucket by ladder_tol
+        kept = [max(preds)]
+        for b in sorted(preds, reverse=True)[1:]:
+            nxt = kept[-1]
+            pad_cost_per_row = preds[nxt] / b      # b rows padded to nxt
+            own_cost_per_row = preds[b] / b
+            if own_cost_per_row < pad_cost_per_row * (1.0 - ladder_tol):
+                kept.append(b)
+        kept = sorted(kept)
+        # batch cap: largest bucket still improving cost-per-row
+        ordered = sorted(preds)
+        cap = ordered[0]
+        for prev, b in zip(ordered, ordered[1:]):
+            if preds[b] / b < (preds[prev] / prev) * (1.0 - cap_tol):
+                cap = b
+        out[f"{site}/{ep}"] = {
+            "buckets": kept,
+            "max_batch_size": cap,
+            "predicted_us": {str(b): round(v, 1)
+                             for b, v in sorted(preds.items())},
+            "cost_per_row_us": {str(b): round(v / b, 2)
+                                for b, v in sorted(preds.items())},
+        }
+    return out
+
+
+def _tune_kv_pages(model, records):
+    """Predicted decode-step cost per candidate KV page count, when the
+    corpus carries paged decode keys (a ``pages`` entry)."""
+    from mxnet_tpu.telemetry import costmodel
+    comp_idx = costmodel._compile_index(records)
+    paged = [r for r in records
+             if isinstance(r.get("key"), dict)
+             and r["key"].get("pages") is not None
+             and str(r.get("site", "")).startswith("decode")]
+    if not paged:
+        return {"skipped": "no paged decode records in this ledger"}
+    key = dict(paged[-1]["key"])
+    site = paged[-1].get("site", "decode_step")
+    preds = {}
+    for pages in (4, 8, 16, 32, 64):
+        v = _predict_step(model, site, dict(key, pages=pages), comp_idx)
+        if v:
+            preds[pages] = round(v, 1)
+    if not preds:
+        return {"skipped": "model cannot price the pages feature"}
+    best = min(preds, key=preds.get)
+    return {"predicted_us_by_pages": {str(k): v
+                                      for k, v in sorted(preds.items())},
+            "recommended_pages_per_seq": best}
+
+
+def _tune_autoscale(model, records, poll_s, up_n, cooldown_s):
+    """Size the scale-up hysteresis from the predicted warm-up wall of a
+    fresh replica (sum of predicted cold-compile over distinct trigger
+    keys)."""
+    from mxnet_tpu.telemetry import costmodel
+    keys = {}
+    for r in records:
+        if r.get("kind") == "step" or not isinstance(r.get("key"), dict):
+            continue
+        if r["key"].get("bucket") is None:
+            continue
+        keys[costmodel._key_id(r["key"])] = (r.get("site", ""), r["key"])
+    warm = 0.0
+    priced = 0
+    comp_idx = costmodel._compile_index(records)
+    for site, key in keys.values():
+        comp = costmodel._join(key, comp_idx)
+        v = model.predict("compile_s",
+                          costmodel.featurize(key, site, comp=comp))
+        if v:
+            warm += v
+            priced += 1
+    if not priced:
+        return {"skipped": "no predictable compile keys"}
+    lead_polls = int(warm // max(poll_s, 1e-9))
+    return {
+        "predicted_replica_warmup_s": round(warm, 3),
+        "priced_keys": priced,
+        "env": {
+            "MXNET_AUTOSCALE_UP_N": max(1, up_n - lead_polls),
+            "MXNET_AUTOSCALE_COOLDOWN_S": round(
+                max(float(cooldown_s), warm), 1),
+        },
+    }
+
+
+def cmd_train(args):
+    from mxnet_tpu.telemetry import costmodel
+    records = _load_records(args.dir)
+    try:
+        model = costmodel.train(records, lam=args.ridge_lambda,
+                                source=args.dir)
+    except costmodel.CostModelError as e:
+        print(f"autotune --train: {e}", file=sys.stderr)
+        return 2
+    sha = model.save(args.train)
+    print(f"wrote {args.train} (sha256 {sha[:12]}, "
+          f"{model.payload['n_samples']} samples)")
+    for t in ("step_us", "compile_s"):
+        met = model.metrics(t)
+        if met:
+            print(f"  {t}: n_train={met.get('n_train')} "
+                  f"holdout_mape={met.get('holdout_mape', '-')} "
+                  f"row_ratio_mape={met.get('row_ratio_mape', '-')} "
+                  f"check_budget_mape={met.get('check_budget_mape', '-')}")
+    return 0
+
+
+def cmd_replay(args):
+    from mxnet_tpu.telemetry import costmodel
+    records = _load_records(args.dir)
+    try:
+        model = costmodel.load(args.model)
+    except costmodel.CostModelError as e:
+        print(f"autotune: {e}", file=sys.stderr)
+        return 1
+    samples = costmodel.build_corpus(records)
+    table, mape = _predict_table(model, samples)
+    train_met = model.metrics("step_us")
+    tuned = {
+        "model": {"path": args.model, "version": model.version},
+        "ledger": {"dir": args.dir, "records": len(records),
+                   "samples": len(samples)},
+        "report": {
+            "predicted_vs_measured": table,
+            "step_mape_in_sample": mape,
+            "holdout_mape": train_met.get("holdout_mape"),
+            "holdout_row_ratio_mape": train_met.get("row_ratio_mape"),
+        },
+        "bucket_ladders": _tune_ladders(model, records,
+                                        args.ladder_tol, args.cap_tol),
+        "kv_pages": _tune_kv_pages(model, records),
+        "autoscale": _tune_autoscale(model, records, args.poll_s,
+                                     args.up_n, args.cooldown_s),
+    }
+    body = json.dumps(tuned, indent=1, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(body + "\n")
+        print(f"wrote tuned config to {args.out}")
+    else:
+        print(body)
+    if mape is not None:
+        print(f"# step_us in-sample MAPE {mape} | training holdout: "
+              f"model={train_met.get('holdout_mape', '-')} "
+              f"row_ratio={train_met.get('row_ratio_mape', '-')}",
+              file=sys.stderr)
+    return 0
+
+
+def cmd_check(args):
+    """Validate the committed artifact against the committed ledger."""
+    from mxnet_tpu.telemetry import costmodel
+    if not os.path.exists(args.check):
+        print(f"autotune --check: no artifact at {args.check}",
+              file=sys.stderr)
+        return 2
+    try:
+        model = costmodel.load(args.check)
+    except costmodel.CostModelError as e:
+        print(f"autotune --check: VIOLATION artifact rejected: {e}")
+        return 1
+    records = _load_records(args.dir)
+    samples = costmodel.build_corpus(records)
+    if not samples:
+        print("autotune --check: ledger has no trainable samples",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for target in ("step_us", "compile_s"):
+        tsamples = [s for s in samples if s["target"] == target]
+        met = model.metrics(target)
+        budget = met.get("check_budget_mape")
+        if not tsamples or budget is None:
+            continue
+        errs = []
+        for s in tsamples:
+            pred = model.predict(target, s["x"])
+            if pred and s["y"] > 0:
+                errs.append(abs(pred - s["y"]) / s["y"])
+        if not errs:
+            print(f"autotune --check: VIOLATION {target}: model prices "
+                  "none of the ledger's samples")
+            rc = 1
+            continue
+        mape = sum(errs) / len(errs)
+        verdict = "ok" if mape <= budget else "VIOLATION"
+        print(f"autotune --check: {verdict} {target}: mape={mape:.4f} "
+              f"budget={budget} over {len(errs)} samples "
+              f"(model {model.version})")
+        if mape > budget:
+            rc = 1
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Replay a compile-ledger directory through the learned "
+                    "cost model: train/save the artifact, emit a tuned "
+                    "config + predicted-vs-measured report, or --check a "
+                    "committed artifact against a committed ledger.")
+    ap.add_argument("dir", nargs="?", default="",
+                    help="ledger directory (default: "
+                         "$MXNET_COMPILE_LEDGER_DIR)")
+    ap.add_argument("--train", metavar="OUT.json",
+                    help="fit the cost model on this ledger and write the "
+                         "sealed artifact")
+    ap.add_argument("--model", metavar="MODEL.json",
+                    help="replay the ledger through this artifact and emit "
+                         "the tuned config")
+    ap.add_argument("--check", metavar="MODEL.json",
+                    help="validate this artifact against the ledger "
+                         "(rc 0/1/2, the perf_gate --check contract)")
+    ap.add_argument("--out", default="",
+                    help="tuned-config destination (default stdout)")
+    ap.add_argument("--ridge-lambda", type=float, default=1.0,
+                    help="--train ridge regularization (default 1.0)")
+    ap.add_argument("--ladder-tol", type=float, default=0.10,
+                    help="minimum per-row saving for a bucket to stay in "
+                         "the ladder (default 0.10)")
+    ap.add_argument("--cap-tol", type=float, default=0.02,
+                    help="minimum per-row improvement for a larger batch "
+                         "cap (default 0.02)")
+    ap.add_argument("--poll-s", type=float, default=1.0,
+                    help="autoscaler poll period assumed for hysteresis "
+                         "sizing (default 1.0)")
+    ap.add_argument("--up-n", type=int, default=2,
+                    help="baseline MXNET_AUTOSCALE_UP_N (default 2)")
+    ap.add_argument("--cooldown-s", type=float, default=10.0,
+                    help="baseline MXNET_AUTOSCALE_COOLDOWN_S (default 10)")
+    args = ap.parse_args(argv)
+
+    if sum(1 for m in (args.train, args.model, args.check) if m) != 1:
+        ap.error("pick exactly one of --train / --model / --check")
+    if not args.dir:
+        from mxnet_tpu.telemetry import compile_ledger
+        args.dir = compile_ledger.ledger_dir()
+    if not args.dir:
+        print("autotune: no ledger directory: pass one or set "
+              "MXNET_COMPILE_LEDGER_DIR", file=sys.stderr)
+        return 2
+    if args.train:
+        return cmd_train(args)
+    if args.check:
+        return cmd_check(args)
+    return cmd_replay(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,8 @@ recompile storm raises:
     python tools/compile_report.py            # $MXNET_COMPILE_LEDGER_DIR
     python tools/compile_report.py DIR --top 30
     python tools/compile_report.py DIR --json # machine-readable rollup
+    python tools/compile_report.py DIR --features [--format csv|jsonl]
+                                              # featurized cost-model corpus
 
   * where did the wall time go — top-N records by lower+compile seconds;
   * what was wasted — fingerprints compiled more than once, ranked by the
@@ -19,6 +21,13 @@ recompile storm raises:
   * what is the hardware doing — flops vs bytes-accessed ratios per record
     where the backend's cost_analysis() reported them (low flops/byte =
     memory-bound, the program to fuse first).
+
+``--features`` instead exports the cost model's featurized training corpus
+(``telemetry.costmodel.export_rows``) as CSV (default) or JSONL — the exact
+matrix ``tools/autotune.py --train`` fits, reproducible outside the process
+that trained it. ``kind="step"`` records (measured step wall, written by
+the cost observatory) are excluded from the compile rollup and included in
+the feature export as ``step_us`` target rows.
 """
 import argparse
 import json
@@ -33,7 +42,10 @@ def _fmt_s(v):
 
 
 def rollup(records):
-    """Aggregate a record list into the report dict (also the --json body)."""
+    """Aggregate a record list into the report dict (also the --json body).
+    Cost-model ``kind="step"`` records carry no compile wall and are
+    excluded up front."""
+    records = [r for r in records if r.get("kind") != "step"]
     sites = {}
     by_fp = {}
     cache_hits = 0
@@ -106,7 +118,7 @@ def render(records, top=20):
         lines.append(f"  {site:<16} n={st['n']:<5} dup={st['dup']:<5} "
                      f"hit={st['hit']:<5} wall={_fmt_s(st['wall_s'])}")
 
-    ranked = sorted(records,
+    ranked = sorted((r for r in records if r.get("kind") != "step"),
                     key=lambda r: r.get("lower_s", 0) + r.get("compile_s", 0),
                     reverse=True)[:top]
     if ranked:
@@ -139,6 +151,32 @@ def render(records, top=20):
     return "\n".join(lines)
 
 
+def export_features(records, fmt="csv", out=""):
+    """Write the featurized corpus (one row per trainable sample, target +
+    meta columns first, then the sorted feature union) as CSV or JSONL."""
+    import csv
+    from mxnet_tpu.telemetry import costmodel
+    cols, rows = costmodel.export_rows(records)
+    if not rows:
+        raise SystemExit("no trainable samples in this ledger "
+                         "(no step records and no non-cache-hit compiles)")
+    fh = open(out, "w", encoding="utf-8", newline="") if out else sys.stdout
+    try:
+        if fmt == "jsonl":
+            for row in rows:
+                fh.write(json.dumps(row, sort_keys=True) + "\n")
+        else:
+            w = csv.DictWriter(fh, fieldnames=cols)
+            w.writeheader()
+            w.writerows(rows)
+    finally:
+        if out:
+            fh.close()
+    if out:
+        print(f"wrote {len(rows)} samples x {len(cols)} columns to {out}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Render a mxnet_tpu compile-ledger directory "
@@ -150,6 +188,13 @@ def main(argv=None):
                     help="rows in the ranked tables (default 20)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable rollup instead")
+    ap.add_argument("--features", action="store_true",
+                    help="export the featurized cost-model training corpus "
+                         "instead of the compile report")
+    ap.add_argument("--format", choices=("csv", "jsonl"), default="csv",
+                    help="--features output format (default csv)")
+    ap.add_argument("--out", default="",
+                    help="--features destination file (default stdout)")
     args = ap.parse_args(argv)
 
     from mxnet_tpu.telemetry import compile_ledger
@@ -160,6 +205,8 @@ def main(argv=None):
     records = compile_ledger.read_ledger(d)
     if not records:
         raise SystemExit(f"no ledger-*.jsonl records under {d}")
+    if args.features:
+        return export_features(records, args.format, args.out)
     if args.json:
         print(json.dumps(rollup(records), indent=1, sort_keys=True))
         return 0
