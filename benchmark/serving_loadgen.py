@@ -75,6 +75,25 @@ a restarted sharded replica with the same slice shape deserializes every
 bucket executable from the cache — and the sharded first-request digest
 must match bitwise across phases.
 
+r18 adds the tail-tolerance phases (``--hedge`` / ``--storm``) and
+end-to-end deadlines (``--deadline-ms``). With a deadline every sweep
+request carries the budget into the serving stack and the per-level row
+grows a ``deadline_misses`` count (requests failed fast with
+DeadlineExceeded instead of served late). ``--hedge`` runs a two-replica
+ServingPool burst under an injected ``replica_straggler`` stall and emits
+the hedging account the perf gate consumes: hedge rate, win rate, the
+wasted-duplicate-work share (``hedge_wasted_work_pct`` — bounded by the
+hedge token bucket, so the ceiling is enforced by construction) and budget
+exhaustions. ``--storm`` replays a bounded retryable ``net_drop`` storm
+through a single-host FrontDoor: the frontdoor retry budget must absorb
+every drop, and the row carries ``storm_amplification`` (fault-site
+attempts per request) and ``storm_client_error_rate`` (the ==0 gate row).
+
+  SLG_DEADLINE_MS=0       end-to-end deadline per request (0 = none)
+  SLG_HEDGE=1             run the hedged-burst phase
+  SLG_STORM=1             run the retry-storm phase
+  SLG_TAIL_REQUESTS=60    burst size for the hedge/storm phases
+
 CLI:
   --tenants N       register N endpoints of the model (t0..tN-1) on ONE
                     server and emit a per-tenant latency table per level
@@ -166,13 +185,27 @@ def _percentiles(lat_ms):
     }
 
 
-def _run_level(server, names, img, np_dtype, conc, seconds, weights):
+def _metric_total(name):
+    """Sum a metric family across its label series (0.0 if unregistered)."""
+    from mxnet_tpu import telemetry
+    fam = telemetry.REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    return float(sum(c.value for _, c in fam._series()))
+
+
+def _run_level(server, names, img, np_dtype, conc, seconds, weights,
+               deadline_ms=None):
     """Closed loop: ``conc`` clients, one in-flight request each, assigned
     to tenants proportionally to ``weights``. Returns (aggregate, per_tenant)
-    where per_tenant maps name -> {latencies, served}."""
+    where per_tenant maps name -> {latencies, served}. ``deadline_ms`` rides
+    each request end-to-end; a DeadlineExceeded is counted as a miss, not a
+    served request."""
+    from mxnet_tpu.serving import DeadlineExceeded
+
     stop_at = time.perf_counter() + seconds
     lock = threading.Lock()
-    per = {n: {"lat_ms": [], "served": 0} for n in names}
+    per = {n: {"lat_ms": [], "served": 0, "misses": 0} for n in names}
     rng = onp.random.default_rng(42)
     frames = [rng.random((3, img, img), dtype="float32").astype(np_dtype)
               for _ in range(8)]
@@ -195,7 +228,14 @@ def _run_level(server, names, img, np_dtype, conc, seconds, weights):
         i = 0
         while time.perf_counter() < stop_at:
             t0 = time.perf_counter()
-            server.predict(name, frames[(ci + i) % len(frames)], timeout=120)
+            try:
+                server.predict(name, frames[(ci + i) % len(frames)],
+                               deadline_ms=deadline_ms, timeout=120)
+            except DeadlineExceeded:
+                with lock:
+                    per[name]["misses"] += 1
+                i += 1
+                continue
             dt = (time.perf_counter() - t0) * 1e3
             with lock:
                 per[name]["lat_ms"].append(dt)
@@ -212,6 +252,9 @@ def _run_level(server, names, img, np_dtype, conc, seconds, weights):
     all_lat = [d for v in per.values() for d in v["lat_ms"]]
     agg = {"img_s": round(sum(v["served"] for v in per.values()) / wall, 1),
            "requests": len(all_lat)}
+    if deadline_ms is not None:
+        agg["deadline_ms"] = deadline_ms
+        agg["deadline_misses"] = sum(v["misses"] for v in per.values())
     agg.update(_percentiles(all_lat))
     return agg, per
 
@@ -395,6 +438,158 @@ def _run_dlrm(args):
     row.update(_queue_wait_fields(snap))
     print(json.dumps(row), flush=True)
     serving.unregister("loadgen_dlrm")
+
+
+def _tail_mlp(in_dim=8, out_dim=4, seed=0):
+    """Identically-seeded tiny MLP for the tail phases — every replica
+    serves bitwise-identical outputs, so hedging is numerics-safe."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+    net.initialize(mx.init.Xavier())
+    net(nd.array(onp.zeros((2, in_dim), "float32")))
+    return net
+
+
+def _run_hedge(args):
+    """Tail-tolerance hedge phase: a burst of deadline-carrying requests
+    over a two-replica ServingPool while an injected ``replica_straggler``
+    stalls the step boundary. Emits one ``{"tailguard": "hedge", ...}`` row
+    with the perf-gate metrics: hedge rate, win rate, the wasted-duplicate-
+    work share (bounded by the hedge token bucket) and budget
+    exhaustions."""
+    from mxnet_tpu import config, serving
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving import DeadlineExceeded, tailguard
+
+    in_dim, n = 8, args.tail_requests
+    deadline_ms = args.deadline_ms or 30000.0
+
+    def factory(rid):
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, n * 8))
+        srv.register(serving.ModelEndpoint(
+            "loadgen_hedge", _tail_mlp(in_dim), input_shapes=(in_dim,),
+            max_batch_size=4))
+        return srv
+
+    saved = config.get("MXNET_HEDGE_DELAY_MIN_MS")
+    config.set("MXNET_HEDGE_DELAY_MIN_MS", 25.0)
+    tailguard.hedge_reset()
+    ratio = float(config.get("MXNET_HEDGE_BUDGET_RATIO"))
+    before = {m: _metric_total(m) for m in
+              ("mxtpu_hedge_requests_total", "mxtpu_hedge_wins_total",
+               "mxtpu_hedge_wasted_total", "mxtpu_hedge_cancelled_total",
+               "mxtpu_hedge_budget_exhausted_total")}
+    xs = onp.random.default_rng(1).standard_normal(
+        (n, in_dim)).astype("float32")
+    pool = serving.ServingPool(factory, initial_replicas=2)
+    lat_ms, misses, errors = [], 0, []
+    t0 = time.perf_counter()
+    try:
+        with faults.inject("replica_straggler", site="serving_dispatch",
+                           every_n=5, seconds=0.2) as inj:
+            futs = [pool.submit("loadgen_hedge", xs[i],
+                                deadline_ms=deadline_ms) for i in range(n)]
+            for f in futs:
+                t1 = time.perf_counter()
+                try:
+                    f.result(timeout=120)
+                    lat_ms.append((time.perf_counter() - t1) * 1e3)
+                except DeadlineExceeded:
+                    misses += 1
+                except Exception as e:
+                    errors.append(repr(e))
+        stalls = inj.fires
+    finally:
+        config.set("MXNET_HEDGE_DELAY_MIN_MS", saved)
+        tailguard.hedge_reset()
+        pool.stop(drain=True)
+        serving.unregister("loadgen_hedge")
+    wall = time.perf_counter() - t0
+    d = {m: _metric_total(m) - before[m] for m in before}
+    hedges = d["mxtpu_hedge_requests_total"]
+    row = {"tailguard": "hedge", "requests": n, "replicas": 2,
+           "seconds": round(wall, 2), "stalls": stalls,
+           "deadline_ms": deadline_ms, "deadline_misses": misses,
+           "client_errors": len(errors),
+           "hedge_rate": round(hedges / n, 4),
+           "hedge_win_rate": round(
+               d["mxtpu_hedge_wins_total"] / max(1.0, hedges), 4),
+           "hedge_wasted_work_pct": round(
+               100.0 * d["mxtpu_hedge_wasted_total"] / n, 3),
+           "hedge_cancelled": d["mxtpu_hedge_cancelled_total"],
+           "hedge_budget_exhausted": d["mxtpu_hedge_budget_exhausted_total"],
+           "hedge_budget_ratio": ratio}
+    row.update(_percentiles(lat_ms))
+    print(json.dumps(row), flush=True)
+
+
+def _run_storm(args):
+    """Tail-tolerance storm phase: a bounded retryable ``net_drop`` storm
+    at a single-host FrontDoor. The frontdoor retry budget must absorb
+    every drop — ``storm_client_error_rate`` is the ==0 perf-gate row —
+    and ``storm_amplification`` (fault-site attempts per request) shows the
+    budget holding re-send traffic near 1x."""
+    from mxnet_tpu import serving
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving.fabric import FrontDoor
+    from mxnet_tpu.serving.tailguard import RETRY_BUDGETS
+
+    in_dim, n = 8, args.tail_requests
+
+    def factory(name):
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, n * 8))
+        srv.register(serving.ModelEndpoint(
+            "loadgen_storm", _tail_mlp(in_dim), input_shapes=(in_dim,),
+            max_batch_size=4))
+        srv.start()
+        return srv
+
+    RETRY_BUDGETS.reset()       # the production-default budget knobs
+    ex_before = _metric_total("mxtpu_retry_budget_exhausted_total")
+    xs = onp.random.default_rng(2).standard_normal(
+        (n, in_dim)).astype("float32")
+    fd = FrontDoor([f"storm_{os.getpid()}"], factory, spawn_agents=False,
+                   supervise=False)
+    lat_ms, errors = [], []
+    t0 = time.perf_counter()
+    try:
+        # the drop volume stays under the budget floor, so absorption —
+        # not shed — is the contract being measured
+        with faults.inject("net_drop", site="frontdoor", p=0.6,
+                           times=max(1, n // 5), seed=3) as inj:
+            for i in range(n):
+                t1 = time.perf_counter()
+                try:
+                    fd.submit("loadgen_storm", xs[i],
+                              deadline_ms=args.deadline_ms) \
+                        .result(timeout=120)
+                    lat_ms.append((time.perf_counter() - t1) * 1e3)
+                except Exception as e:
+                    errors.append(repr(e))
+            attempts, drops = inj.calls, inj.fires
+    finally:
+        fd.stop(drain=True)
+        serving.unregister("loadgen_storm")
+        RETRY_BUDGETS.reset()
+    wall = time.perf_counter() - t0
+    row = {"tailguard": "storm", "requests": n, "seconds": round(wall, 2),
+           "drops_absorbed": drops,
+           "storm_amplification": round(attempts / float(n), 3),
+           "storm_client_error_rate": round(len(errors) / float(n), 4),
+           "client_errors": len(errors),
+           "retry_budget_exhausted": _metric_total(
+               "mxtpu_retry_budget_exhausted_total") - ex_before}
+    row.update(_percentiles(lat_ms))
+    print(json.dumps(row), flush=True)
 
 
 def _run_restart_child(args, phase):
@@ -628,6 +823,19 @@ def _parse_args():
     p.add_argument("--dlrm-seconds", type=float,
                    default=float(env("SLG_DLRM_SECONDS",
                                      env("SLG_SECONDS", 5))))
+    p.add_argument("--deadline-ms", type=float,
+                   default=float(env("SLG_DEADLINE_MS", 0)) or None,
+                   help="end-to-end deadline per request; sweep rows gain "
+                        "deadline_misses (env SLG_DEADLINE_MS, 0 = none)")
+    p.add_argument("--hedge", action="store_true",
+                   default=env("SLG_HEDGE", "") not in ("", "0"),
+                   help="run the hedged-burst tail phase (env SLG_HEDGE=1)")
+    p.add_argument("--storm", action="store_true",
+                   default=env("SLG_STORM", "") not in ("", "0"),
+                   help="run the retry-storm tail phase (env SLG_STORM=1)")
+    p.add_argument("--tail-requests", type=int,
+                   default=int(env("SLG_TAIL_REQUESTS", 60)),
+                   help="burst size for the hedge/storm phases")
     p.add_argument("--restart", action="store_true",
                    help="cold/warm restart-to-first-request benchmark "
                         "instead of the load sweep")
@@ -693,7 +901,8 @@ def _run_sweep(args):
         try:
             for conc in conc_levels:
                 agg, per = _run_level(server, names, img, np_dtype, conc,
-                                      seconds, weights)
+                                      seconds, weights,
+                                      deadline_ms=args.deadline_ms)
                 snaps = serving.stats()
                 agg.update({
                     "dtype": dtype, "conc": conc, "tenants": tenants,
@@ -743,6 +952,12 @@ def _run_sweep(args):
 
     if args.dlrm:
         _run_dlrm(args)
+
+    if args.hedge:
+        _run_hedge(args)
+
+    if args.storm:
+        _run_storm(args)
 
     # one whole-process telemetry snapshot: serving latency histograms,
     # executable-cache hit/miss/compile-seconds, queue depth / occupancy,

@@ -30,6 +30,13 @@ named boundaries —
                           RESOURCE_EXHAUSTED, so the retry policy's OOM
                           classifier fires a flight bundle exactly as a
                           real exchange-buffer OOM would)
+    ``frontdoor``         FrontDoor.submit, before routing (kinds
+                          ``net_delay`` — a slow network hop, sleeps — and
+                          ``net_drop`` — a retryable UNAVAILABLE simulating
+                          a partition dropping the request; the front
+                          door's retry budget absorbs it)
+    ``pool_submit``       ServingPool.submit, before replica dispatch
+                          (kinds ``net_delay``/``replica_straggler``)
 
 The ``numerics``/``sdc`` kinds (``nan_grad``, ``loss_spike``, ``bad_batch``,
 ``sdc``) are never raised to user code: the NumericsGuard *consumes* them and
@@ -71,7 +78,7 @@ __all__ = ["FaultInjected", "SimulatedCrash", "PreemptionNotice",
 #: boundaries where production code calls :func:`check`
 SITES = ("train_step", "compile", "serving_dispatch", "serving_prep",
          "checkpoint_write", "preemption", "numerics", "sdc", "decode",
-         "exec_cache", "emb_dispatch")
+         "exec_cache", "emb_dispatch", "frontdoor", "pool_submit")
 
 _INJECTED = _telemetry.counter(
     "mxtpu_faults_injected_total",
@@ -170,11 +177,23 @@ _KINDS = {
                      "RESOURCE_EXHAUSTED: embedding exchange buffer "
                      "allocation failed mid-dispatch "
                      "(injected {kind} #{count} at {site})"),
+    "net_delay": (("frontdoor", "pool_submit"), True, ""),
+    "net_drop": (("frontdoor",), True,
+                 "UNAVAILABLE: network partition dropped the request at "
+                 "the front door (injected {kind} #{count} at {site})"),
+    "replica_straggler": (("serving_dispatch", "pool_submit", "decode"),
+                          True, ""),
 }
 
 #: kinds that raise a dedicated exception class instead of FaultInjected
 _KIND_CLS = {"crash": SimulatedCrash, "preempt": PreemptionNotice,
              "worker_kill": WorkerKilled, "decode_stall": WorkerKilled}
+
+#: kinds that stall (sleep ``seconds``) instead of raising — "hang" is the
+#: generic device stall; "net_delay" a slow network hop at the front door /
+#: pool boundary; "replica_straggler" one replica's dispatch path running
+#: slow every step (the tail the hedging policy exists to cut)
+_SLEEP_KINDS = ("hang", "net_delay", "replica_straggler")
 
 _LOCK = threading.Lock()
 _ACTIVE: list = []          # the hot-path gate: empty list == harness off
@@ -223,7 +242,7 @@ class _Injection:
             self.fires += 1
             count = self.fires
         _INJECTED.labels(self.kind, site).inc()
-        if self.kind == "hang":
+        if self.kind in _SLEEP_KINDS:
             time.sleep(self.seconds)
             return None
         if self._exc_factory is not None:

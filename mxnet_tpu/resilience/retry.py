@@ -84,6 +84,25 @@ def _now_us() -> int:
     return time.perf_counter_ns() // 1000
 
 
+def _deadline_exceeded_cls():
+    """The serving DeadlineExceeded class, lazily (resilience must stay
+    importable without the serving package; the import cycle runs the other
+    way — serving imports RetryPolicy at module load)."""
+    from ..serving.errors import DeadlineExceeded
+    return DeadlineExceeded
+
+
+def _retry_budget_allowed(tier: str) -> bool:
+    """Consult the tailguard per-tier retry budget, lazily (same cycle
+    discipline as :func:`_deadline_exceeded_cls`). Fails open: a broken
+    budget layer must never turn retries off."""
+    try:
+        from ..serving import tailguard
+        return tailguard.retry_allowed(tier)
+    except Exception:
+        return True
+
+
 class RetryPolicy:
     """Configurable retry loop: ``run(fn)`` calls ``fn`` up to
     ``max_attempts`` times, sleeping ``base_ms * multiplier**attempt``
@@ -132,13 +151,23 @@ class RetryPolicy:
 
     def run(self, fn: Callable, site: str = "generic",
             deadline_us: Optional[int] = None,
-            on_retry: Optional[Callable] = None):
+            on_retry: Optional[Callable] = None,
+            budget_tier: Optional[str] = None):
         """Call ``fn()`` under this policy.
 
         ``deadline_us`` (absolute, ``time.perf_counter_ns()//1000`` clock):
-        never sleep past it — when the backoff cannot fit, the last error
-        propagates instead (the serving path hands in the batch's earliest
-        request deadline, so retries respect what clients asked for).
+        each backoff is CLAMPED to the remaining budget — a retry that still
+        fits sleeps only what the deadline can afford — and when no budget
+        remains the last error is raised chained under the serving
+        ``DeadlineExceeded`` taxonomy (fail fast, never oversleep; the
+        serving path hands in the batch's earliest request deadline, so
+        retries respect what clients asked for).
+
+        ``budget_tier`` names a tailguard retry-budget bucket ("frontdoor" /
+        "execute" / "decode"); when set, every retry must win a token from
+        that tier's bucket — a dry bucket propagates the last error instead
+        (retry storms convert to bounded shed). None (the default) keeps the
+        unbudgeted legacy behavior.
 
         ``on_retry(exc, attempt, delay_s)`` runs before each sleep; raising
         from it aborts the retry (the train step uses this to refuse to
@@ -161,8 +190,16 @@ class RetryPolicy:
                 if not self._classify(e) or attempt + 1 >= self.max_attempts:
                     raise
                 delay_s = self.delay_ms(attempt) / 1e3
-                if deadline_us is not None and \
-                        _now_us() + delay_s * 1e6 > deadline_us:
+                if deadline_us is not None:
+                    remaining_s = (deadline_us - _now_us()) / 1e6
+                    if remaining_s <= 0:
+                        raise _deadline_exceeded_cls()(
+                            f"retry at {site!r} abandoned: deadline spent "
+                            f"after attempt {attempt + 1} "
+                            f"({type(e).__name__}: {str(e)[:120]})") from e
+                    delay_s = min(delay_s, remaining_s)
+                if budget_tier is not None and \
+                        not _retry_budget_allowed(budget_tier):
                     raise
                 if on_retry is not None:
                     on_retry(e, attempt, delay_s)

@@ -91,13 +91,25 @@ ledger and warmup contracts), ``ServingPool.submit`` weights placement by
 replica capacity, and ``FrontDoor`` adds consistent-hash tenant→host
 routing with bounded rebalancing plus cross-host failover that replays a
 dead host's in-flight work on survivors — zero client-visible errors.
+
+r18 adds the tail-tolerance defense layer (``serving.tailguard``): one
+end-to-end ``Deadline`` minted at ingress rides every hop and fails fast
+(``DeadlineExceeded``, which ``RequestTimeoutError`` now derives from);
+``ServingPool.submit`` hedges a late request onto the second-least-loaded
+replica under a token-bucket hedge budget (first response wins, loser
+cancelled at batch assembly, results bitwise-equal to unhedged); per-tier
+retry budgets (frontdoor / execute / decode) convert retry storms into
+bounded shed; and a ``BrownoutController`` ladder degrades under sustained
+SLO burn in tenant-criticality order (``register(..., tier="bulk")`` sheds
+before silver before gold; gold is never refused).
 """
 from __future__ import annotations
 
 from .autoscaler import Autoscaler, ServingPool
 from .endpoint import ModelEndpoint, get_endpoint, list_endpoints, unregister
-from .errors import (HotSwapError, KVPoolExhausted, RequestTimeoutError,
-                     ServerClosedError, ServerOverloadError, ServingError)
+from .errors import (DeadlineExceeded, HotSwapError, KVPoolExhausted,
+                     RequestTimeoutError, ServerClosedError,
+                     ServerOverloadError, ServingError)
 from .router import Router, StepCostEWMA, Tenant
 from .server import InferenceServer
 from .supervisor import PoolSupervisor
@@ -108,15 +120,22 @@ from .generate import (DecodeEndpoint, DecodeScheduler, PagedKVPool,
 from . import fabric
 from .fabric import (FrontDoor, ShardedDecodeEndpoint, ShardedEndpoint,
                      SliceSpec, plan_slices)
+from . import tailguard
+from .tailguard import (BROWNOUT, BrownoutController, Deadline, HEDGER,
+                        HedgePolicy, RETRY_BUDGETS, RetryBudgets, TIER_RANKS,
+                        TokenBucket)
 
 __all__ = ["ModelEndpoint", "InferenceServer", "PoolSupervisor", "stats",
            "get_endpoint", "list_endpoints", "unregister", "ServingError",
            "ServerOverloadError", "RequestTimeoutError", "ServerClosedError",
-           "HotSwapError", "KVPoolExhausted", "Router", "StepCostEWMA",
-           "Tenant", "bucketing", "generate", "DecodeEndpoint",
-           "DecodeScheduler", "PagedKVPool", "TokenStream", "ServingPool",
-           "Autoscaler", "fabric", "FrontDoor", "ShardedEndpoint",
-           "ShardedDecodeEndpoint", "SliceSpec", "plan_slices"]
+           "HotSwapError", "KVPoolExhausted", "DeadlineExceeded", "Router",
+           "StepCostEWMA", "Tenant", "bucketing", "generate",
+           "DecodeEndpoint", "DecodeScheduler", "PagedKVPool", "TokenStream",
+           "ServingPool", "Autoscaler", "fabric", "FrontDoor",
+           "ShardedEndpoint", "ShardedDecodeEndpoint", "SliceSpec",
+           "plan_slices", "tailguard", "Deadline", "TokenBucket",
+           "RetryBudgets", "RETRY_BUDGETS", "HedgePolicy", "HEDGER",
+           "BrownoutController", "BROWNOUT", "TIER_RANKS"]
 
 
 def stats():

@@ -40,18 +40,26 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional
+from concurrent.futures import CancelledError, Future
+from typing import Callable, List, Optional, Tuple
 
 from ..base import MXNetError
 from .. import config as _config
 from .. import telemetry as _telemetry
+from ..resilience import faults as _faults
 from ..telemetry import debug_server as _debug
 from ..telemetry import flight as _flight
 from ..telemetry.slo import MONITOR as _SLO_MONITOR
+from . import tailguard as _tailguard
+from .batcher import fail as _fail_fut, resolve as _resolve_fut
 from .errors import ServerClosedError, ServerOverloadError
 from .server import InferenceServer
 
 __all__ = ["ServingPool", "Autoscaler"]
+
+
+def _now_us() -> int:
+    return time.perf_counter_ns() // 1000
 
 _REPLICAS_G = _telemetry.gauge(
     "mxtpu_autoscale_replicas",
@@ -146,17 +154,47 @@ class ServingPool:
         with self._lock:
             return list(self._replicas)
 
-    def submit(self, name: str, inputs, deadline_ms: Optional[float] = None):
+    def submit(self, name: str, inputs, deadline_ms: Optional[float] = None,
+               deadline=None):
         """Route one request to the least-loaded replica in rotation,
         where load is queued rows divided by replica capacity — a 4-chip
         mesh-sharded replica keeps attracting traffic until it holds ~4x a
         single chip's queue, so heterogeneous pools utilize every chip.
         A replica that sheds (overload / mid-cutover close) falls through
-        to the next-least-loaded one before the error reaches the client."""
+        to the next-least-loaded one before the error reaches the client.
+
+        With hedging enabled (``MXNET_HEDGE_ENABLE`` + a >=2 replica pool),
+        a request still pending after the adaptive hedge delay is duplicated
+        onto the next-least-loaded replica; the first response settles the
+        returned Future and the loser is cancelled (dropped at batch
+        assembly, never mid-step). ``deadline`` is the end-to-end
+        :class:`~.tailguard.Deadline` minted at ingress; it rides into the
+        replica's queue unchanged."""
+        _faults.check("pool_submit")
+        if deadline is not None:
+            deadline.check("pool_submit")
         replicas = self._rotation()
         if not replicas:
             raise ServerClosedError("serving pool has no replicas")
         ranked = sorted(replicas, key=self._load_of)
+        _tailguard.hedge_deposit()
+        born_us = _now_us()
+        primary, primary_rep = self._submit_ranked(
+            name, inputs, deadline_ms, deadline, ranked)
+        hedge_pool = [r for r in ranked if r is not primary_rep]
+        if not (_tailguard.HEDGER.enabled() and hedge_pool):
+            primary.add_done_callback(
+                lambda f: _tailguard.HEDGER.observe_latency(
+                    _now_us() - born_us))
+            return primary
+        return self._hedged(name, inputs, deadline_ms, deadline,
+                            hedge_pool, primary, born_us)
+
+    def _submit_ranked(self, name: str, inputs,
+                       deadline_ms: Optional[float], deadline,
+                       ranked: List[_Replica]) -> Tuple[Future, _Replica]:
+        """The fallthrough core: try replicas in load order, returning the
+        admitted Future and the replica that took it."""
         last_exc: Optional[Exception] = None
         for rep in ranked:
             try:
@@ -165,11 +203,110 @@ class ServingPool:
                 # inside submit() — the replica hop is traceable end to end
                 with _telemetry.span("pool.submit", replica=rep.rid,
                                      endpoint=name):
-                    return rep.server.submit(name, inputs,
-                                             deadline_ms=deadline_ms)
+                    return rep.server.submit(
+                        name, inputs, deadline_ms=deadline_ms,
+                        deadline=deadline), rep
             except (ServerOverloadError, ServerClosedError) as e:
                 last_exc = e
         raise last_exc
+
+    def _predicted_step_us(self, name: str) -> float:
+        """Cost-model / EWMA predicted device time of this endpoint's next
+        batch (the Router's scheduling estimate) — the hedge delay's prior
+        for workloads the latency ring has not warmed yet. 0.0 when
+        unknowable."""
+        try:
+            replicas = self._rotation()
+            if not replicas:
+                return 0.0
+            srv = replicas[0].server
+            with srv._cond:
+                tenant = srv._router.find(name)
+                if tenant is None:
+                    return 0.0
+                return float(srv._router.est_step_us(tenant))
+        except Exception:
+            return 0.0
+
+    def _hedged(self, name: str, inputs, deadline_ms: Optional[float],
+                deadline, hedge_pool: List[_Replica], primary: Future,
+                born_us: int) -> Future:
+        """Wrap an admitted primary with the hedge race: after the adaptive
+        delay a budgeted duplicate goes to the next replica; the first
+        *successful* arm settles the client Future (a failed arm defers to
+        the other while it is still pending), the loser is cancelled."""
+        out: Future = Future()
+        lock = threading.Lock()
+        state = {"done": False, "hedge": None, "timer": None}
+
+        def settle(f: Future, is_hedge: bool):
+            try:
+                err = f.exception()
+            except CancelledError:
+                return                    # the cancelled loser reporting in
+            with lock:
+                if state["done"]:
+                    return
+                other = primary if is_hedge else state["hedge"]
+                if err is not None and other is not None \
+                        and not other.done():
+                    return                # lost by failing; other arm decides
+                state["done"] = True
+                timer = state["timer"]
+                loser = other
+            if timer is not None:
+                timer.cancel()
+            _tailguard.HEDGER.observe_latency(_now_us() - born_us)
+            if is_hedge and err is None:
+                _tailguard.hedge_won()
+            if loser is not None:
+                if loser.cancel():
+                    _tailguard.hedge_cancelled()
+                else:
+                    _tailguard.hedge_wasted()
+            if err is not None:
+                _fail_fut(out, err)
+            else:
+                _resolve_fut(out, f.result())
+
+        def launch_hedge():
+            with lock:
+                if state["done"]:
+                    return
+            if deadline is not None and deadline.expired():
+                return                    # no budget left to speculate into
+            if not _tailguard.hedge_allowed():
+                return
+            try:
+                hf, _rep = self._submit_ranked(
+                    name, inputs, deadline_ms, deadline, hedge_pool)
+            except Exception:
+                return                    # no replica would take the hedge
+            _tailguard.hedge_launched()
+            lost_race = False
+            with lock:
+                if state["done"]:
+                    lost_race = True
+                else:
+                    state["hedge"] = hf
+            if lost_race:                 # primary settled while we admitted
+                if hf.cancel():
+                    _tailguard.hedge_cancelled()
+                else:
+                    _tailguard.hedge_wasted()
+                return
+            hf.add_done_callback(lambda f: settle(f, True))
+
+        delay_s = _tailguard.HEDGER.delay_s(self._predicted_step_us(name))
+        timer = threading.Timer(delay_s, launch_hedge)  # mxlint: disable=THR400
+        timer.daemon = True
+        state["timer"] = timer
+        primary.add_done_callback(lambda f: settle(f, False))
+        with lock:
+            fast = state["done"]
+        if not fast:                      # don't spawn timers for requests
+            timer.start()                 # that already finished
+        return out
 
     def predict(self, name: str, inputs, deadline_ms: Optional[float] = None,
                 timeout: Optional[float] = None):
@@ -404,6 +541,9 @@ class Autoscaler:
         action report ({"action", "rid", **signals}) or None."""
         if now is None:
             now = self._now()
+        # the brownout ladder rides this poll loop for free: same cadence,
+        # same burn evidence, no thread of its own
+        _tailguard.BROWNOUT.tick(now)
         sig = self.signals()
         verdict = self._decide(sig, now)
         if verdict is None:

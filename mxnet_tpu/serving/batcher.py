@@ -29,6 +29,28 @@ from .errors import RequestTimeoutError
 __all__ = ["Request", "EndpointQueue", "resolve", "fail"]
 
 
+def _deadline_expired(site: str):
+    """Bump mxtpu_deadline_exceeded_total{site} lazily (tailguard registers
+    knobs at import; the batcher must stay import-light)."""
+    try:
+        from .tailguard import deadline_expired
+        deadline_expired(site)
+    except Exception:
+        pass
+
+
+def brownout_timeout_boost() -> float:
+    """The brownout ladder's batch-timeout multiplier (1.0 at level 0):
+    under degradation the assembly window widens — fuller batches, better
+    goodput per device step — before any request is refused. Lazy import
+    for the same reason as :func:`_deadline_expired`."""
+    try:
+        from .tailguard import BROWNOUT
+        return BROWNOUT.timeout_boost()
+    except Exception:
+        return 1.0
+
+
 def resolve(fut: Future, value):
     """set_result that tolerates the future already being settled (client
     cancelled it, or a racing stop() failed it first). ONLY the Future's own
@@ -60,17 +82,24 @@ class Request:
     step — one trace id follows the request across the queue hop."""
 
     __slots__ = ("inputs", "rows", "squeeze", "enqueue_us", "deadline_us",
-                 "future", "trace_id")
+                 "deadline", "future", "trace_id")
 
     def __init__(self, inputs: Tuple[onp.ndarray, ...], rows: int,
-                 squeeze: bool, deadline_ms: Optional[float] = None):
+                 squeeze: bool, deadline_ms: Optional[float] = None,
+                 deadline=None):
         from .. import telemetry
         self.inputs = inputs
         self.rows = rows
         self.squeeze = squeeze            # single example: drop the batch axis
         self.enqueue_us = _now_us()
-        self.deadline_us = (self.enqueue_us + int(deadline_ms * 1000)
-                            if deadline_ms is not None else None)
+        # a propagated tailguard.Deadline wins over a tier-local deadline_ms:
+        # the budget was minted once at ingress and is never re-derived here
+        self.deadline = deadline
+        if deadline is not None:
+            self.deadline_us: Optional[int] = int(deadline.deadline_us)
+        else:
+            self.deadline_us = (self.enqueue_us + int(deadline_ms * 1000)
+                                if deadline_ms is not None else None)
         self.future: Future = Future()
         self.trace_id = (telemetry.current_trace_id()
                          or telemetry.new_trace_id())
@@ -105,19 +134,25 @@ class EndpointQueue:
         self.endpoint.stats.set_queue_depth(self.pending_rows)
         return True
 
+    def effective_timeout_us(self) -> int:
+        """The batch window this queue assembles under right now: the
+        configured timeout, widened by the brownout ladder's boost."""
+        return int(self.batch_timeout_us * brownout_timeout_boost())
+
     # -- readiness (caller holds the server lock) ---------------------------
     def ready(self, now_us: int, flush: bool = False) -> bool:
         if not self._pending:
             return False
         if flush or self.pending_rows >= self.endpoint.max_batch_size:
             return True
-        return now_us - self._pending[0].enqueue_us >= self.batch_timeout_us
+        return now_us - self._pending[0].enqueue_us >= \
+            self.effective_timeout_us()
 
     def next_wakeup_us(self) -> Optional[int]:
         """Absolute time at which the head request hits the batch deadline."""
         if not self._pending:
             return None
-        return self._pending[0].enqueue_us + self.batch_timeout_us
+        return self._pending[0].enqueue_us + self.effective_timeout_us()
 
     def head_enqueue_us(self) -> int:
         """Enqueue time of the head request (queue must be non-empty)."""
@@ -137,10 +172,18 @@ class EndpointQueue:
         rows = 0
         while self._pending:
             head = self._pending[0]
+            if head.future.cancelled():
+                # a settled future nobody is waiting on (hedge loser, or a
+                # client that cancelled): drop before it occupies device rows
+                self._pending.popleft()
+                self.pending_rows -= head.rows
+                ep.stats.bump("cancelled")
+                continue
             if head.expired(now_us):
                 self._pending.popleft()
                 self.pending_rows -= head.rows
                 ep.stats.bump("deadline_drops")
+                _deadline_expired("queue")
                 fail(head.future, RequestTimeoutError(
                     f"deadline expired after "
                     f"{(now_us - head.enqueue_us) / 1e3:.1f} ms in queue"))

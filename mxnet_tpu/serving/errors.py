@@ -4,13 +4,22 @@ Admission control needs errors a client can branch on: overload is retryable
 with backoff, a missed deadline is not (the work was dropped on purpose), and
 a closed server means the process is going away. All derive from MXNetError
 so existing blanket handlers keep working.
+
+Deadline taxonomy: every "the latency budget ran out" failure — queue expiry,
+a backoff that cannot fit, a decode token past the budget — derives from
+:class:`DeadlineExceeded`, so one ``except DeadlineExceeded`` catches the
+whole family while ``RequestTimeoutError`` keeps its historical meaning
+(expired while queued). Clients can therefore distinguish "deadline elapsed"
+(not worth retrying: the budget is gone) from "server closed" (retryable on
+another replica/host).
 """
 from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["ServingError", "ServerOverloadError", "RequestTimeoutError",
-           "ServerClosedError", "HotSwapError", "KVPoolExhausted"]
+__all__ = ["ServingError", "ServerOverloadError", "DeadlineExceeded",
+           "RequestTimeoutError", "ServerClosedError", "HotSwapError",
+           "KVPoolExhausted"]
 
 
 class ServingError(MXNetError):
@@ -22,7 +31,14 @@ class ServerOverloadError(ServingError):
     admission (never enqueued). Retryable: back off and resubmit."""
 
 
-class RequestTimeoutError(ServingError):
+class DeadlineExceeded(ServingError):
+    """The request's end-to-end deadline budget ran out at some tier —
+    ingress, queue, batch assembly, a retry backoff that could not fit, or
+    decode mid-generation. NOT retryable: the client's budget is spent;
+    retrying cannot make the answer arrive in time."""
+
+
+class RequestTimeoutError(DeadlineExceeded):
     """The request's deadline expired while it waited in the queue; it was
     dropped before reaching the device (no compute was wasted on it)."""
 

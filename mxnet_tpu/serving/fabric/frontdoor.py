@@ -44,8 +44,11 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ... import config as _config
 from ... import telemetry as _telemetry
 from ...base import MXNetError
+from ...resilience import faults as _faults
+from ...resilience.faults import FaultInjected
 from ...telemetry import flight as _flight
 from ...telemetry.fleet import FleetCollector
+from .. import tailguard as _tailguard
 from ..errors import ServerClosedError, ServerOverloadError
 from ..supervisor import PoolSupervisor
 
@@ -250,27 +253,56 @@ class FrontDoor:
         """Route and enqueue; the returned future hides host death. When
         the serving host dies before this request resolves, the request is
         resubmitted on the rerouted survivor behind the same future —
-        callers never see the dead host's ServerClosedError."""
+        callers never see the dead host's ServerClosedError.
+
+        ``deadline_ms`` mints the request's end-to-end
+        :class:`~..tailguard.Deadline` HERE, at ingress — the one budget
+        every downstream tier (routing, pool, queue, batch, retry backoff)
+        decrements; no tier re-derives its own."""
+        deadline = _tailguard.Deadline(deadline_ms) \
+            if deadline_ms is not None else None
         out: Future = Future()
         self._submit_once(tenant, inputs, deadline_ms, out,
-                          tries=len(self._hosts))
+                          tries=len(self._hosts), deadline=deadline)
         return out
 
     def _submit_once(self, tenant, inputs, deadline_ms, out: Future,
-                     tries: int):
+                     tries: int, deadline=None):
+        if deadline is not None:
+            deadline.check("ingress")
+        # the network hop between client and serving plane: net_delay
+        # sleeps in place; net_drop (a partition) raises and is absorbed by
+        # re-sending under the frontdoor retry budget — a drop storm
+        # converts into bounded shed the moment the bucket runs dry
+        while True:
+            try:
+                _faults.check("frontdoor")
+                break
+            except FaultInjected as e:
+                if not e.retryable or not _tailguard.retry_allowed(
+                        "frontdoor"):
+                    raise
+                if deadline is not None:
+                    deadline.check("ingress")
         host = self.route(tenant)
         h = self._hosts[host]
         _REQS_C.labels(host).inc()
+        # one routed request = one unit of real work funding the frontdoor
+        # tier's retry budget
+        _tailguard.retry_deposit("frontdoor")
         try:
-            inner = h.server.submit(tenant, inputs, deadline_ms=deadline_ms)
+            inner = h.server.submit(tenant, inputs, deadline_ms=deadline_ms,
+                                    deadline=deadline)
         except (ServerClosedError, ServerOverloadError):
             # overload on a LIVE host is the caller's backpressure signal;
-            # only a dead host's rejection reroutes (race with kill_host)
-            if h.alive or tries <= 1 or not self.alive_hosts():
+            # only a dead host's rejection reroutes (race with kill_host),
+            # and the replay spends a frontdoor retry-budget token
+            if h.alive or tries <= 1 or not self.alive_hosts() \
+                    or not _tailguard.retry_allowed("frontdoor"):
                 raise
             _RESUBMITS_C.inc()
             return self._submit_once(tenant, inputs, deadline_ms, out,
-                                     tries - 1)
+                                     tries - 1, deadline=deadline)
 
         def _done(f: Future):
             exc = f.exception()
@@ -278,13 +310,16 @@ class FrontDoor:
                 out.set_result(f.result())
                 return
             # ServerClosedError from a host marked down == the host died
-            # with this request in flight: replay it on a survivor
+            # with this request in flight: replay it on a survivor (same
+            # propagated deadline — the budget keeps burning), under the
+            # frontdoor retry budget
             if isinstance(exc, ServerClosedError) and not h.alive \
-                    and tries > 1 and self.alive_hosts():
+                    and tries > 1 and self.alive_hosts() \
+                    and _tailguard.retry_allowed("frontdoor"):
                 _RESUBMITS_C.inc()
                 try:
                     self._submit_once(tenant, inputs, deadline_ms, out,
-                                      tries - 1)
+                                      tries - 1, deadline=deadline)
                 except Exception as e:          # survivors full/closed
                     out.set_exception(e)
                 return

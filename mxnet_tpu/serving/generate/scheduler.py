@@ -43,7 +43,8 @@ from ...base import MXNetError
 from ...resilience import faults as _faults
 from ...resilience.faults import FaultInjected
 from ...telemetry import flight as _flight
-from ..errors import KVPoolExhausted, ServerClosedError
+from .. import tailguard as _tailguard
+from ..errors import DeadlineExceeded, KVPoolExhausted, ServerClosedError
 from .streams import TokenStream
 
 __all__ = ["DecodeScheduler"]
@@ -70,10 +71,11 @@ class _Tenant:
 class _Seq:
     __slots__ = ("sid", "tenant", "prompt", "max_new", "eos_id", "stream",
                  "state", "emitted", "pos", "prefilled", "enqueue_us",
-                 "last_token_us")
+                 "last_token_us", "deadline")
 
     def __init__(self, sid: int, tenant: _Tenant, prompt: Sequence[int],
-                 max_new: int, eos_id: Optional[int], stream: TokenStream):
+                 max_new: int, eos_id: Optional[int], stream: TokenStream,
+                 deadline=None):
         self.sid = sid
         self.tenant = tenant
         self.prompt = list(prompt)
@@ -86,6 +88,7 @@ class _Seq:
         self.prefilled = False
         self.enqueue_us = _now_us()
         self.last_token_us = 0
+        self.deadline = deadline         # propagated tailguard.Deadline
 
 
 class DecodeScheduler:
@@ -203,15 +206,26 @@ class DecodeScheduler:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: Optional[int] = None,
                tenant: str = "default", eos_id: Optional[int] = None,
-               on_token=None) -> TokenStream:
+               on_token=None, deadline=None) -> TokenStream:
         """Queue one generation; returns its :class:`TokenStream`.
 
         The prompt plus generation budget must fit the endpoint's
         ``max_seq_len`` — the whole KV budget is reserved at admission so a
         running sequence can never hit pool exhaustion mid-generation.
+
+        ``deadline`` (a propagated :class:`~..tailguard.Deadline`) bounds
+        the whole generation: an expired budget refuses admission, and the
+        decode loop retires the sequence mid-generation the moment the
+        budget runs out (site ``decode_token``). Under brownout (level >= 1)
+        ``max_new_tokens`` is clamped to MXNET_BROWNOUT_MAX_NEW_TOKENS —
+        generations shorten before anyone is refused.
         """
+        if deadline is not None:
+            deadline.check("ingress")
         if max_new_tokens is None:
             max_new_tokens = int(_config.get("MXNET_DECODE_MAX_TOKENS"))
+        max_new_tokens = _tailguard.BROWNOUT.clamp_max_new_tokens(
+            max_new_tokens)
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise MXNetError("prompt must contain at least one token")
@@ -236,7 +250,8 @@ class DecodeScheduler:
             sid = next(self._sids)
             stream = TokenStream(sid, self._stream_buffer,
                                  on_token=on_token, resume_cb=self._resume)
-            seq = _Seq(sid, ten, prompt, int(max_new_tokens), eos_id, stream)
+            seq = _Seq(sid, ten, prompt, int(max_new_tokens), eos_id, stream,
+                       deadline=deadline)
             self._waiting.append(seq)
             self._by_sid[sid] = seq
             self._stats.seq_event("submitted")
@@ -291,6 +306,16 @@ class DecodeScheduler:
             with self._cond:
                 if self._epoch != epoch:
                     return
+                # the per-token deadline hop: a sequence whose end-to-end
+                # budget ran out mid-generation is retired BEFORE it costs
+                # another device step
+                for s in list(self._active):
+                    if s.state == _S_RUNNING and s.deadline is not None \
+                            and s.deadline.expired():
+                        _tailguard.deadline_expired("decode_token")
+                        self._fail_seq_locked(s, DeadlineExceeded(
+                            f"sequence {s.sid} overran its deadline after "
+                            f"{len(s.emitted)} of {s.max_new} tokens"))
                 rows = [s for s in self._active if s.state == _S_RUNNING]
                 if not rows:
                     if not admits:
@@ -311,6 +336,9 @@ class DecodeScheduler:
                     for s, _, _, _ in batch:
                         self._fail_seq_locked(s, e)
                 continue
+            # one decode step = one unit of real work funding the decode
+            # tier's retry budget (failover requeues spend from it)
+            _tailguard.retry_deposit("decode")
             with self._cond:
                 if self._epoch != epoch:
                     return              # died-and-replaced mid-step: the
@@ -426,16 +454,31 @@ class DecodeScheduler:
             t = self._thread
             if t is None or t.is_alive():
                 return
-            requeued = [s for s in self._active if s.state == _S_RUNNING]
-            for seq in requeued:
+            candidates = [s for s in self._active if s.state == _S_RUNNING]
+            requeued, shed = [], 0
+            for seq in candidates:
                 self._active.remove(seq)
+                # a failover requeue IS a retry of this sequence's remaining
+                # tokens: it must win a decode-tier budget token, so a
+                # crash-looping worker converts into bounded shed instead of
+                # requeueing the same sequences forever
+                if not _tailguard.retry_allowed("decode"):
+                    self._retire_locked(seq, _S_FAILED, "failed",
+                                        error=ServerClosedError(
+                                            f"sequence {seq.sid} shed: decode "
+                                            "retry budget exhausted during "
+                                            "worker failover"))
+                    shed += 1
+                    continue
                 seq.state = _S_WAITING
                 self._waiting.appendleft(seq)
                 self._stats.seq_event("requeued")
+                requeued.append(seq)
             report = {
                 "endpoint": self.engine.name,
                 "reason": "worker_dead",
                 "requeued": len(requeued),
+                "shed": shed,
                 "paused_kept": len(self._active),
                 "epoch": self._epoch,
             }

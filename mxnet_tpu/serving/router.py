@@ -193,22 +193,24 @@ class StepCostEWMA:
 class Tenant:
     """One endpoint's seat at the scheduler: its queue, its circuit breaker
     (per-tenant shedding: this tenant's overload degrades this tenant's
-    admission, not the whole server), and its optional SLO (``slo_us`` is
+    admission, not the whole server), its optional SLO (``slo_us`` is
     both the scheduling deadline default and the latency objective the SLO
-    monitor burns against ``slo_target``)."""
+    monitor burns against ``slo_target``), and its brownout criticality
+    ``tier`` (gold/silver/bulk — what the degradation ladder sheds first)."""
 
     __slots__ = ("name", "endpoint", "queue", "breaker", "slo_us",
-                 "slo_target")
+                 "slo_target", "tier")
 
     def __init__(self, name: str, endpoint, queue: EndpointQueue,
                  breaker, slo_us: Optional[int] = None,
-                 slo_target: Optional[float] = None):
+                 slo_target: Optional[float] = None, tier: str = "gold"):
         self.name = name
         self.endpoint = endpoint
         self.queue = queue
         self.breaker = breaker
         self.slo_us = slo_us
         self.slo_target = slo_target
+        self.tier = tier
 
 
 class Router:
@@ -243,6 +245,13 @@ class Router:
         return name in self._tenants
 
     # -- scheduling inputs --------------------------------------------------
+    def effective_batch_timeout_us(self) -> float:
+        """The batching deadline in force right now: the configured timeout
+        widened by the brownout ladder (level >= 1 trades per-request
+        latency for fuller batches before anyone is refused)."""
+        from .batcher import brownout_timeout_boost
+        return self.batch_timeout_us * brownout_timeout_boost()
+
     def est_step_us(self, tenant: Tenant) -> float:
         """Estimated device time of the batch this tenant would run next:
         the EWMA for the bucket its pending prefix actually lands in."""
@@ -255,8 +264,9 @@ class Router:
         head_dl = tenant.queue.head_deadline_us()
         if head_dl is not None:
             return head_dl
-        budget = tenant.slo_us if tenant.slo_us else self.batch_timeout_us
-        return tenant.queue.head_enqueue_us() + budget
+        budget = tenant.slo_us if tenant.slo_us \
+            else self.effective_batch_timeout_us()
+        return int(tenant.queue.head_enqueue_us() + budget)
 
     def slack_us(self, tenant: Tenant, now_us: int) -> float:
         return self.effective_deadline_us(tenant) - now_us - \
@@ -264,7 +274,7 @@ class Router:
 
     def _starvation_us(self, tenant: Tenant) -> float:
         return self.starvation_factor * \
-            (self.batch_timeout_us + self.est_step_us(tenant))
+            (self.effective_batch_timeout_us() + self.est_step_us(tenant))
 
     # -- the decision -------------------------------------------------------
     def select(self, now_us: int, flush: bool = False) -> Optional[Tenant]:

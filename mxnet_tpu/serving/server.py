@@ -63,6 +63,7 @@ from .errors import (HotSwapError, RequestTimeoutError, ServerClosedError,
                      ServerOverloadError)
 from .pipeline import OverlapTracker, PreparedBatch, prepare_batch
 from .router import Router, Tenant
+from . import tailguard as _tailguard
 
 __all__ = ["InferenceServer"]
 
@@ -204,7 +205,8 @@ class InferenceServer:
                  max_queue: Optional[int] = None,
                  slo_ms: Optional[float] = None,
                  slo_target: Optional[float] = None,
-                 breaker: Optional[CircuitBreaker] = None) -> ModelEndpoint:
+                 breaker: Optional[CircuitBreaker] = None,
+                 tier: str = "gold") -> ModelEndpoint:
         """Attach an endpoint as a tenant; by default compiles every shape
         bucket now so no request ever pays first-compile latency (warmup also
         seeds the scheduler's per-bucket step-cost EWMA).
@@ -215,7 +217,15 @@ class InferenceServer:
         submit, and it doubles as the tenant's latency *objective*: the SLO
         monitor tracks the fraction of requests finishing under it against
         ``slo_target`` (default MXNET_SLO_TARGET) with burn-rate alerting;
-        ``breaker`` overrides the tenant's circuit breaker."""
+        ``breaker`` overrides the tenant's circuit breaker; ``tier`` is the
+        tenant's brownout criticality ("gold" / "silver" / "bulk") — under
+        sustained SLO burn the brownout ladder refuses bulk tenants first,
+        then silver; gold is never refused (default gold, so existing
+        registrations are untouchable by the ladder)."""
+        if tier not in _tailguard.TIER_RANKS:
+            raise MXNetError(
+                f"unknown tenant tier {tier!r}; expected one of "
+                f"{sorted(_tailguard.TIER_RANKS)}")
         with self._cond:
             if endpoint.name in self._router:
                 raise MXNetError(f"endpoint {endpoint.name!r} already registered")
@@ -231,7 +241,7 @@ class InferenceServer:
             self._router.add(Tenant(
                 endpoint.name, endpoint, q, breaker,
                 slo_us=int(slo_ms * 1000) if slo_ms is not None else None,
-                slo_target=slo_target))
+                slo_target=slo_target, tier=tier))
         if slo_ms is not None:
             _SLO.register(endpoint.name, threshold_us=slo_ms * 1000.0,
                           target=slo_target, breaker=breaker)
@@ -529,31 +539,46 @@ class InferenceServer:
     # ------------------------------------------------------------------
     # client surface
     # ------------------------------------------------------------------
-    def submit(self, name: str, inputs, deadline_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, name: str, inputs, deadline_ms: Optional[float] = None,
+               deadline=None) -> Future:
         """Enqueue a request; returns a Future resolving to the endpoint's
         output (an NDArray, or a tuple for multi-output models). A single
         example (no batch axis) resolves without a batch axis; a batch of n
         rows resolves to n-row outputs.
 
-        Raises ServerOverloadError when the tenant's bounded queue is full
-        or its circuit breaker is shedding load (OPEN: everything;
-        HALF_OPEN: beyond the probe budget; DEGRADED: beyond half the queue
-        bound) and ServerClosedError when the server is not accepting
-        work."""
+        ``deadline`` is a propagated :class:`~.tailguard.Deadline` (minted
+        once at ingress); when set it overrides ``deadline_ms`` — the
+        request carries the SAME end-to-end budget through the queue instead
+        of re-deriving a fresh one here, and an already-spent budget raises
+        DeadlineExceeded before admission.
+
+        Raises ServerOverloadError when the tenant's bounded queue is full,
+        its circuit breaker is shedding load (OPEN: everything; HALF_OPEN:
+        beyond the probe budget; DEGRADED: beyond half the queue bound), or
+        the brownout ladder is refusing this tenant's tier, and
+        ServerClosedError when the server is not accepting work."""
+        if deadline is not None:
+            deadline.check("ingress")
         with self._cond:
             if name not in self._router:
                 raise MXNetError(f"unknown endpoint {name!r}; registered: "
                                  f"{self._router.names()}")
             tenant = self._router.get(name)
         q = tenant.queue
+        if _tailguard.BROWNOUT.shed_tier(tenant.tier):
+            q.endpoint.stats.bump("rejected")
+            q.endpoint.stats.record_shed("brownout")
+            raise ServerOverloadError(
+                f"endpoint {name!r} (tier {tenant.tier!r}) shed by brownout "
+                f"level {_tailguard.BROWNOUT.level}: the fleet is burning "
+                "its SLO budget; retry with backoff")
         if not tenant.breaker.allow():
             q.endpoint.stats.bump("rejected")
             q.endpoint.stats.record_shed(f"circuit_{tenant.breaker.state()}")
             raise ServerOverloadError(
                 f"endpoint {name!r} circuit {tenant.breaker.state()}: "
                 "shedding load until the device recovers; retry with backoff")
-        req = self._make_request(q.endpoint, inputs, deadline_ms)
+        req = self._make_request(q.endpoint, inputs, deadline_ms, deadline)
         with self._cond:
             if self._state != _RUNNING:
                 raise ServerClosedError(f"server is {self._state}")
@@ -581,7 +606,8 @@ class InferenceServer:
         return self.submit(name, inputs, deadline_ms).result(timeout=timeout)
 
     def _make_request(self, ep: ModelEndpoint, inputs,
-                      deadline_ms: Optional[float]) -> Request:
+                      deadline_ms: Optional[float],
+                      deadline=None) -> Request:
         """Validate + host-normalize one request OUTSIDE the lock: every
         input becomes a contiguous numpy batch in the endpoint dtype."""
         if not isinstance(inputs, (tuple, list)):
@@ -616,7 +642,8 @@ class InferenceServer:
             raise MXNetError(
                 f"request of {rows} rows exceeds endpoint {ep.name!r} "
                 f"max_batch_size={ep.max_batch_size}; split the request")
-        return Request(tuple(host), rows, squeeze, deadline_ms)
+        return Request(tuple(host), rows, squeeze, deadline_ms,
+                       deadline=deadline)
 
     # ------------------------------------------------------------------
     # shared scheduling helpers (caller holds the condition lock)
@@ -947,7 +974,8 @@ class InferenceServer:
                     # retries must respect what clients asked for: never back
                     # off past the earliest request deadline in the batch
                     outs = self._retry.run(run_step, site="serving_dispatch",
-                                           deadline_us=pb.deadline_us)
+                                           deadline_us=pb.deadline_us,
+                                           budget_tier="execute")
             killed = False
         except Exception as e:  # retries exhausted / fatal: fail the batch
             killed = False
@@ -970,6 +998,9 @@ class InferenceServer:
                     if self._inflight is pb:
                         self._inflight = None
         pb.tenant.breaker.record_success()
+        # one executed batch = one unit of real work funding the execute
+        # tier's retry budget
+        _tailguard.retry_deposit("execute")
         ep.stats.record_step(_now_us() - t0)
         off = 0
         done = _now_us()

@@ -556,4 +556,5 @@ def test_perf_gate_committed_budgets_valid():
     # the canonical env pins every knob the measured sources read
     assert budgets["env"]["JAX_PLATFORMS"] == "cpu"
     sources = {m["source"] for m in budgets["metrics"].values()}
-    assert sources == {"bench", "loadgen", "eager", "restart", "fabric"}
+    assert sources == {"bench", "loadgen", "eager", "restart", "fabric",
+                       "tailguard"}

@@ -68,6 +68,23 @@ from tier-1 (tests/test_resilience.py::test_chaos_smoke):
      the collector still listing the dead host's last dump, and per-host
      goodput ledgers reconciling within 1%.
 
+  8. TAIL-TOLERANCE SCENARIOS (``--scenario {retry_storm,straggler,
+     partition}``) — the r18 tailguard drills. A ``net_drop`` storm at the
+     front door under a nearly-dry retry budget must convert into bounded
+     shed (retry amplification < 2x, classified client errors, a
+     ``retry_budget_exhausted`` flight bundle) while the same storm under
+     an effectively unbounded budget is fully absorbed at >=2x
+     amplification — the difference is the defense. A replica-straggler
+     stall at the device-step boundary must be cut by hedged requests:
+     every request lands inside its deadline, outputs bitwise-equal to the
+     unhedged fault-free oracle, speculation bounded by the hedge token
+     bucket (a dry bucket latches ``hedge_budget_exhausted``). A front-door
+     partition plus synthetic SLO burn must walk the brownout ladder in
+     criticality order — bulk shed before silver, gold never refused, one
+     ``brownout_shift`` flight bundle per transition, full recovery to
+     level 0 — with the fleet pane intact (parseable report naming both
+     host agents, per-host goodput ledgers reconciling within 1%).
+
 Every run prints its seed; a failing seed is a deterministic repro::
 
     python tools/chaos_check.py --seed 1234 --steps 20 --requests 40
@@ -1203,12 +1220,424 @@ def check_host_down(seed, requests=24, p=0.0, in_dim=8, out_dim=4):
             "goodput_ledgers": ledgers, "ok": bool(ok)}
 
 
+def check_retry_storm(seed, requests=20, in_dim=8, out_dim=4):
+    """SCENARIO retry_storm (r18): the same high-probability retryable
+    ``net_drop`` storm is replayed twice through a single-host FrontDoor.
+    With the frontdoor retry budget nearly dry the storm must convert into
+    bounded, classified shed: retry amplification (fault-site attempts per
+    client request) stays under 2x, some requests still serve, every shed
+    error carries the honest UNAVAILABLE marker, and the latched
+    ``retry_budget_exhausted`` flight trigger fires. With an effectively
+    unbounded budget the identical storm is fully absorbed — zero client
+    errors — at >=2x amplification: the gap between the two runs IS the
+    defense. Served outputs stay bitwise-equal to the direct forward."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving.fabric import FrontDoor
+    from mxnet_tpu.serving.tailguard import RETRY_BUDGETS
+
+    tenant = f"chaos_storm_{seed}"
+
+    def mlp():
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    ref = mlp()
+    weights = [prm.data().asnumpy() for prm in ref.collect_params().values()]
+
+    def factory(name):
+        net = mlp()
+        for prm, w in zip(net.collect_params().values(), weights):
+            prm.set_data(nd.array(w))
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, requests * 8))
+        srv.register(serving.ModelEndpoint(
+            tenant, net, input_shapes=(in_dim,), max_batch_size=4))
+        srv.start()
+        return srv
+
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    direct = ref(nd.array(xs)).asnumpy()
+    knobs = ("MXNET_RETRY_BUDGET_RATIO", "MXNET_RETRY_BUDGET_MIN",
+             "MXNET_RETRY_BUDGET_CAP")
+    saved = {k: config.get(k) for k in knobs}
+
+    def storm(tag, ratio, floor, cap):
+        """One storm pass over a fresh front door + fresh retry buckets."""
+        config.set("MXNET_RETRY_BUDGET_RATIO", ratio)
+        config.set("MXNET_RETRY_BUDGET_MIN", floor)
+        config.set("MXNET_RETRY_BUDGET_CAP", cap)
+        RETRY_BUDGETS.reset()          # fresh bucket picks up the knobs
+        ex_before = _metric_total("mxtpu_retry_budget_exhausted_total")
+        fd = FrontDoor([f"{tag}_{seed}"], factory, spawn_agents=False,
+                       supervise=False)
+        served, errors = [], []
+        try:
+            with faults.inject("net_drop", site="frontdoor", p=0.75,
+                               seed=seed) as inj:
+                for i in range(requests):
+                    try:
+                        o = fd.submit(tenant, xs[i]).result(timeout=60)
+                        served.append((i, o.asnumpy()))
+                    except Exception as e:
+                        errors.append(repr(e))
+                attempts = inj.calls
+        finally:
+            fd.stop(drain=True)
+            serving.unregister(tenant)
+        return {"attempts": attempts, "served": len(served),
+                "errors": errors,
+                "exhausted": _metric_total(
+                    "mxtpu_retry_budget_exhausted_total") - ex_before,
+                "amplification": attempts / float(requests),
+                "bitwise": all(onp.array_equal(o, direct[i])
+                               for i, o in served)}
+
+    try:
+        # budgeted: a nearly-dry bucket (5 tokens, negligible income) must
+        # convert the storm into bounded shed instead of absorbing it
+        budgeted = storm("bud", 0.001, 5.0, 5.0)
+        # unbounded: a bucket the storm cannot drain absorbs every drop
+        unbounded = storm("unb", 0.1, 1e6, 1e6)
+    finally:
+        for k, v in saved.items():
+            config.set(k, v)
+        RETRY_BUDGETS.reset()
+    amp_on = budgeted["amplification"]
+    amp_off = unbounded["amplification"]
+    shed_classified = all("UNAVAILABLE" in e for e in budgeted["errors"])
+    ok = (amp_on < 2.0 and amp_off >= 2.0 and
+          budgeted["exhausted"] >= 1 and budgeted["served"] > 0 and
+          budgeted["errors"] and shed_classified and
+          unbounded["served"] == requests and not unbounded["errors"] and
+          budgeted["bitwise"] and unbounded["bitwise"])
+    return {"phase": "retry_storm", "seed": seed, "requests": requests,
+            "amplification_budgeted": round(amp_on, 3),
+            "amplification_unbounded": round(amp_off, 3),
+            "served_budgeted": budgeted["served"],
+            "shed_budgeted": len(budgeted["errors"]),
+            "shed_classified": bool(shed_classified),
+            "budget_exhaustions": budgeted["exhausted"],
+            "client_errors_unbounded": unbounded["errors"][:5],
+            "outputs_bitwise_equal": bool(budgeted["bitwise"]
+                                          and unbounded["bitwise"]),
+            "ok": bool(ok)}
+
+
+def check_straggler(seed, requests=24, in_dim=8, out_dim=4):
+    """SCENARIO straggler (r18): the very first device dispatch of the
+    burst stalls 0.4 s (``replica_straggler`` at the step boundary),
+    wedging one replica of a two-replica ServingPool with its share of the
+    deadline-carrying burst stuck behind it — the canonical straggling
+    replica. The hedging policy must cut the tail:
+    duplicates launch onto the other replica after the adaptive delay, at
+    least one hedge wins, every request lands inside its deadline (zero
+    client errors), outputs stay bitwise-equal to the unhedged fault-free
+    oracle AND the direct forward, speculation stays inside the token
+    bucket (hedges launched <= seed + ratio * submits) and the dry bucket
+    latches the ``hedge_budget_exhausted`` flight trigger."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving import tailguard
+
+    svc = f"chaos_strag_{seed}"
+    ratio = 0.2
+
+    def mlp():
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    nets = {}
+
+    def factory(rid):
+        net = mlp()                   # same seed: replicas serve bitwise-
+        nets[rid] = net               # identical outputs, so hedging is safe
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, requests * 8))
+        srv.register(serving.ModelEndpoint(
+            svc, net, input_shapes=(in_dim,), max_batch_size=4))
+        return srv
+
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    knobs = ("MXNET_HEDGE_ENABLE", "MXNET_HEDGE_DELAY_MIN_MS",
+             "MXNET_HEDGE_BUDGET_RATIO")
+    saved = {k: config.get(k) for k in knobs}
+    pool = serving.ServingPool(factory, initial_replicas=2)
+    client_errors = []
+    try:
+        # oracle: hedging off, fault-free — the bitwise bar for the chaos run
+        config.set("MXNET_HEDGE_ENABLE", False)
+        oracle = [pool.predict(svc, xs[i], timeout=60).asnumpy()
+                  for i in range(requests)]
+        # chaos: hedge quickly (25 ms floor) under a deliberately tight
+        # budget so the bucket runs dry mid-burst
+        config.set("MXNET_HEDGE_ENABLE", True)
+        config.set("MXNET_HEDGE_DELAY_MIN_MS", 25.0)
+        config.set("MXNET_HEDGE_BUDGET_RATIO", ratio)
+        tailguard.hedge_reset()
+        before = {m: _metric_total(m) for m in
+                  ("mxtpu_hedge_requests_total", "mxtpu_hedge_wins_total",
+                   "mxtpu_hedge_cancelled_total", "mxtpu_hedge_wasted_total",
+                   "mxtpu_hedge_budget_exhausted_total")}
+        outs = [None] * requests
+        with faults.inject("replica_straggler", site="serving_dispatch",
+                           at=(1,), seconds=0.4) as inj:
+            futs = [pool.submit(svc, xs[i], deadline_ms=30000.0)
+                    for i in range(requests)]
+            for i, f in enumerate(futs):
+                try:
+                    outs[i] = f.result(timeout=120).asnumpy()
+                except Exception as e:
+                    client_errors.append(repr(e))
+        delta = {m: _metric_total(m) - before[m] for m in before}
+    finally:
+        for k, v in saved.items():
+            config.set(k, v)
+        tailguard.hedge_reset()
+        pool.stop(drain=True)
+        serving.unregister(svc)
+    direct = nets[0](nd.array(xs)).asnumpy()
+    oracle_ok = all(onp.array_equal(o, direct[i])
+                    for i, o in enumerate(oracle))
+    bitwise = all(o is not None and onp.array_equal(o, oracle[i])
+                  for i, o in enumerate(outs))
+    hedges = delta["mxtpu_hedge_requests_total"]
+    wins = delta["mxtpu_hedge_wins_total"]
+    wasted = delta["mxtpu_hedge_wasted_total"]
+    exhausted = delta["mxtpu_hedge_budget_exhausted_total"]
+    budget_cap = 1.0 + ratio * requests       # seed token + per-submit income
+    ok = (not client_errors and oracle_ok and bitwise and inj.fires >= 1 and
+          hedges >= 1 and wins >= 1 and exhausted >= 1 and
+          hedges <= budget_cap + 1e-9 and wasted <= hedges)
+    return {"phase": "straggler", "seed": seed, "requests": requests,
+            "stalls_fired": inj.fires,
+            "hedges_launched": hedges, "hedge_wins": wins,
+            "hedges_cancelled": delta["mxtpu_hedge_cancelled_total"],
+            "hedges_wasted": wasted, "budget_exhaustions": exhausted,
+            "hedge_rate": round(hedges / float(requests), 3),
+            "hedge_budget_cap": budget_cap,
+            "client_errors": client_errors[:5],
+            "outputs_bitwise_equal": bool(oracle_ok and bitwise),
+            "ok": bool(ok)}
+
+
+def check_partition(seed, requests=20, in_dim=8, out_dim=4):
+    """SCENARIO partition (r18): a two-host FrontDoor serves gold, silver
+    and bulk tenants while (a) a bounded ``net_drop`` partition fires at the
+    front door — the frontdoor retry budget must absorb every drop with
+    zero client errors on ANY tier — and (b) a synthetic SLO burn walks the
+    brownout ladder deterministically: level 1 softens (timeout boost, no
+    shed), level 2 sheds bulk at admission (ServerOverloadError) while
+    silver and gold keep serving, recovery returns to level 0 and bulk
+    serves again. Gold sees zero client errors across the whole drill, every
+    transition leaves exactly one ``brownout_shift`` flight bundle, and the
+    fleet pane survives: the collector names both host agents and every
+    host's goodput ledger reconciles buckets-to-wall within 1%."""
+    import time
+    import mxnet_tpu as mx
+    from mxnet_tpu import config, nd, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.resilience import faults
+    from mxnet_tpu.serving.errors import ServerOverloadError
+    from mxnet_tpu.serving.fabric import FrontDoor
+    from mxnet_tpu.serving.tailguard import BROWNOUT, RETRY_BUDGETS
+    from mxnet_tpu.telemetry import flight
+
+    tiers = {f"chaos_part_gold_{seed}": "gold",
+             f"chaos_part_silver_{seed}": "silver",
+             f"chaos_part_bulk_{seed}": "bulk"}
+    gold, silver, bulk = list(tiers)
+
+    def mlp():
+        mx.random.seed(seed)
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(out_dim))
+        net.initialize(mx.init.Xavier())
+        net(nd.array(onp.zeros((2, in_dim), "float32")))
+        return net
+
+    ref = mlp()
+    weights = [prm.data().asnumpy() for prm in ref.collect_params().values()]
+
+    def factory(name):
+        net = mlp()
+        for prm, w in zip(net.collect_params().values(), weights):
+            prm.set_data(nd.array(w))
+        srv = serving.InferenceServer(batch_timeout_ms=1.0,
+                                      max_queue=max(256, requests * 8))
+        for i, (t, tier) in enumerate(tiers.items()):
+            srv.register(serving.ModelEndpoint(
+                t, net, input_shapes=(in_dim,), max_batch_size=4),
+                warmup=(i == 0), tier=tier)
+        srv.start()
+        return srv
+
+    class _BurnStub:
+        burn_threshold = 14.0
+
+        def __init__(self):
+            self.burning = False
+
+        def check_all(self):
+            burn = 20.0 if self.burning else 0.0
+            return [{"endpoint": gold, "fast_burn": burn, "slow_burn": burn,
+                     "alert_active": self.burning}]
+
+    workdir = os.environ.get("CHAOS_FLEET_DIR") or tempfile.mkdtemp(
+        prefix="chaos-partition-")
+    xs = onp.random.RandomState(seed + 1).randn(
+        requests, in_dim).astype("float32")
+    direct = ref(nd.array(xs)).asnumpy()
+    errors = {t: [] for t in tiers}
+    outs = []
+
+    def send(tenant, i):
+        try:
+            outs.append((i, fd.submit(tenant, xs[i % requests],
+                                      deadline_ms=30000.0).result(timeout=60)
+                         .asnumpy()))
+            return None
+        except Exception as e:
+            errors[tenant].append(repr(e))
+            return e
+
+    RETRY_BUDGETS.reset()
+    mon = _BurnStub()
+    trans_before = _metric_total("mxtpu_brownout_transitions_total")
+    shed_before = _metric_total("mxtpu_brownout_shed_total")
+    fd = FrontDoor(["alpha", "beta"], factory, workdir=workdir)
+    agents_seen = False
+    level_path = []
+    shed_at_2 = {"bulk": None, "silver": None, "gold": None}
+    try:
+        # both agents must boot + dump before the drill (post-mortem pane)
+        boot_deadline = time.time() + 60
+        while time.time() < boot_deadline:
+            if all(os.path.exists(os.path.join(
+                    workdir, f"dump-host-{n}.json")) for n in fd.hosts()):
+                agents_seen = True
+                break
+            time.sleep(0.1)
+        # (a) bounded partition: every drop absorbed by the frontdoor
+        # retry budget (12 drops << the 50-token floor) — zero errors
+        with faults.inject("net_drop", site="frontdoor", p=0.6, times=12,
+                           seed=seed) as inj:
+            for i in range(requests):
+                send([gold, silver, bulk][i % 3], i)
+        drops = inj.fires
+        # (b) the brownout ladder, driven deterministically
+        BROWNOUT.set_monitor(mon)
+        BROWNOUT.reset()
+        mon.burning = True
+        tick = 0
+        for _ in range(2):            # -> level 1: soften, nobody refused
+            flight.RECORDER.reset_rate_limit()
+            BROWNOUT.tick(now=float(tick))
+            tick += 1
+        level_path.append(BROWNOUT.level)
+        soften_ok = (BROWNOUT.level == 1 and BROWNOUT.timeout_boost() > 1.0
+                     and send(bulk, 1) is None)
+        for _ in range(2):            # -> level 2: shed bulk, serve the rest
+            flight.RECORDER.reset_rate_limit()
+            BROWNOUT.tick(now=float(tick))
+            tick += 1
+        level_path.append(BROWNOUT.level)
+        shed_at_2["bulk"] = repr(send(bulk, 2))
+        shed_at_2["silver"] = send(silver, 3) is None
+        shed_at_2["gold"] = send(gold, 4) is None
+        shed_ok = (BROWNOUT.level == 2
+                   and len(errors[bulk]) == 1
+                   and "ServerOverloadError" in errors[bulk][0]
+                   and "brownout" in errors[bulk][0]
+                   and shed_at_2["silver"] and shed_at_2["gold"])
+        mon.burning = False
+        for _ in range(6):            # calm: -> 1 -> 0 (down_n=3 each)
+            flight.RECORDER.reset_rate_limit()
+            BROWNOUT.tick(now=float(tick))
+            tick += 1
+        level_path.append(BROWNOUT.level)
+        recovered_ok = BROWNOUT.level == 0 and send(bulk, 5) is None
+        # the post-mortem pane: one more agent dump cycle, then collect
+        time.sleep(max(0.3, 2 * float(
+            config.get("MXNET_FABRIC_HEARTBEAT_S"))))
+        pane = fd.fleet_collect()
+        ledgers = fd.goodput_reconcile(tol=0.01)
+    finally:
+        BROWNOUT.set_monitor(None)
+        BROWNOUT.reset()
+        RETRY_BUDGETS.reset()
+        fd.stop(drain=True)
+        for t in tiers:
+            serving.unregister(t)
+    transitions = _metric_total(
+        "mxtpu_brownout_transitions_total") - trans_before
+    shed_total = _metric_total("mxtpu_brownout_shed_total") - shed_before
+    # one brownout_shift bundle per transition (countable when the flight
+    # dir is scoped by the harness wrapper)
+    fdir = str(config.get("MXNET_FLIGHT_DIR") or "")
+    bundles = None
+    if fdir:
+        bundles = 0
+        for path in flight.list_bundles(fdir):
+            try:
+                if flight.load_bundle(path)["trigger"]["kind"] == \
+                        "brownout_shift":
+                    bundles += 1
+            except (OSError, ValueError, KeyError):
+                pass
+    bundles_ok = bundles is None or bundles == transitions
+    bitwise = bool(outs) and all(
+        onp.array_equal(o, direct[i % requests]) for i, o in outs)
+    pane_ok = {f"host-{n}" for n in fd.hosts()} <= set(pane["sources"])
+    ledgers_ok = (set(ledgers) == set(fd.hosts())
+                  and all(v["ok"] for v in ledgers.values()))
+    ok = (agents_seen and drops >= 1 and not errors[gold]
+          and not errors[silver] and len(errors[bulk]) == 1
+          and soften_ok and shed_ok and recovered_ok
+          and transitions == 4 and shed_total >= 1 and bundles_ok
+          and bitwise and pane_ok and ledgers_ok)
+    return {"phase": "partition", "seed": seed, "requests": requests,
+            "drops_absorbed": drops, "level_path": level_path,
+            "transitions": transitions, "brownout_bundles": bundles,
+            "shed_counter": shed_total,
+            "gold_errors": errors[gold][:5],
+            "silver_errors": errors[silver][:5],
+            "bulk_shed_error": (errors[bulk] or [None])[0],
+            "requests_served": len(outs),
+            "outputs_bitwise_equal": bitwise,
+            "agents_seen": agents_seen,
+            "fleet_pane_sources": [s for s in pane["sources"]
+                                   if s.startswith("host-")],
+            "goodput_ledgers": ledgers, "ok": bool(ok)}
+
+
 SCENARIOS = {"preempt": check_preempt, "worker_kill": check_worker_kill,
              "hot_swap": check_hot_swap, "nan_grad": check_nan_grad,
              "bad_batch": check_bad_batch, "sdc": check_sdc,
              "decode": check_decode, "cache_poison": check_cache_poison,
              "autoscale": check_autoscale, "dlrm": check_dlrm,
-             "host_down": check_host_down}
+             "host_down": check_host_down, "retry_storm": check_retry_storm,
+             "straggler": check_straggler, "partition": check_partition}
 
 # the flight-recorder trigger each injected fault must leave behind (a clean
 # hot_swap is a structured event, not a dump trigger, so it has no entry)
@@ -1221,6 +1650,9 @@ EXPECTED_FLIGHT_TRIGGER = {
     "decode": "decode_failover",
     "dlrm": "oom",   # retry's OOM classifier fires on the RESOURCE_EXHAUSTED
     "host_down": "host_down",
+    "retry_storm": "retry_budget_exhausted",
+    "straggler": "hedge_budget_exhausted",
+    "partition": "brownout_shift",
 }
 
 
@@ -1359,6 +1791,16 @@ def run_chaos(seed=0, steps=20, requests=40, p=0.3, ckpt_dir=None,
                 res = check_fleet_report(name, lambda: check_flight_bundle(
                     name, lambda: check_host_down(
                         seed, requests=max(8, requests // 2))))
+            elif name == "retry_storm":
+                res = check_flight_bundle(name, lambda: check_retry_storm(
+                    seed, requests=max(8, requests // 2)))
+            elif name == "straggler":
+                res = check_flight_bundle(name, lambda: check_straggler(
+                    seed, requests=max(8, requests // 2)))
+            elif name == "partition":
+                res = check_fleet_report(name, lambda: check_flight_bundle(
+                    name, lambda: check_partition(
+                        seed, requests=max(9, requests // 2))))
             else:
                 raise SystemExit(f"unknown scenario {name!r}; known: "
                                  f"{sorted(SCENARIOS)}")

@@ -38,6 +38,12 @@ Measurement sources (selectable with ``--only``):
             serving scaling sweep's top-slice served throughput
             (``fabric_sharded_img_s``), valid only when every slice size
             served bitwise-equal to the single-chip reference
+  tailguard serving_loadgen.py --hedge --storm in a subprocess: the
+            tail-tolerance contract rows — hedged duplicate work stays
+            under its token-bucket ceiling (``hedge_wasted_work_pct``)
+            and a retry storm reaches zero clients
+            (``storm_client_error_rate``, budget 0: the retry budget
+            must absorb every injected drop)
 
 Exit status mirrors tools/mxlint.py --check: 0 clean, 1 findings,
 2 operational error.
@@ -54,7 +60,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 DEFAULT_BUDGETS = os.path.join(REPO, "PERF_BUDGETS.json")
-_SOURCES = ("bench", "loadgen", "eager", "restart", "fabric")
+_SOURCES = ("bench", "loadgen", "eager", "restart", "fabric", "tailguard")
 
 
 # ---------------------------------------------------------------------------
@@ -82,8 +88,11 @@ def validate_budgets(budgets):
         if not isinstance(m, dict):
             errs.append(f"{where} must be an object")
             continue
-        if not isinstance(m.get("budget"), (int, float)) or m["budget"] <= 0:
-            errs.append(f"{where}.budget must be a positive number")
+        budget = m.get("budget")
+        if not isinstance(budget, (int, float)) or budget < 0 or \
+                (budget == 0 and m.get("direction") != "max"):
+            errs.append(f"{where}.budget must be a positive number "
+                        "(or zero for a max-direction ceiling)")
         tol = m.get("tolerance")
         if not isinstance(tol, (int, float)) or not 0 <= tol < 1:
             errs.append(f"{where}.tolerance must be in [0, 1)")
@@ -133,10 +142,14 @@ def gate(budgets, measured):
                         "error": "not measured"})
             continue
         ok = v >= bound if m["direction"] == "min" else v <= bound
+        # a zero ceiling has no relative headroom: report the absolute
+        # overshoot instead of dividing by the bound
+        margin = round((v / bound - 1.0) * 100.0, 1) if bound \
+            else round(float(v), 4)
         out.append({"metric": name, "ok": bool(ok),
                     "measured": round(float(v), 4), "budget": budget,
                     "bound": round(bound, 4), "direction": m["direction"],
-                    "margin": round((v / bound - 1.0) * 100.0, 1)})
+                    "margin": margin})
     return out
 
 
@@ -246,6 +259,33 @@ def measure_fabric(env):
                       "stderr": err[-2000:]}
 
 
+def measure_tailguard(env):
+    """serving_loadgen --hedge --storm tailguard rows ->
+    hedge_wasted_work_pct / storm_client_error_rate. Both phases embed
+    their own correctness oracles (bitwise outputs, bounded hedge volume,
+    drop volume under the retry-budget floor), so the parsed numbers are
+    the residual perf contract: duplicate work stays under the
+    token-bucket ceiling and the storm never reaches a client. Skips the
+    image sweep and the decode phase — only the tailguard phases run."""
+    tg_env = dict(env)
+    tg_env["SLG_DECODE"] = "0"
+    cmd = [sys.executable, os.path.join("benchmark", "serving_loadgen.py"),
+           "--dtypes", "none", "--hedge", "--storm"]
+    rc, out, err = _run(cmd, tg_env)
+    measured = {}
+    for row in _json_lines(out):
+        if row.get("tailguard") == "hedge" \
+                and row.get("hedge_wasted_work_pct") is not None:
+            measured["hedge_wasted_work_pct"] = \
+                float(row["hedge_wasted_work_pct"])
+        if row.get("tailguard") == "storm" \
+                and row.get("storm_client_error_rate") is not None:
+            measured["storm_client_error_rate"] = \
+                float(row["storm_client_error_rate"])
+    return measured, {"cmd": " ".join(cmd), "rc": rc, "stdout": out,
+                      "stderr": err[-2000:]}
+
+
 def measure_eager():
     """p95 eager dispatch (us) over the representative op set, best of 3
     windows — the test_eager_latency gate as a number."""
@@ -312,9 +352,10 @@ def smoke(budgets):
         print("perf_gate: smoke: at-budget values must pass",
               file=sys.stderr)
         return None
-    # fail case: every metric 3x out of band in its bad direction
-    bad = {name: float(m["budget"]) * (0.25 if m["direction"] == "min"
-                                       else 4.0)
+    # fail case: every metric well out of band in its bad direction
+    # (+1 keeps zero-budget ceilings out of band too)
+    bad = {name: float(m["budget"]) * 0.25 if m["direction"] == "min"
+           else float(m["budget"]) * 4.0 + 1.0
            for name, m in budgets["metrics"].items()}
     if not all(not r["ok"] for r in gate(budgets, bad)):
         print("perf_gate: smoke: out-of-band values must fail",
@@ -383,6 +424,9 @@ def main(argv=None):
         measured.update(vals)
     if "fabric" in sources and "fabric" in wanted:
         vals, _ = measure_fabric(env)
+        measured.update(vals)
+    if "tailguard" in sources and "tailguard" in wanted:
+        vals, _ = measure_tailguard(env)
         measured.update(vals)
 
     # metrics whose source was excluded by --only are reported, not gated
