@@ -33,7 +33,7 @@ from typing import Dict, Optional
 
 __all__ = ["AnalysisCache", "content_sha"]
 
-CACHE_VERSION = 3     # v3: origin dataflow learned for-loop target binding
+CACHE_VERSION = 4     # v4: blocking/bare-write/axis-use effect summaries
 
 
 def content_sha(text: str) -> str:

@@ -17,6 +17,17 @@ are checkable syntactically:
            resolution) contributes an edge lockA -> lockB to a per-file
            acquisition graph; a cycle means two threads can acquire the
            locks in opposite orders and deadlock.
+  CONC202  blocking under a lock — ``time.sleep``/``.join()``/
+           ``.result()``/file IO/device syncs (``block_until_ready``,
+           ``device_get``) executed while an owning lock is held stall
+           every thread contending for that lock for the full blocking
+           duration (the serving dispatch lock held across a device sync
+           is a global convoy). Fires through helper indirection: the
+           per-function ``blocking`` summaries mean ``with self._lock:
+           self._flush()`` is flagged at the call site when ``_flush``
+           opens a file three hops down. ``Condition.wait()`` is exempt —
+           it releases the lock while parked, which is the one legal way
+           to block under one.
 
 A ``Condition(lock)`` aliases its lock (acquiring either is acquiring the
 same underlying mutex), which the analysis models via lock *groups* — the
@@ -28,8 +39,10 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Checker, Finding, SourceFile, register
+from .summaries import MAX_CHAIN, blocking_reason
 
-__all__ = ["UnlockedSharedMutation", "LockOrderCycles"]
+__all__ = ["UnlockedSharedMutation", "LockOrderCycles",
+           "BlockingUnderLock"]
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 # container methods that mutate their receiver in place
@@ -383,3 +396,110 @@ class LockOrderCycles(Checker):
                     f"lock-order cycle among {{{', '.join(comp)}}}: "
                     f"acquisitions {order} can interleave into a deadlock; "
                     "impose a single acquisition order")
+
+
+class _BlockingScan(ast.NodeVisitor):
+    """Find blocking calls executed while an owning lock is held, in one
+    method. Direct ops come from the shared blocking vocabulary; helper
+    indirection comes from the callee's propagated ``blocking`` summary."""
+
+    def __init__(self, locks: _ClassLocks, owner, project):
+        self.locks = locks
+        self.owner = owner        # FuncInfo of the method (call resolution)
+        self.project = project
+        self.held: List[str] = []     # stack of held group names
+        self.hits: List[Tuple[ast.Call, str, Optional[object]]] = []
+
+    def visit_With(self, node: ast.With):
+        groups = _acquired_groups(node, self.locks)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.extend(groups)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(groups):]
+
+    def visit_Call(self, node: ast.Call):
+        if self.held:
+            reason = blocking_reason(node)
+            if reason is not None:
+                self.hits.append((node, reason, None))
+            elif self.owner is not None and self.project is not None:
+                callee = self.project.resolve_call(self.owner, node)
+                if callee is not None and callee is not self.owner and \
+                        callee.summary is not None and \
+                        callee.summary.blocking:
+                    eff = callee.summary.blocking[0]
+                    if len(eff.chain) < MAX_CHAIN:
+                        self.hits.append((node, eff.reason,
+                                          (callee, eff)))
+        self.generic_visit(node)
+
+    # deferred bodies run outside this with-block's critical section
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        outer = self.held
+        self.held = []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = outer
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        outer = self.held
+        self.held = []
+        self.visit(node.body)
+        self.held = outer
+
+
+@register
+class BlockingUnderLock(Checker):
+    rule = "CONC202"
+    name = "blocking-under-lock"
+    help = ("A thread-blocking operation (time.sleep / .join() / .result() "
+            "/ file IO / block_until_ready / device_get) runs while an "
+            "owning lock is held — every contending thread convoys behind "
+            "it for the full blocking duration. Move the blocking work "
+            "outside the critical section (snapshot under the lock, block "
+            "after). Fires through helpers via the blocking summaries; "
+            "Condition.wait() is exempt (it releases the lock).")
+
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        owners = {}
+        if project is not None:
+            table = project.tables.get(src.path)
+            if table is not None:
+                owners = {id(info.node): info
+                          for info in table.all_functions}
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _ClassLocks(cls)
+            if not locks:
+                continue
+            for meth in _methods(cls):
+                scan = _BlockingScan(locks, owners.get(id(meth)), project)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                for call, reason, via in scan.hits:
+                    if via is None:
+                        yield src.finding(
+                            self.rule, call,
+                            f"{reason} while `{cls.name}`'s lock is held "
+                            f"in `{meth.name}()`: every thread contending "
+                            "for the lock stalls for the full blocking "
+                            "duration — snapshot state under the lock and "
+                            "block after releasing it")
+                    else:
+                        callee, eff = via
+                        chain = " -> ".join((callee.display,) + eff.chain)
+                        yield src.finding(
+                            self.rule, call,
+                            f"call to `{callee.display}()` blocks "
+                            f"({eff.reason}, via: {chain} at "
+                            f"{eff.site()}) while `{cls.name}`'s lock is "
+                            f"held in `{meth.name}()`: the critical "
+                            "section stalls every contending thread — "
+                            "move the blocking call outside the lock")

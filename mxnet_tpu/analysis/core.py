@@ -46,11 +46,11 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Finding", "SourceFile", "Checker", "register", "all_checkers",
            "get_checker", "iter_python_files", "lint_file", "lint_paths",
-           "LAST_SCAN_STATS", "VERSION"]
+           "ruleset_digest", "LAST_SCAN_STATS", "VERSION"]
 
 #: mxlint version: stamps the SARIF driver and keys the incremental cache
 #: (any version bump is a full cold scan)
-VERSION = "2.0"
+VERSION = "3.0"
 
 _DISABLE_RE = re.compile(
     r"#\s*mxlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)")
@@ -58,8 +58,10 @@ _SCOPE_LINE_RE = re.compile(r"^\s*(?:async\s+def|def|class)\b")
 
 #: how the last :func:`lint_paths` run split the scan (for the CLI status
 #: line and the incremental-cache tests): ``checked`` were analyzed fresh,
-#: ``cache_hits`` replayed findings from the cache
-LAST_SCAN_STATS: Dict[str, list] = {"checked": [], "cache_hits": []}
+#: ``cache_hits`` replayed findings from the cache; ``wall_s`` is the
+#: scan's total wall time (the warm-gate perf guard asserts over it)
+LAST_SCAN_STATS: Dict[str, object] = {"checked": [], "cache_hits": [],
+                                      "wall_s": 0.0}
 
 
 class Finding:
@@ -241,6 +243,26 @@ def get_checker(rule: str) -> Optional[Checker]:
     return _CHECKERS.get(rule.upper())
 
 
+def ruleset_digest() -> str:
+    """Content digest of the active rule set: every registered rule id plus
+    a hash of its checker's source. Part of the incremental cache key, so a
+    new rule (or an edited checker) is a guaranteed cold scan even when
+    nobody remembered to bump CACHE_VERSION — a stale-clean report from a
+    cache that predates the rule is impossible by construction."""
+    import inspect
+    h = hashlib.sha256()
+    for checker in all_checkers():
+        cls = type(checker)
+        try:
+            src = inspect.getsource(cls)
+        except (OSError, TypeError):
+            # source unavailable (REPL-defined test rules): fall back to
+            # the rule's declared surface, which still keys registration
+            src = f"{cls.__name__}|{checker.rule}|{checker.help}"
+        h.update(f"{checker.rule}\x00{src}\x00".encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
 def iter_python_files(paths: Sequence[str]) -> List[str]:
     """Expand files/directories into a sorted list of ``*.py`` files."""
     out = []
@@ -327,9 +349,14 @@ def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
     without re-analysis (see :mod:`.cache`); the report is identical to a
     cold scan either way. ``LAST_SCAN_STATS`` records the split.
     """
+    import time
     from .callgraph import Project
     from .cache import AnalysisCache
-    cache = AnalysisCache(cache_path, tool_key=f"mxlint-{VERSION}") \
+    t0 = time.perf_counter()
+    # the cache key carries the rule-set digest: registering a new rule (or
+    # editing a checker) cold-scans without relying on a version bump
+    cache = AnalysisCache(
+        cache_path, tool_key=f"mxlint-{VERSION}-{ruleset_digest()}") \
         if cache_path else None
 
     sources: List[SourceFile] = []
@@ -375,4 +402,5 @@ def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
     findings.extend(_project_findings(project))
     if cache is not None:
         cache.save()
+    LAST_SCAN_STATS["wall_s"] = time.perf_counter() - t0
     return _filter_sort(findings, rules)

@@ -1,0 +1,243 @@
+"""Fault/chaos/flight registry drift: the ENV600 pattern, generalized.
+
+The chaos story spans four artifacts that drift independently: the fault
+registry (``resilience/faults.py``'s ``SITES``/``_KINDS``), the production
+``check``/``inject`` call sites, the chaos gate's scenario table
+(``tools/chaos_check.py``'s ``SCENARIOS``), and the runbooks
+(RESILIENCE.md, OBSERVABILITY.md) operators drill from. A site nothing
+checks is a fault nothing can inject; a scenario the runbook never names is
+a drill nobody runs; a flight-dump kind missing from OBSERVABILITY.md is a
+bundle the on-call can't interpret.
+
+  DRIFT601  registry/code/doc drift, project-scoped and armed only on a
+            full scan (faults.py in the scan set, repo root known):
+            - a ``SITES`` entry no ``faults.check(site)`` /
+              ``inject(..., site=...)`` literal ever names (dead site:
+              the boundary was removed but the registry kept the name);
+            - a literal site or kind at a ``check``/``inject`` call that
+              the registry does not declare (``check`` silently never
+              fires for unknown sites — worse than the loud ``inject``
+              error);
+            - a ``_KINDS`` kind or chaos ``SCENARIOS`` key that
+              RESILIENCE.md never mentions (word-boundary match,
+              anywhere in the doc);
+            - a literal flight ``trigger("kind")`` that OBSERVABILITY.md
+              never mentions (every dump kind needs a runbook entry).
+
+Dynamic sites/kinds (variables, f-strings) are invisible and silent, as
+everywhere else in mxlint.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, register
+from .summaries import dotted
+
+__all__ = ["FaultRegistryDrift"]
+
+FAULTS_FILE = "mxnet_tpu/resilience/faults.py"
+FLIGHT_FILE = "mxnet_tpu/telemetry/flight.py"
+CHAOS_FILE = "tools/chaos_check.py"
+RESILIENCE_DOC = "RESILIENCE.md"
+OBSERVABILITY_DOC = "OBSERVABILITY.md"
+
+#: receivers whose ``.trigger("kind")`` is the flight recorder
+_FLIGHT_RECEIVERS = {"flight", "_flight", "RECORDER"}
+
+
+def _doc_mentions(root: str, doc: str) -> Optional[str]:
+    path = os.path.join(root, doc)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def _mentioned(text: str, word: str) -> bool:
+    return re.search(r"(?<![A-Za-z0-9_])" + re.escape(word)
+                     + r"(?![A-Za-z0-9_])", text) is not None
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _module_assign(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for n in tree.body:
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return n.value
+    return None
+
+
+def _site_literals(node: Optional[ast.AST]) -> List[Tuple[str, ast.AST]]:
+    """String literals of a site argument: one string or a tuple/list."""
+    if node is None:
+        return []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    out = []
+    for e in elts:
+        v = _str_const(e)
+        if v is not None:
+            out.append((v, e))
+    return out
+
+
+@register
+class FaultRegistryDrift(Checker):
+    rule = "DRIFT601"
+    name = "fault-registry-drift"
+    scope = "project"
+    help = ("The fault registry (faults.SITES/_KINDS), its check()/"
+            "inject() call sites, the chaos_check SCENARIOS table, and "
+            "the runbooks must agree: no dead registry sites, no unknown "
+            "site/kind literals at call sites, every fault kind and chaos "
+            "scenario named in RESILIENCE.md, every flight trigger kind "
+            "named in OBSERVABILITY.md. Drift here means drills that "
+            "don't run and dumps nobody can interpret.")
+
+    def check_project(self, project) -> Iterable[Finding]:
+        if project.root is None or FAULTS_FILE not in project.files:
+            return
+        faults_src = project.files[FAULTS_FILE]
+        res_doc = _doc_mentions(project.root, RESILIENCE_DOC)
+        obs_doc = _doc_mentions(project.root, OBSERVABILITY_DOC)
+
+        sites_node = _module_assign(faults_src.tree, "SITES")
+        kinds_node = _module_assign(faults_src.tree, "_KINDS")
+        sites: Dict[str, ast.AST] = {}
+        if isinstance(sites_node, (ast.Tuple, ast.List)):
+            for e in sites_node.elts:
+                v = _str_const(e)
+                if v is not None:
+                    sites[v] = e
+        kinds: Dict[str, ast.AST] = {}
+        if isinstance(kinds_node, ast.Dict):
+            for k in kinds_node.keys:
+                v = _str_const(k)
+                if v is not None:
+                    kinds[v] = k
+
+        # -- sweep every function for check/inject/trigger call sites -------
+        used_sites: Set[str] = set()
+        site_refs: List[Tuple[str, object, ast.AST]] = []
+        kind_refs: List[Tuple[str, object, ast.AST]] = []
+        trigger_refs: List[Tuple[str, object, ast.AST]] = []
+        faults_quals = {info.qual: info.name
+                       for info in project.tables[FAULTS_FILE].all_functions
+                       if info.cls is None} if FAULTS_FILE in project.tables \
+            else {}
+        for info in project.sorted_functions():
+            if info.src is None:
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                tail = d.rsplit(".", 1)[-1]
+                if tail in ("check", "inject"):
+                    callee = project.resolve_call(info, node)
+                    is_faults = (callee is not None
+                                 and callee.qual in faults_quals) or \
+                        d.split(".")[-2:-1] == ["faults"]
+                    if not is_faults:
+                        continue
+                    if tail == "check":
+                        for v, n in _site_literals(
+                                node.args[0] if node.args else None):
+                            used_sites.add(v)
+                            site_refs.append((v, info.src, n))
+                    else:
+                        kv = _str_const(node.args[0]) if node.args else None
+                        if kv is not None:
+                            kind_refs.append((kv, info.src, node.args[0]))
+                        site_arg = node.args[1] if len(node.args) >= 2 \
+                            else None
+                        for k in node.keywords:
+                            if k.arg == "site":
+                                site_arg = k.value
+                        for v, n in _site_literals(site_arg):
+                            used_sites.add(v)
+                            site_refs.append((v, info.src, n))
+                elif tail == "trigger" and isinstance(node.func,
+                                                      ast.Attribute):
+                    recv = dotted(node.func.value).rsplit(".", 1)[-1]
+                    if recv not in _FLIGHT_RECEIVERS:
+                        continue
+                    v = _str_const(node.args[0]) if node.args else None
+                    if v is not None:
+                        trigger_refs.append((v, info.src, node.args[0]))
+
+        # -- registry -> call sites: dead entries ---------------------------
+        for name in sorted(sites):
+            if name not in used_sites:
+                yield faults_src.finding(
+                    self.rule, sites[name],
+                    f"fault site '{name}' is registered in faults.SITES "
+                    "but no check()/inject() call site names it: a dead "
+                    "site — the production boundary was removed (drop the "
+                    "entry) or its check() hook is missing")
+        # -- call sites -> registry: unknown literals -----------------------
+        if sites:
+            for name, src, node in site_refs:
+                if name not in sites:
+                    yield src.finding(
+                        self.rule, node,
+                        f"fault site '{name}' is not declared in "
+                        "faults.SITES: check() silently never fires here "
+                        "— register the site or fix the name")
+        if kinds:
+            for name, src, node in kind_refs:
+                if name not in kinds:
+                    yield src.finding(
+                        self.rule, node,
+                        f"fault kind '{name}' is not declared in "
+                        "faults._KINDS: inject() will raise at runtime — "
+                        "register the kind or fix the name")
+        # -- registry -> runbook: undocumented kinds ------------------------
+        if res_doc is not None:
+            for name in sorted(kinds):
+                if not _mentioned(res_doc, name):
+                    yield faults_src.finding(
+                        self.rule, kinds[name],
+                        f"fault kind '{name}' is injectable but "
+                        f"{RESILIENCE_DOC} never mentions it: operators "
+                        "can't drill what the runbook doesn't name — add "
+                        "it to the fault-kind catalog")
+        # -- chaos scenarios -> runbook -------------------------------------
+        if res_doc is not None and CHAOS_FILE in project.files:
+            chaos_src = project.files[CHAOS_FILE]
+            scen = _module_assign(chaos_src.tree, "SCENARIOS")
+            if isinstance(scen, ast.Dict):
+                for k in scen.keys:
+                    v = _str_const(k)
+                    if v is not None and not _mentioned(res_doc, v):
+                        yield chaos_src.finding(
+                            self.rule, k,
+                            f"chaos scenario '{v}' is gated in "
+                            f"chaos_check but {RESILIENCE_DOC} never "
+                            "mentions it: the drill exists, the runbook "
+                            "doesn't — document what the scenario "
+                            "exercises")
+        # -- flight triggers -> runbook -------------------------------------
+        if obs_doc is not None and FLIGHT_FILE in project.files:
+            seen: Set[Tuple[str, str]] = set()
+            for name, src, node in trigger_refs:
+                if _mentioned(obs_doc, name):
+                    continue
+                if (name, src.path) in seen:
+                    continue
+                seen.add((name, src.path))
+                yield src.finding(
+                    self.rule, node,
+                    f"flight trigger kind '{name}' dumps a bundle but "
+                    f"{OBSERVABILITY_DOC} never mentions it: the on-call "
+                    "finds a dump with no runbook entry — document the "
+                    "trigger")

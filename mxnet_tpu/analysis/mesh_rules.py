@@ -1,0 +1,346 @@
+"""Mesh/collective axis rules: the sharding counterpart of the TPU rules.
+
+Every in-program collective (``psum``/``all_to_all``/``ppermute``/...) and
+every ``PartitionSpec`` names mesh axes; XLA binds those names against the
+mesh the computation runs under. An axis the mesh never declared is a
+runtime error on the first dispatch — or worse, a silently replicated dim
+when a spec is built against the wrong mesh. The axis names are string
+literals and the meshes are built by ``parallel/mesh.py`` constructors with
+literal ``{axis: size}`` layouts, so the check is fully static:
+
+  MESH700  mesh/collective axis checking —
+           - a literal axis passed to a collective / ``P(...)`` /
+             ``mesh.sharding(...)`` / ``NamedSharding`` / ``shard_map``
+             spec must be declared by the innermost statically-known mesh
+             in scope (``make_mesh({...})`` / ``Mesh(arr, (...))`` bound
+             to a variable or entered via ``with``); carved-slice
+             sub-meshes (``make_mesh`` over a ``carve_slices`` slice)
+             declare only *their* axes — an axis of the outer mesh is not
+             in scope inside the slice;
+           - a spec naming the same axis twice shards one dim twice
+             (always an error, mesh or no mesh);
+           - a ``shard_map`` whose ``in_specs`` shard over an axis that
+             neither ``out_specs`` nor the (lexically resolvable) body
+             ever names returns partial per-shard values as if they were
+             the full result;
+           - a call to a helper whose summary says it runs collectives
+             over axis X (a meshless helper exports its axis needs) fires
+             at the call site, with the ``via:`` chain, when the mesh in
+             scope does not declare X.
+
+Everything dynamic — axis names from parameters, meshes from config —
+resolves to "unknown" and the rule stays silent: zero-noise, like the rest
+of the call-graph layer. Functions that build their own literal mesh are
+judged locally and export no axis requirements.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, SourceFile, register
+from .summaries import collective_axes, dotted
+
+__all__ = ["MeshAxisCheck"]
+
+_MESH_CTORS = {"make_mesh", "Mesh", "DeviceMesh"}
+_SPEC_FUNCS = {"P", "PartitionSpec", "shard_spec"}
+
+
+def _literal_axes_of_ctor(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Declared axis names of a mesh-constructor call, when literal.
+    ``make_mesh({"dp": 8, "tp": -1})`` -> ("dp", "tp");
+    ``Mesh(arr, ("dp", "tp"))`` -> ("dp", "tp"); None when dynamic."""
+    name = dotted(call.func).rsplit(".", 1)[-1]
+    if name == "make_mesh":
+        arg = call.args[0] if call.args else None
+        for k in call.keywords:
+            if k.arg == "axes":
+                arg = k.value
+        if isinstance(arg, ast.Dict) and all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in arg.keys):
+            return tuple(k.value for k in arg.keys)
+        return None
+    if name == "Mesh":
+        arg = call.args[1] if len(call.args) >= 2 else None
+        for k in call.keywords:
+            if k.arg == "axis_names":
+                arg = k.value
+        if isinstance(arg, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in arg.elts):
+            return tuple(e.value for e in arg.elts)
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return (arg.value,)
+        return None
+    if name == "DeviceMesh" and call.args and \
+            isinstance(call.args[0], ast.Call):
+        return _literal_axes_of_ctor(call.args[0])
+    return None
+
+
+def _spec_literals(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    """Literal axis strings of one PartitionSpec-style call (positional
+    entries, including tuple entries like ``P(("dp", "fsdp"), None)``)."""
+    out: List[Tuple[str, ast.AST]] = []
+    for a in call.args:
+        elts = a.elts if isinstance(a, (ast.Tuple, ast.List)) else [a]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e))
+    return out
+
+
+def _is_spec_call(call: ast.Call) -> bool:
+    return dotted(call.func).rsplit(".", 1)[-1] in _SPEC_FUNCS
+
+
+def _spec_axes_of_expr(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """Literal axes in an in_specs/out_specs expression: a spec call, or a
+    tuple/list/dict of them."""
+    out: List[Tuple[str, ast.AST]] = []
+    for sub in ast.walk(node) if not isinstance(node, ast.Call) else [node]:
+        if isinstance(sub, ast.Call) and _is_spec_call(sub):
+            out.extend(_spec_literals(sub))
+    if isinstance(node, ast.Call) and _is_spec_call(node):
+        out = _spec_literals(node)
+    return out
+
+
+class _MeshEnv:
+    """Statically known meshes of one lexical scope: variable bindings and
+    the with-stack. The innermost entered mesh governs — entering a carved
+    slice's mesh shadows the outer pod mesh, exactly like the runtime's
+    thread-local mesh stack."""
+
+    def __init__(self, inherited_vars: Optional[Dict[str, Optional[
+            Tuple[str, ...]]]] = None):
+        # name -> declared axes (None = a mesh whose axes we can't know)
+        self.vars: Dict[str, Optional[Tuple[str, ...]]] = dict(
+            inherited_vars or {})
+        self.stack: List[Optional[Tuple[str, ...]]] = []
+
+    def current(self) -> Optional[Tuple[str, ...]]:
+        """Axes of the innermost entered mesh, or None when no mesh is
+        statically in scope (or the innermost one is dynamic)."""
+        return self.stack[-1] if self.stack else None
+
+    def bind(self, name: str, axes: Optional[Tuple[str, ...]]):
+        self.vars[name] = axes
+
+
+class _ScopeScan:
+    """Walk one scope (module body or one function body, nested defs
+    excluded) tracking the mesh environment and yielding findings."""
+
+    def __init__(self, checker: "MeshAxisCheck", src: SourceFile, project,
+                 owner, env: _MeshEnv):
+        self.checker = checker
+        self.src = src
+        self.project = project
+        self.owner = owner          # FuncInfo for call resolution (or None)
+        self.env = env
+        self.findings: List[Finding] = []
+
+    # -- helpers -------------------------------------------------------------
+    def _mesh_of_expr(self, node: ast.AST) -> Tuple[bool, Optional[
+            Tuple[str, ...]]]:
+        """(is_mesh, axes) for an expression entering/naming a mesh."""
+        if isinstance(node, ast.Name) and node.id in self.env.vars:
+            return True, self.env.vars[node.id]
+        if isinstance(node, ast.Call) and \
+                dotted(node.func).rsplit(".", 1)[-1] in _MESH_CTORS:
+            return True, _literal_axes_of_ctor(node)
+        return False, None
+
+    def _fire(self, node: ast.AST, message: str):
+        self.findings.append(self.src.finding("MESH700", node, message))
+
+    def _check_axes(self, pairs: List[Tuple[str, ast.AST]],
+                    mesh: Optional[Tuple[str, ...]], what: str):
+        if mesh is not None:
+            for axis, node in pairs:
+                if axis not in mesh:
+                    self._fire(node,
+                               f"{what} names axis '{axis}' but the mesh "
+                               f"in scope declares only "
+                               f"{{{', '.join(mesh)}}}: the axis is "
+                               "unbound here — declare it on the mesh or "
+                               "fix the name")
+        seen: Set[str] = set()
+        for axis, node in pairs:
+            if what.startswith("spec") and axis in seen:
+                self._fire(node,
+                           f"{what} names axis '{axis}' twice: a "
+                           "PartitionSpec may shard over an axis at most "
+                           "once — one dim per mesh axis")
+            seen.add(axis)
+
+    # -- the walk ------------------------------------------------------------
+    def scan(self, body: List[ast.stmt]):
+        for stmt in body:
+            self._visit(stmt)
+        return self.findings
+
+    def _visit(self, node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return                  # separate scope / deferred execution
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            is_mesh, axes = self._mesh_of_expr(node.value)
+            if is_mesh:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.env.bind(tgt.id, axes)
+        if isinstance(node, ast.With):
+            entered = 0
+            for item in node.items:
+                self._visit(item.context_expr)
+                is_mesh, axes = self._mesh_of_expr(item.context_expr)
+                if is_mesh:
+                    self.env.stack.append(axes)
+                    entered += 1
+                    if item.optional_vars is not None and \
+                            isinstance(item.optional_vars, ast.Name):
+                        self.env.bind(item.optional_vars.id, axes)
+            for stmt in node.body:
+                self._visit(stmt)
+            del self.env.stack[len(self.env.stack) - entered:]
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _visit_call(self, call: ast.Call):
+        fname = dotted(call.func).rsplit(".", 1)[-1]
+        mesh = self.env.current()
+        # duplicate-axis check applies mesh or no mesh; undeclared-axis
+        # checks need a statically known mesh
+        if _is_spec_call(call):
+            self._check_axes(_spec_literals(call), mesh, "spec")
+            return
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "sharding":
+            recv_mesh = None
+            if isinstance(call.func.value, ast.Name) and \
+                    call.func.value.id in self.env.vars:
+                recv_mesh = self.env.vars[call.func.value.id]
+            self._check_axes(_spec_literals(call), recv_mesh or mesh,
+                             "spec")
+            return
+        if fname == "NamedSharding" and call.args:
+            recv_mesh = None
+            if isinstance(call.args[0], ast.Name) and \
+                    call.args[0].id in self.env.vars:
+                recv_mesh = self.env.vars[call.args[0].id]
+            pairs = []
+            for a in call.args[1:]:
+                if isinstance(a, ast.Call) and _is_spec_call(a):
+                    pairs.extend(_spec_literals(a))
+            self._check_axes(pairs, recv_mesh or mesh, "spec")
+            return
+        if fname == "shard_map":
+            self._visit_shard_map(call, mesh)
+            return
+        pairs = collective_axes(call)
+        if pairs:
+            self._check_axes(pairs, mesh, f"collective `{fname}`")
+            return
+        # interprocedural: a meshless helper's summary says which axes its
+        # collectives need — the caller's mesh must declare them
+        if mesh is None or self.owner is None or self.project is None:
+            return
+        callee = self.project.resolve_call(self.owner, call)
+        if callee is None or callee is self.owner or \
+                callee.summary is None:
+            return
+        for eff in callee.summary.axis_uses:
+            if eff.reason not in mesh:
+                chain = " -> ".join((callee.display,) + eff.chain)
+                self._fire(call,
+                           f"call to `{callee.display}()` runs a "
+                           f"collective over axis '{eff.reason}' (via: "
+                           f"{chain}, at {eff.site()}) but the mesh in "
+                           f"scope declares only {{{', '.join(mesh)}}}: "
+                           "the axis is unbound here — declare it on the "
+                           "mesh or pass the axis name through")
+
+    def _visit_shard_map(self, call: ast.Call, mesh: Optional[
+            Tuple[str, ...]]):
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        sm_mesh = mesh
+        mesh_arg = call.args[1] if len(call.args) >= 2 else kw.get("mesh")
+        if mesh_arg is not None:
+            is_mesh, axes = self._mesh_of_expr(mesh_arg)
+            if is_mesh and axes is not None:
+                sm_mesh = axes
+            elif is_mesh:
+                sm_mesh = None      # known mesh, unknown axes: stay silent
+        in_specs = kw.get("in_specs") or (
+            call.args[2] if len(call.args) >= 3 else None)
+        out_specs = kw.get("out_specs") or (
+            call.args[3] if len(call.args) >= 4 else None)
+        in_pairs = _spec_axes_of_expr(in_specs) if in_specs is not None \
+            else []
+        out_pairs = _spec_axes_of_expr(out_specs) if out_specs is not None \
+            else []
+        self._check_axes(in_pairs, sm_mesh, "shard_map in_specs spec")
+        self._check_axes(out_pairs, sm_mesh, "shard_map out_specs spec")
+        # in-not-out axes must be reduced over inside the body: otherwise
+        # each shard returns its partial value as if it were the total
+        body_fn = None
+        if call.args and self.owner is not None and self.project is not None:
+            from .summaries import _call_ref
+            ref = _call_ref(call.args[0], self.owner.lexical_defs())
+            if ref is not None:
+                body_fn = self.project.resolve_ref(self.owner, ref)
+        if body_fn is None or body_fn.node is None:
+            return
+        body_literals = {n.value for n in ast.walk(body_fn.node)
+                         if isinstance(n, ast.Constant)
+                         and isinstance(n.value, str)}
+        if body_fn.summary is not None:
+            body_literals |= {e.reason for e in body_fn.summary.axis_uses}
+        out_axes = {a for a, _ in out_pairs}
+        for axis, node in in_pairs:
+            if axis in out_axes or axis in body_literals:
+                continue
+            self._fire(node,
+                       f"shard_map in_specs shard over axis '{axis}' but "
+                       "out_specs do not keep it and the body "
+                       f"`{body_fn.display}` never names it in a "
+                       "collective: each shard's partial result is "
+                       "returned as if it were the full value — psum/"
+                       "all_gather over the axis or keep it in out_specs")
+
+
+@register
+class MeshAxisCheck(Checker):
+    rule = "MESH700"
+    name = "mesh-collective-axis-check"
+    help = ("A literal axis name handed to a collective (psum/all_to_all/"
+            "ppermute/...) or a PartitionSpec/NamedSharding/shard_map spec "
+            "must be declared by the statically-known mesh in scope "
+            "(make_mesh/Mesh literals, carved-slice sub-meshes included); "
+            "a spec may not name an axis twice; shard_map in_specs axes "
+            "must be reduced over or kept in out_specs. Fires through "
+            "helper calls whose summaries export axis requirements.")
+
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        if project is None:
+            return
+        table = project.tables.get(src.path)
+        if table is None:
+            return
+        # module scope first: its mesh variables are inherited by every
+        # function in the file (module globals are in lexical scope)
+        module_env = _MeshEnv()
+        module_stmts = [s for s in src.tree.body]
+        scan = _ScopeScan(self, src, project, None, module_env)
+        yield from scan.scan(module_stmts)
+        for info in table.all_functions:
+            env = _MeshEnv(inherited_vars=module_env.vars)
+            fscan = _ScopeScan(self, src, project, info, env)
+            yield from fscan.scan(info.node.body)

@@ -6,6 +6,16 @@ declared with a literal name that fails ``^mxtpu_[a-z0-9_]+$`` is caught by
 the linter before the code ever runs, instead of blowing up at import in
 the first process that touches the module. Non-literal names (f-strings,
 variables) are skipped — the runtime lint still owns those.
+
+MET301 guards label *cardinality*: a ``.labels(...)`` value built from an
+f-string, ``str(...)`` of a variable, or ``.format(...)`` mints a new time
+series per distinct value. When the underlying value is a request id, a
+tenant name, or a hash, the registry grows without bound and the scrape
+payload with it — the classic cardinality explosion. Literal strings and
+plain variables (assumed enum-like; the AST can't prove boundedness, so
+only the *constructions that advertise unboundedness* fire) pass. A value
+that is genuinely bounded (a padding-ladder bucket, a replica count)
+carries a line suppression stating the bound.
 """
 from __future__ import annotations
 
@@ -14,7 +24,7 @@ from typing import Iterable
 
 from .core import Checker, Finding, SourceFile, register
 
-__all__ = ["MetricNameLint"]
+__all__ = ["MetricNameLint", "MetricLabelCardinality"]
 
 # keep in sync with telemetry.metrics.METRIC_NAME_RE; re-declared literally
 # so the linter never imports the (jax-loading) telemetry package
@@ -58,3 +68,55 @@ class MetricNameLint(Checker):
                     "^mxtpu_[a-z0-9_]+$ — the registration call will raise "
                     "at import; namespace it mxtpu_ and use lowercase "
                     "snake_case")
+
+
+def _unbounded_label(node: ast.AST) -> str:
+    """Why this label-value expression advertises unbounded cardinality
+    ('' when it doesn't)."""
+    if isinstance(node, ast.JoinedStr) and any(
+            isinstance(v, ast.FormattedValue) for v in node.values):
+        return "an f-string"
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("str", "repr", "hex") and \
+                node.args and not isinstance(node.args[0], ast.Constant):
+            return f"`{f.id}()` of a runtime value"
+        if isinstance(f, ast.Attribute) and f.attr == "format" and \
+                isinstance(f.value, ast.Constant):
+            return "`.format()`"
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        return "%-formatting"
+    return ""
+
+
+@register
+class MetricLabelCardinality(Checker):
+    rule = "MET301"
+    name = "metric-label-cardinality"
+    help = ("A .labels(...) value built from an f-string / str() of a "
+            "runtime value / .format() mints one time series per distinct "
+            "value — unbounded for ids, names, hashes: the registry and "
+            "scrape payload grow forever. Use a literal enum value, bucket "
+            "the value first, or (when the value is provably bounded) "
+            "suppress on the line with the bound stated in a comment.")
+
+    def check(self, src: SourceFile, project=None) -> Iterable[Finding]:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (isinstance(func, ast.Attribute)
+                    and func.attr == "labels"):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                why = _unbounded_label(arg)
+                if why:
+                    yield src.finding(
+                        self.rule, arg,
+                        f"label value built from {why}: every distinct "
+                        "runtime value mints a new time series — a "
+                        "cardinality explosion for ids/names/hashes. Use "
+                        "a literal enum, bucket the value, or suppress "
+                        "with the bound stated")

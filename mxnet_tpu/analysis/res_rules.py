@@ -1,0 +1,139 @@
+"""Durable-write rules for the persistence subsystems.
+
+Every state file the resilience story depends on — checkpoints, the
+executable cache index, telemetry spools, the cost-model ledger snapshots —
+is written with the same idiom: write to a temp path in the same directory,
+flush (+fsync where loss matters), then ``os.replace`` onto the final name.
+A bare ``open(path, "w")`` at any of those sites tears on preemption: the
+reader sees a half-written JSON and the recovery path that was supposed to
+use it dies on a parse error. The idiom is visible in the AST, so:
+
+  RES900  non-atomic persistence write — a write-mode ``open()``
+          (``w``/``x``; append-mode JSONL ledgers are the sanctioned
+          exception) reachable in a persistence subsystem
+          (``resilience/``, ``cache/``, ``telemetry/``) whose function
+          neither calls ``os.replace``/``os.rename`` itself nor is
+          exclusively called by functions that do. The split idiom — a
+          ``_write_file(tmp)`` helper whose callers ``os.replace`` the
+          tmp into place — is recognized through the call graph: the
+          helper is *covered* when every resolved caller replaces (or is
+          itself covered), so only genuinely bare writes fire. Calls from
+          persistence code into an uncovered bare-writing helper outside
+          the subsystem fire at the call site with the ``via:`` chain.
+
+Code outside the persistence scopes writes however it likes (debug dumps,
+reports); durability is a property of the state files recovery reads.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .core import Checker, Finding, register
+from .summaries import MAX_CHAIN
+
+__all__ = ["NonAtomicPersistenceWrite"]
+
+#: subsystems whose files are recovery-read state: writes must be atomic
+PERSIST_SCOPES = ("mxnet_tpu/resilience/", "mxnet_tpu/cache/",
+                  "mxnet_tpu/telemetry/")
+
+
+def _in_scope(path: str) -> bool:
+    return any(path.startswith(s) for s in PERSIST_SCOPES)
+
+
+class _Anchor:
+    """Line anchor for findings built from summary call records (no AST
+    node survives into the serialized summaries)."""
+
+    def __init__(self, line: int, col: int = 0):
+        self.lineno = line
+        self.col_offset = col
+
+
+def _covered_set(project) -> Set[str]:
+    """Quals whose bare writes are absorbed by the atomic idiom: the
+    function ``os.replace``s itself, or every resolved caller is covered
+    (the tmp-writer helper whose callers all replace). Fixpoint, biased
+    toward silence: an unresolved caller leaves the callee uncovered only
+    if no resolved caller exists either."""
+    callers: Dict[str, Set[str]] = {}
+    infos = project.sorted_functions()
+    for info in infos:
+        if info.summary is None:
+            continue
+        for cs in info.summary.calls:
+            callee = project.resolve_ref(info, cs["ref"])
+            if callee is not None and callee is not info:
+                callers.setdefault(callee.qual, set()).add(info.qual)
+    covered: Set[str] = {info.qual for info in infos
+                         if info.summary is not None
+                         and info.summary.replaces}
+    changed = True
+    while changed:
+        changed = False
+        for info in infos:
+            q = info.qual
+            if q in covered:
+                continue
+            cs = callers.get(q)
+            if cs and all(c in covered for c in cs):
+                covered.add(q)
+                changed = True
+    return covered
+
+
+@register
+class NonAtomicPersistenceWrite(Checker):
+    rule = "RES900"
+    name = "non-atomic-persistence-write"
+    scope = "project"
+    help = ("A write-mode open() in a persistence subsystem (resilience/, "
+            "cache/, telemetry/) with no os.replace in sight — not in the "
+            "function, not in any caller: a preemption mid-write tears the "
+            "file and recovery dies reading it. Write tmp + flush/fsync + "
+            "os.replace (append-mode JSONL ledgers are exempt). Fires "
+            "through helpers via the bare-write summaries.")
+
+    def check_project(self, project) -> Iterable[Finding]:
+        covered = _covered_set(project)
+        for info in project.sorted_functions():
+            if info.src is None or info.summary is None:
+                continue
+            if not _in_scope(info.src.path) or info.qual in covered:
+                continue
+            # local bare writes fire at the open() line
+            for eff in info.summary.bare_writes:
+                if eff.chain or eff.path != info.src.path:
+                    continue      # lifted: reported via the call site below
+                yield info.src.finding(
+                    self.rule, _Anchor(eff.line),
+                    f"{eff.reason} in `{info.display}()` writes recovery-"
+                    "read state in place: a preemption mid-write tears the "
+                    "file and the restore path dies parsing it — write to "
+                    "a tmp path, flush (+fsync), then `os.replace` onto "
+                    "the final name (or open in append mode for JSONL "
+                    "ledgers)")
+            # calls into uncovered bare-writing helpers *outside* the
+            # persistence scopes fire here, with the chain (helpers inside
+            # the scopes report at their own open() lines above)
+            for cs in info.summary.calls:
+                callee = project.resolve_ref(info, cs["ref"])
+                if callee is None or callee is info or \
+                        callee.summary is None or callee.qual in covered:
+                    continue
+                if callee.src is not None and _in_scope(callee.src.path):
+                    continue
+                for eff in callee.summary.bare_writes:
+                    if len(eff.chain) >= MAX_CHAIN:
+                        continue
+                    chain = " -> ".join((callee.display,) + eff.chain)
+                    yield info.src.finding(
+                        self.rule, _Anchor(cs["line"], cs.get("col", 0)),
+                        f"call to `{callee.display}()` performs a non-"
+                        f"atomic write ({eff.reason}, via: {chain} at "
+                        f"{eff.site()}) on behalf of persistence code "
+                        f"`{info.display}()`: the written state can tear "
+                        "on preemption — route it through the tmp + "
+                        "`os.replace` idiom")
+                    break         # one finding per call site is plenty

@@ -24,6 +24,17 @@ A :class:`FunctionSummary` records, for one function:
                       callee + which params flow into which argument), the
                       edges summaries propagate over
   - ``wrap_sites``    ``<retryish>.run(fn)`` sites (EXC500's seed set)
+  - ``blocking``      operations that stall the calling thread no matter
+                      what (``time.sleep``, ``.join()``, ``.result()``,
+                      ``open()``, device syncs) — CONC202's
+                      blocking-under-lock summary
+  - ``bare_writes``   write-mode ``open()`` in a function that never calls
+                      ``os.replace``/``os.rename`` itself — RES900's
+                      non-atomic-persistence summary
+  - ``axis_uses``     literal mesh-axis names handed to in-program
+                      collectives (``psum``/``all_to_all``/...) in a
+                      function with no mesh of its own — the axes a caller
+                      must have declared in scope (MESH700)
 
 Every effect carries provenance — the ultimate source location plus the
 *via-chain* of function names it propagated through — so a finding reported
@@ -49,6 +60,7 @@ from .core import SourceFile
 
 __all__ = ["Effect", "ParamSpace", "FunctionSummary", "extract_file",
            "origins_of", "build_origin_map", "traced_params",
+           "blocking_reason", "open_write_mode", "collective_axes",
            "MAX_CHAIN"]
 
 #: via-chains longer than this stop growing (recursion guard; nobody debugs
@@ -88,6 +100,103 @@ def is_jit_decorator(dec: ast.AST) -> bool:
             return is_jit_decorator(dec.args[0])
         return False
     return dotted(dec).rsplit(".", 1)[-1] in ("jit", "pjit")
+
+
+# -- blocking / durable-write / collective-axis vocabulary ------------------
+# methods that park the calling thread; `.wait()` is deliberately absent
+# (Condition.wait releases the lock, so it is the one legal block-under-lock)
+_BLOCKING_SYNC_METHODS = {"block_until_ready", "result"}
+_DEVICE_FETCHERS = {"device_get"}
+#: in-program collectives: executing one requires the named axis to be
+#: bound by the mesh the surrounding computation runs under
+COLLECTIVE_FUNCS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                    "all_to_all", "ppermute", "psum_scatter", "axis_index",
+                    "all_reduce", "reduce_scatter"}
+_WRITE_MODE_RE_CHARS = ("w", "x")      # "a" (O_APPEND ledgers) is exempt
+
+
+def blocking_reason(call: ast.Call) -> Optional[str]:
+    """Why this call blocks the calling thread ('' reasons never happen:
+    None means it doesn't). Conservative by design: ``.join()`` only counts
+    with no arguments or a ``timeout`` (``str.join`` always takes the
+    iterable), and ``.wait()`` never counts (Condition.wait releases the
+    lock it was called under)."""
+    func = call.func
+    if dotted(func) == "time.sleep":
+        return "`time.sleep()`"
+    if isinstance(func, ast.Attribute):
+        if func.attr in _BLOCKING_SYNC_METHODS:
+            return f"`.{func.attr}()`"
+        if func.attr in _DEVICE_FETCHERS:
+            return f"`.{func.attr}()`"
+        if func.attr == "join":
+            if isinstance(func.value, ast.Constant):
+                return None        # "sep".join(...) — string joins
+            if not call.args and not call.keywords:
+                return "`.join()`"
+            if any(k.arg == "timeout" for k in call.keywords) or (
+                    len(call.args) == 1 and not call.keywords and
+                    isinstance(call.args[0], ast.Constant) and
+                    isinstance(call.args[0].value, (int, float,
+                                                    type(None)))):
+                return "`.join(timeout)`"
+            return None
+    elif isinstance(func, ast.Name):
+        if func.id in _DEVICE_FETCHERS:
+            return f"`{func.id}()`"
+        if func.id == "open":
+            return "file I/O (`open()`)"
+    if dotted(func) == "os.fdopen":
+        return "file I/O (`os.fdopen()`)"
+    return None
+
+
+def open_write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string when ``call`` is a write-mode ``open()`` /
+    ``os.fdopen()`` (``w``/``x`` flavors only — append-mode JSONL ledgers
+    are the sanctioned non-atomic write)."""
+    func = call.func
+    is_open = isinstance(func, ast.Name) and func.id == "open"
+    if not is_open and dotted(func) != "os.fdopen":
+        return None
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) and \
+            isinstance(call.args[1].value, str):
+        mode = call.args[1].value
+    for k in call.keywords:
+        if k.arg == "mode" and isinstance(k.value, ast.Constant) and \
+                isinstance(k.value.value, str):
+            mode = k.value.value
+    if mode and any(c in mode for c in _WRITE_MODE_RE_CHARS) and \
+            "a" not in mode:
+        return mode
+    return None
+
+
+def collective_axes(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    """Literal axis names an in-program collective call names: the second
+    positional arg / ``axis_name=`` of ``psum``-family calls, as a string
+    or a tuple/list of strings. Empty when dynamic (a parameter forwards
+    the axis) — the rules stay silent then."""
+    fname = dotted(call.func).rsplit(".", 1)[-1]
+    if fname not in COLLECTIVE_FUNCS:
+        return []
+    node = None
+    if len(call.args) >= 2:
+        node = call.args[1]
+    elif fname == "axis_index" and call.args:
+        node = call.args[0]
+    for k in call.keywords:
+        if k.arg == "axis_name":
+            node = k.value
+    if node is None:
+        return []
+    out: List[Tuple[str, ast.AST]] = []
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append((e.value, e))
+    return out
 
 
 def donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
@@ -336,7 +445,9 @@ class FunctionSummary:
     """Externally visible effects of one function (see module docstring)."""
 
     __slots__ = ("qual", "display", "sync_always", "sync_param",
-                 "branch_param", "donate_param", "calls", "wrap_sites")
+                 "branch_param", "donate_param", "calls", "wrap_sites",
+                 "blocking", "bare_writes", "axis_uses", "replaces",
+                 "has_mesh")
 
     def __init__(self, qual: str, display: str):
         self.qual = qual
@@ -347,6 +458,14 @@ class FunctionSummary:
         self.donate_param: Dict[int, List[Effect]] = {}
         self.calls: List[Dict] = []       # serializable call-site records
         self.wrap_sites: List[Dict] = []  # <retryish>.run(fn) records
+        self.blocking: List[Effect] = []       # thread-stalling ops (CONC202)
+        self.bare_writes: List[Effect] = []    # non-atomic writes (RES900)
+        self.axis_uses: List[Effect] = []      # collective axis names (MESH700)
+        self.replaces = False    # calls os.replace/os.rename itself (atomic
+        #                          writer: bare-write effects stop here)
+        self.has_mesh = False    # builds its own mesh (axis requirements
+        #                          stop here: the mesh in its scope binds
+        #                          whatever its helpers need)
 
     # -- merge with dedupe (returns True when something was added) ----------
     @staticmethod
@@ -372,7 +491,11 @@ class FunctionSummary:
                 "sync_param": tbl(self.sync_param),
                 "branch_param": tbl(self.branch_param),
                 "donate_param": tbl(self.donate_param),
-                "calls": self.calls, "wrap_sites": self.wrap_sites}
+                "calls": self.calls, "wrap_sites": self.wrap_sites,
+                "blocking": [e.to_dict() for e in self.blocking],
+                "bare_writes": [e.to_dict() for e in self.bare_writes],
+                "axis_uses": [e.to_dict() for e in self.axis_uses],
+                "replaces": self.replaces, "has_mesh": self.has_mesh}
 
     @classmethod
     def from_dict(cls, d: Dict) -> "FunctionSummary":
@@ -383,6 +506,11 @@ class FunctionSummary:
                               for k, v in d[name].items()})
         s.calls = d["calls"]
         s.wrap_sites = d["wrap_sites"]
+        for name in ("blocking", "bare_writes", "axis_uses"):
+            setattr(s, name,
+                    [Effect.from_dict(e) for e in d.get(name, ())])
+        s.replaces = bool(d.get("replaces", False))
+        s.has_mesh = bool(d.get("has_mesh", False))
         return s
 
     def digest(self) -> str:
@@ -448,6 +576,17 @@ class _Extractor:
         self.omap, self.seqs = build_origin_map(fn, space)
         # local donating callables: name -> donated positions
         self.donating: Dict[str, Tuple[int, ...]] = {}
+        # spans of nested defs/lambdas: deferred execution — the new
+        # always-effects (blocking / bare-write / axis-use) must not claim
+        # a closure's body runs when this function is called
+        self.nested_spans: List[Tuple[int, int]] = []
+        # functions that os.replace/os.rename themselves are the atomic
+        # tmp-writer idiom: their write-mode opens are the tmp files
+        self.replaces = False
+        # a function that builds its own literal mesh judges its collective
+        # axes locally (MESH700's file checker); only meshless helpers
+        # export axis requirements to their callers
+        self.has_local_mesh = False
         for node in ast.walk(fn):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call):
@@ -456,6 +595,24 @@ class _Extractor:
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             self.donating[tgt.id] = pos
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                self.nested_spans.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno)))
+            if isinstance(node, ast.Call):
+                callee = dotted(node.func)
+                if callee in ("os.replace", "os.rename", "shutil.move"):
+                    self.replaces = True
+                    summary.replaces = True
+                if callee.rsplit(".", 1)[-1] in ("make_mesh", "Mesh",
+                                                 "DeviceMesh"):
+                    self.has_local_mesh = True
+                    summary.has_mesh = True
+
+    def _deferred(self, node: ast.AST) -> bool:
+        line = getattr(node, "lineno", 0)
+        return any(lo <= line <= hi for lo, hi in self.nested_spans)
 
     def _ok(self, rule: str, node: ast.AST) -> bool:
         return not self.src.is_suppressed(rule, getattr(node, "lineno", 0))
@@ -526,6 +683,24 @@ class _Extractor:
                         s.add_param(s.donate_param, idx,
                                     Effect("donate", "donate_argnums",
                                            src.path, call.lineno))
+        # -- thread-blocking ops (CONC202) -----------------------------------
+        if not self._deferred(call):
+            reason = blocking_reason(call)
+            if reason is not None and self._ok("CONC202", call):
+                s._add(s.blocking,
+                       Effect("blocking", reason, src.path, call.lineno))
+            # -- non-atomic persistence writes (RES900) ----------------------
+            mode = open_write_mode(call)
+            if mode is not None and not self.replaces and \
+                    self._ok("RES900", call):
+                s._add(s.bare_writes,
+                       Effect("bare_write", f"`open(..., {mode!r})`",
+                              src.path, call.lineno))
+            # -- collective axis requirements (MESH700) ----------------------
+            if not self.has_local_mesh and self._ok("MESH700", call):
+                for axis, node in collective_axes(call):
+                    s._add(s.axis_uses,
+                           Effect("axis", axis, src.path, call.lineno))
         # -- RetryPolicy wrap sites (EXC500 seeds) ---------------------------
         if isinstance(func, ast.Attribute) and func.attr == "run" and \
                 call.args:
@@ -602,6 +777,29 @@ def _lift_callsite(caller, callee, cs: Dict, src_of) -> bool:
         for eff in cee.sync_always:
             if len(eff.chain) < MAX_CHAIN:
                 grew |= cal.add_always(eff.lifted(callee.display))
+    # the always-effects of the distributed-systems rules lift the same way
+    # (no parameter dependence): calling a blocker blocks, calling a bare
+    # writer persists non-atomically, calling a meshless collective user
+    # demands its axes from the caller's mesh
+    for rule, bucket_name in (("CONC202", "blocking"),
+                              ("RES900", "bare_writes"),
+                              ("MESH700", "axis_uses")):
+        src_bucket = getattr(cee, bucket_name)
+        if not src_bucket or suppressed(rule):
+            continue
+        if bucket_name == "bare_writes" and cal.replaces:
+            continue      # the split atomic-write idiom: the caller
+            #               replaces the tmp its helper wrote — the write
+            #               is durable from here up
+        if bucket_name == "axis_uses" and cal.has_mesh:
+            continue      # the caller builds its own mesh: whatever axes
+            #               its helpers collect over are (or aren't) bound
+            #               there — judged by the MESH700 file checker, not
+            #               re-exported to the caller's callers
+        dst_bucket = getattr(cal, bucket_name)
+        for eff in src_bucket:
+            if len(eff.chain) < MAX_CHAIN:
+                grew |= cal._add(dst_bucket, eff.lifted(callee.display))
     for j, rec in arg_records():
         if rec["origins"]:
             if not suppressed("TPU100"):

@@ -1,0 +1,218 @@
+"""Deadline-discipline rules for the request path.
+
+The tail-tolerance layer's contract (RESILIENCE.md: "the deadline rides
+every hop") is structural: the front door stamps a ``Deadline`` on each
+request, and every downstream wait — queue blocks, retry backoffs, replica
+health polls — clamps to ``deadline.remaining_*()`` so one slow hop cannot
+spend another hop's budget. Both ways to break it are syntactic:
+
+  TAIL800  deadline discipline on the request path —
+           (a) a function reachable from a request entry point
+               (``FrontDoor.submit`` / ``InferenceServer.predict`` /
+               ``generate`` / the decode scheduler's ``submit``, seeded
+               like EXC500 and closed over the call graph) calls
+               ``time.sleep(x)`` where ``x`` mentions no deadline/budget
+               value: the wait is unclamped — a request with 10ms left
+               sleeps the full backoff and times out downstream instead
+               of failing fast here;
+           (b) a request-path function that *has* a deadline in hand (a
+               ``deadline``-ish parameter, or a local built via
+               ``Deadline(...)``/``Deadline.at(...)``) calls a resolved
+               function that *accepts* a ``deadline``-ish parameter but
+               drops it (the call passes nothing into that slot): the
+               remaining budget stops propagating at this hop, so every
+               wait below is unclamped no matter how disciplined the
+               callee is.
+
+Off the request path, sleeps are fine (the autoscaler control loop, chaos
+tooling); dynamic sleeps that *mention* a deadline/remaining/budget value
+are assumed clamped — the rule checks the discipline, not the arithmetic.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .core import Checker, Finding, register
+from .summaries import dotted
+
+__all__ = ["DeadlineDiscipline"]
+
+#: request entry points: serving-layer methods where a request (and its
+#: deadline) enters the system
+_ENTRY_NAMES = {"submit", "predict", "generate", "enqueue"}
+_ENTRY_PATH_MARKERS = ("serving",)
+#: identifiers that signal a value is deadline-derived
+_DEADLINE_MARKERS = ("deadline", "remaining", "budget", "expiry")
+_MAX_DEPTH = 5
+
+
+def _is_entry(info) -> bool:
+    if info.cls is None or info.name not in _ENTRY_NAMES:
+        return False
+    path = info.src.path if info.src is not None else ""
+    return any(m in path for m in _ENTRY_PATH_MARKERS)
+
+
+def _deadlineish(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _DEADLINE_MARKERS)
+
+
+def _mentions_deadline(node: ast.AST) -> bool:
+    """True when any identifier inside ``node`` is deadline-derived —
+    ``min(backoff, deadline.remaining_ms() / 1000)`` passes, ``0.05``
+    does not."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _deadlineish(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _deadlineish(sub.attr):
+            return True
+    return False
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes belonging to this def (nested defs/lambdas excluded — they are
+    marked and scanned under their own qual)."""
+    stack = [fn]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _has_deadline_in_hand(info) -> bool:
+    """The function received or built a deadline it could propagate."""
+    if any(_deadlineish(n) for n in info.space.names):
+        return True
+    for node in _own_nodes(info.node):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            tail = dotted(node.value.func).rsplit(".", 2)
+            if tail[-1] == "Deadline" or \
+                    (len(tail) >= 2 and tail[-2] == "Deadline"):
+                return True
+    return False
+
+
+def _deadline_param(callee) -> Optional[Tuple[int, str]]:
+    """(index, name) of the callee's deadline-ish parameter, if any."""
+    for i, name in enumerate(callee.space.names):
+        if _deadlineish(name):
+            return i, name
+    return None
+
+
+def _call_passes(call: ast.Call, callee, idx: int, name: str) -> bool:
+    """Whether the call site feeds the callee's deadline slot (or is too
+    dynamic to judge — splats and deadline-mentioning args count as
+    passing; the rule only fires on a demonstrably dropped deadline)."""
+    if any(isinstance(a, ast.Starred) for a in call.args) or \
+            any(k.arg is None for k in call.keywords):
+        return True               # splats: can't see the slots — stay silent
+    for i, a in enumerate(call.args):
+        if callee.space.map_pos(i) == idx:
+            return True
+    for k in call.keywords:
+        if k.arg == name:
+            return True
+    # a request/context object that *carries* the deadline counts as
+    # propagation even when the explicit slot stays default
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        if _mentions_deadline(a):
+            return True
+    return False
+
+
+@register
+class DeadlineDiscipline(Checker):
+    rule = "TAIL800"
+    name = "deadline-discipline"
+    scope = "project"
+    help = ("On the request path (reachable from FrontDoor.submit / "
+            "server predict/generate / decode submit), a `time.sleep()` "
+            "whose duration mentions no deadline/remaining/budget value is "
+            "an unclamped wait, and a call that drops an in-hand deadline "
+            "on the floor (the callee accepts `deadline=` but the call "
+            "never feeds it) stops budget propagation. Clamp sleeps to "
+            "`deadline.remaining_*()` and pass the deadline through every "
+            "hop.")
+
+    def check_project(self, project) -> Iterable[Finding]:
+        marked: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        infos = project.sorted_functions()
+        for info in infos:
+            if _is_entry(info):
+                marked.setdefault(info.qual, (info.display, ()))
+        frontier = sorted(marked)
+        depth = 0
+        while frontier and depth < _MAX_DEPTH:
+            nxt: List[str] = []
+            for qual in frontier:
+                info = project.by_qual.get(qual)
+                if info is None or info.summary is None:
+                    continue
+                entry, chain = marked[qual]
+                for cs in info.summary.calls:
+                    callee = project.resolve_ref(info, cs["ref"])
+                    if callee is None or callee.qual in marked:
+                        continue
+                    marked[callee.qual] = (entry,
+                                           chain + (info.display,))
+                    nxt.append(callee.qual)
+            frontier = nxt
+            depth += 1
+        for qual in sorted(marked):
+            info = project.by_qual.get(qual)
+            if info is None or info.src is None:
+                continue
+            entry, chain = marked[qual]
+            via = ""
+            if chain:      # chain[0] is the entry point itself
+                via = f" (reached via: {' -> '.join(chain)} -> " \
+                      f"{info.display})"
+            yield from self._check_function(project, info, entry, via)
+
+    def _check_function(self, project, info, entry: str,
+                        via: str) -> Iterable[Finding]:
+        src = info.src
+        has_deadline = _has_deadline_in_hand(info)
+        for node in _own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a) unclamped sleeps on the request path
+            if dotted(node.func) == "time.sleep" and node.args and \
+                    not any(_mentions_deadline(a) for a in node.args):
+                yield src.finding(
+                    self.rule, node,
+                    f"`time.sleep()` on the request path from "
+                    f"`{entry}` does not clamp to the propagated "
+                    f"deadline{via}: a nearly-expired request sleeps the "
+                    "full duration and times out downstream — bound the "
+                    "wait by `deadline.remaining_ms()` (or fail fast "
+                    "when already expired)")
+                continue
+            # (b) deadline dropped at a hop
+            if not has_deadline:
+                continue
+            callee = project.resolve_call(info, node)
+            if callee is None or callee is info or callee.space is None:
+                continue
+            slot = _deadline_param(callee)
+            if slot is None:
+                continue
+            idx, pname = slot
+            if _call_passes(node, callee, idx, pname):
+                continue
+            yield src.finding(
+                self.rule, node,
+                f"`{info.display}()` holds a deadline but calls "
+                f"`{callee.display}()` without feeding its `{pname}=` "
+                f"parameter{via}: budget propagation stops at this hop, "
+                "so every wait below runs unclamped — pass the deadline "
+                "through")

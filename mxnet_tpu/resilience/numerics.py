@@ -751,9 +751,12 @@ class NumericsGuard:
                     "loss": rec.loss_v, "grad_norm": rec.gnorm_v,
                     "finite": rec.finite_v, "injected": rec.injected,
                     "wall_time": time.time()}
-            with open(os.path.join(self.quarantine_dir,
-                                   f"quarantine-{stamp}.json"), "w") as f:
+            meta_path = os.path.join(self.quarantine_dir,
+                                     f"quarantine-{stamp}.json")
+            tmp = f"{meta_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
                 json.dump(meta, f, sort_keys=True)
+            os.replace(tmp, meta_path)
 
     def _rewind(self, window: Sequence[_StepRecord]):
         """Restore the last good checkpoint and fast-forward the loader past
@@ -915,8 +918,11 @@ class NumericsGuard:
                 "pre_digest": pre_digest,
                 "opt_arities": [len(_tree_leaves(st)) for st in snap["opt"]],
                 "repro": self.repro_meta, "wall_time": time.time()}
-        with open(os.path.join(path, "meta.json"), "w") as f:
+        meta_path = os.path.join(path, "meta.json")
+        tmp = f"{meta_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(meta, f, sort_keys=True, indent=1)
+        os.replace(tmp, meta_path)
         return path
 
     # ------------------------------------------------------------------

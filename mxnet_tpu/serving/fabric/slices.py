@@ -99,5 +99,6 @@ def plan_slices(sizes: Sequence[int], devices=None,
     for sp in specs:
         by_size[sp.capacity] = by_size.get(sp.capacity, 0) + 1
     for size, count in by_size.items():
-        _SLICES_G.labels(str(size)).set(count)
+        # bounded: slice capacities are divisors of the device count
+        _SLICES_G.labels(str(size)).set(count)  # mxlint: disable=MET301
     return specs

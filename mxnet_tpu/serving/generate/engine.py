@@ -288,7 +288,9 @@ class DecodeEndpoint:
                 for a in self._param_datas())
             with telemetry.span("serving.compile", endpoint=self.name,
                                 bucket=bucket, kind=kind):
-                comp = _ledger.lower_and_compile(
+                # compile-once gate (see ModelEndpoint._get_executable):
+                # contenders need this executable and wait for it either way
+                comp = _ledger.lower_and_compile(  # mxlint: disable=CONC202
                     jfn, (param_sds,) + arg_sds,
                     site=f"decode_{kind}",
                     key=self._cost_key(kind, bucket))

@@ -103,7 +103,9 @@ class StepCostEWMA:
         if self.name is None:
             return
         try:
-            _EST_G.labels(self.name, str(bucket)).set(value)
+            # bounded: buckets come from the fixed padding ladder
+            _EST_G.labels(
+                self.name, str(bucket)).set(value)  # mxlint: disable=MET301
         except Exception:
             pass
 

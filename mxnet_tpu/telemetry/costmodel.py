@@ -589,7 +589,9 @@ def make_prior(site: str, key_fn: Callable[[int], Dict[str, Any]]
             if v is None:
                 return None
             _PRIOR_USED.labels(site).inc()
-            _PREDICTED_G.labels(site, str(bucket)).set(v)
+            # bounded: buckets come from the fixed padding ladder
+            _PREDICTED_G.labels(
+                site, str(bucket)).set(v)  # mxlint: disable=MET301
             return v
         except Exception:
             return None
@@ -672,7 +674,9 @@ def _maybe_record_step(site, key, bucket, measured_us, rows):
 def _observe_residual(site: str, bucket: int, prior_us: float,
                       measured_us: float):
     ratio = measured_us / prior_us
-    _RESIDUAL_G.labels(site, str(bucket)).set(ratio)
+    # bounded: buckets come from the fixed padding ladder
+    _RESIDUAL_G.labels(
+        site, str(bucket)).set(ratio)  # mxlint: disable=MET301
     fire = None
     with _LOCK:
         st = _RESIDUALS.get(site)

@@ -272,7 +272,10 @@ class FlightRecorder:
                 path = os.path.join(
                     d, f"flight-{stamp}-{next(self._seq):04d}-{slug}.json")
             tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
+            # _dump_lock exists solely to serialize bundle IO + rotation
+            # (the ring-buffer lock is separate and stays free): blocking
+            # here only queues other dumpers, which is its purpose
+            with open(tmp, "w") as f:  # mxlint: disable=CONC202
                 f.write(payload)
             os.replace(tmp, path)
             self.bundles_written.append(path)

@@ -1142,3 +1142,534 @@ def test_incremental_cache_perf_guard(tmp_path):
     assert min(warm_times) < cold_s, (
         f"warm scan {min(warm_times):.2f}s not faster than cold "
         f"{cold_s:.2f}s")
+
+
+# ---------------------------------------------------------------------------
+# MESH700 — mesh/collective axis checking
+# ---------------------------------------------------------------------------
+MESH700_BAD = '''
+import jax
+def run(x):
+    with make_mesh({"data": 8, "model": 4}):
+        return jax.lax.psum(x, "pipeline")
+def spec():
+    return P("dp", "dp")
+'''
+
+MESH700_FIXED = '''
+import jax
+def run(x):
+    with make_mesh({"data": 8, "model": 4}):
+        return jax.lax.psum(x, "model")
+def spec():
+    return P("dp", "tp")
+def dynamic(x, axis):
+    with make_mesh({"data": 8}):
+        return jax.lax.psum(x, axis)
+'''
+
+
+def test_mesh700_fires_on_undeclared_and_duplicate_axes():
+    fs = lint(MESH700_BAD)
+    assert codes(fs) == ["MESH700"] * 2
+    assert "axis 'pipeline'" in fs[0].message
+    assert "declares only {data, model}" in fs[0].message
+    assert "names axis 'dp' twice" in fs[1].message
+
+
+def test_mesh700_declared_and_dynamic_axes_silent():
+    assert lint(MESH700_FIXED) == []
+
+
+def test_mesh700_carved_slice_shadows_outer_mesh():
+    bad = ('import jax\n'
+           'def run(x):\n'
+           '    with make_mesh({"dp": 8, "tp": 4}):\n'
+           '        with make_mesh({"tp": 4}):\n'
+           '            return jax.lax.psum(x, "dp")\n')
+    fs = lint(bad)
+    assert codes(fs) == ["MESH700"]
+    assert "declares only {tp}" in fs[0].message
+    fixed = ('import jax\n'
+             'def run(x):\n'
+             '    with make_mesh({"dp": 8, "tp": 4}):\n'
+             '        y = jax.lax.psum(x, "dp")\n'
+             '        with make_mesh({"tp": 4}):\n'
+             '            y = jax.lax.psum(y, "tp")\n'
+             '        return y\n')
+    assert lint(fixed) == []
+
+
+MESH700_IP = '''
+import jax
+def _shard_helper(x):
+    return jax.lax.psum(x, "model")
+def run(x):
+    with make_mesh({"data": 8}):
+        return _shard_helper(x)
+'''
+
+
+def test_mesh700_interprocedural_via_chain():
+    # the helper is meshless, so it exports its axis requirement; the
+    # caller's mesh does not declare it -> fires at the call site
+    fs = lint(MESH700_IP)
+    assert codes(fs) == ["MESH700"]
+    assert "call to `_shard_helper()` runs a collective over axis " \
+        "'model'" in fs[0].message
+    assert "via: _shard_helper, at fixture.py:4" in fs[0].message
+
+
+def test_mesh700_interprocedural_silent_when_declared_or_self_meshed():
+    fixed = MESH700_IP.replace('{"data": 8}', '{"data": 8, "model": 4}')
+    assert lint(fixed) == []
+    # a helper that builds its own literal mesh is judged locally and
+    # exports no axis requirements to its callers
+    self_meshed = ('import jax\n'
+                   'def _self_meshed(x):\n'
+                   '    with make_mesh({"model": 4}):\n'
+                   '        return jax.lax.psum(x, "model")\n'
+                   'def run(x):\n'
+                   '    with make_mesh({"data": 8}):\n'
+                   '        return _self_meshed(x)\n')
+    assert lint(self_meshed) == []
+
+
+def test_mesh700_shard_map_in_not_out_unreduced():
+    bad = ('def body(x):\n'
+           '    return x * 2\n'
+           'def run(arr):\n'
+           '    with make_mesh({"dp": 8}) as m:\n'
+           '        return shard_map(body, m, in_specs=P("dp"),\n'
+           '                         out_specs=P(None))(arr)\n')
+    fs = lint(bad)
+    assert codes(fs) == ["MESH700"]
+    assert "shard_map in_specs shard over axis 'dp'" in fs[0].message
+    assert "`body` never names it" in fs[0].message
+    fixed = bad.replace("def body(x):\n    return x * 2",
+                        "import jax\ndef body(x):\n"
+                        "    return jax.lax.psum(x, \"dp\")")
+    assert lint(fixed) == []
+
+
+# ---------------------------------------------------------------------------
+# TAIL800 — deadline discipline on the request path
+# ---------------------------------------------------------------------------
+TAIL800_BAD = '''
+import time
+class FrontDoor:
+    def submit(self, req):
+        return self._dispatch(req)
+    def _dispatch(self, req):
+        return self._backoff(req)
+    def _backoff(self, req):
+        time.sleep(0.2)
+        return req
+'''
+
+TAIL800_FIXED = '''
+import time
+class FrontDoor:
+    def submit(self, req, deadline):
+        return self._dispatch(req, deadline)
+    def _dispatch(self, req, deadline):
+        return self._backoff(req, deadline)
+    def _backoff(self, req, deadline):
+        time.sleep(min(0.2, deadline.remaining_ms() / 1000.0))
+        return req
+def maintenance_loop():
+    time.sleep(30.0)
+'''
+
+
+def test_tail800_unclamped_sleep_two_hops_deep():
+    fs = lint(TAIL800_BAD, name="mxnet_tpu/serving/front_fixture.py")
+    assert codes(fs) == ["TAIL800"]
+    assert "does not clamp to the propagated deadline" in fs[0].message
+    assert ("reached via: FrontDoor.submit -> FrontDoor._dispatch -> "
+            "FrontDoor._backoff") in fs[0].message
+
+
+def test_tail800_clamped_sleep_and_off_path_sleep_silent():
+    # the clamped sleep mentions the deadline; the maintenance loop is not
+    # reachable from a request entry point
+    assert lint(TAIL800_FIXED,
+                name="mxnet_tpu/serving/front_fixture.py") == []
+    # same code outside the serving layer has no request entry points
+    assert lint(TAIL800_BAD, name="mxnet_tpu/engine/loop_fixture.py") == []
+
+
+TAIL800_DROP = '''
+class Scheduler:
+    def submit(self, req, deadline):
+        return self._hop(req, deadline)
+    def _hop(self, req, deadline):
+        return _wait_slot(req)
+def _wait_slot(req, deadline=None):
+    return req
+'''
+
+
+def test_tail800_deadline_dropped_at_hop():
+    fs = lint(TAIL800_DROP, name="mxnet_tpu/serving/sched_fixture.py")
+    assert codes(fs) == ["TAIL800"]
+    assert ("`Scheduler._hop()` holds a deadline but calls `_wait_slot()` "
+            "without feeding its `deadline=` parameter") in fs[0].message
+    assert "reached via: Scheduler.submit -> Scheduler._hop" \
+        in fs[0].message
+
+
+def test_tail800_deadline_passed_through_silent():
+    fixed = TAIL800_DROP.replace("_wait_slot(req)",
+                                 "_wait_slot(req, deadline)")
+    assert lint(fixed, name="mxnet_tpu/serving/sched_fixture.py") == []
+
+
+# ---------------------------------------------------------------------------
+# CONC202 — blocking under lock
+# ---------------------------------------------------------------------------
+CONC202_BAD = '''
+import threading
+import time
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def tick(self):
+        with self._lock:
+            time.sleep(0.1)
+'''
+
+CONC202_IP = '''
+import threading
+import time
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def flush(self):
+        with self._lock:
+            self._drain()
+    def _drain(self):
+        time.sleep(0.1)
+'''
+
+CONC202_FIXED = '''
+import threading
+import time
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.snap = None
+    def tick(self):
+        with self._lock:
+            snap = self.snap
+        time.sleep(0.1)
+        return snap
+    def wait_ready(self):
+        with self._cond:
+            self._cond.wait()
+'''
+
+
+def test_conc202_fires_on_sleep_under_lock():
+    fs = lint(CONC202_BAD)
+    assert codes(fs) == ["CONC202"]
+    assert "while `Pool`'s lock is held in `tick()`" in fs[0].message
+
+
+def test_conc202_helper_sleeps_under_callers_lock():
+    # the sleep lives in the helper; the lock is held by the caller — the
+    # finding lands at the call site with the chain to the blocking op
+    fs = lint(CONC202_IP)
+    assert codes(fs) == ["CONC202"]
+    assert "call to `Pool._drain()` blocks (`time.sleep()`" \
+        in fs[0].message
+    assert "via: Pool._drain at fixture.py:11" in fs[0].message
+    assert fs[0].line == 9          # the call site, not the sleep
+
+
+def test_conc202_snapshot_then_block_and_cond_wait_silent():
+    # blocking after release is the fix; Condition.wait() releases the
+    # lock and is exempt by vocabulary
+    assert lint(CONC202_FIXED) == []
+
+
+# ---------------------------------------------------------------------------
+# RES900 — non-atomic persistence writes
+# ---------------------------------------------------------------------------
+RES900_BAD = '''
+import json
+def save_state(path, state):
+    with open(path, "w") as f:
+        json.dump(state, f)
+'''
+
+RES900_FIXED = '''
+import json
+import os
+def _write_tmp(tmp, state):
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+def save_state(path, state):
+    tmp = path + ".tmp"
+    _write_tmp(tmp, state)
+    os.replace(tmp, path)
+def append_event(path, ev):
+    with open(path, "a") as f:
+        f.write(ev)
+'''
+
+
+def test_res900_fires_on_bare_write_in_persistence_scope():
+    fs = lint(RES900_BAD, name="mxnet_tpu/resilience/store_fixture.py")
+    assert codes(fs) == ["RES900"]
+    assert "`open(..., 'w')` in `save_state()` writes recovery-read " \
+        "state in place" in fs[0].message
+    assert fs[0].line == 4
+
+
+def test_res900_split_tmp_writer_and_append_mode_silent():
+    # the tmp-writer helper is covered because its only caller
+    # os.replace()s; append-mode JSONL ledgers are the sanctioned
+    # non-atomic write
+    assert lint(RES900_FIXED,
+                name="mxnet_tpu/resilience/store_fixture.py") == []
+
+
+def test_res900_outside_persistence_scopes_silent():
+    assert lint(RES900_BAD, name="mxnet_tpu/engine/report_fixture.py") == []
+
+
+def test_res900_cross_file_via_chain(tmp_path):
+    (tmp_path / "mxnet_tpu" / "resilience").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "util").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "resilience" / "store.py").write_text(
+        "from mxnet_tpu.util.dump import write_json\n"
+        "def persist(path, state):\n"
+        "    return write_json(path, state)\n")
+    (tmp_path / "mxnet_tpu" / "util" / "dump.py").write_text(
+        "import json\n"
+        "def write_json(path, state):\n"
+        "    with open(path, \"w\") as f:\n"
+        "        json.dump(state, f)\n")
+    root = str(tmp_path)
+    fs = analysis.lint_paths([root], root=root, rules=["RES900"])
+    assert [(f.rule, f.path, f.line) for f in fs] == \
+        [("RES900", "mxnet_tpu/resilience/store.py", 3)]
+    assert "call to `write_json()` performs a non-atomic write" \
+        in fs[0].message
+    assert "via: write_json at mxnet_tpu/util/dump.py:3" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# DRIFT601 — fault/chaos/flight registry drift
+# ---------------------------------------------------------------------------
+def _drift_tree(tmp_path, fixed=False):
+    (tmp_path / "mxnet_tpu" / "resilience").mkdir(parents=True)
+    (tmp_path / "mxnet_tpu" / "telemetry").mkdir(parents=True)
+    (tmp_path / "tools").mkdir()
+    sites = '("step",)' if fixed else '("step", "ghost_site")'
+    (tmp_path / "mxnet_tpu" / "resilience" / "faults.py").write_text(
+        f"SITES = {sites}\n"
+        '_KINDS = {"device_lost": "d", "undocumented_kind": "u"}\n'
+        "def check(site):\n"
+        "    return None\n"
+        "def inject(kind, site=None, rate=1.0):\n"
+        "    return None\n")
+    check_site = '"step"' if fixed else '"typo_site"'
+    extra = "" if fixed else '    faults.inject("bogus_kind", site="step")\n'
+    (tmp_path / "mxnet_tpu" / "train.py").write_text(
+        "from mxnet_tpu.resilience import faults\n"
+        "_flight = None\n"
+        "def run_step():\n"
+        '    faults.check("step")\n'
+        f"    faults.check({check_site})\n"
+        '    faults.inject("device_lost", site="step")\n'
+        + extra +
+        "def boom():\n"
+        '    _flight.trigger("undocumented_trigger")\n')
+    (tmp_path / "mxnet_tpu" / "telemetry" / "flight.py").write_text(
+        "class FlightRecorder:\n"
+        "    def trigger(self, kind):\n"
+        "        return kind\n")
+    (tmp_path / "tools" / "chaos_check.py").write_text(
+        'SCENARIOS = {"decode": None, "mystery": None}\n')
+    res = "Kinds: device_lost. Sites: step. Scenarios: decode."
+    obs = "Flight bundles: none documented yet."
+    if fixed:
+        res += " Also undocumented_kind and the mystery drill."
+        obs += " Trigger kinds: undocumented_trigger."
+    (tmp_path / "RESILIENCE.md").write_text(res + "\n")
+    (tmp_path / "OBSERVABILITY.md").write_text(obs + "\n")
+    return str(tmp_path)
+
+
+def test_drift601_catches_every_drift_direction(tmp_path):
+    root = _drift_tree(tmp_path)
+    fs = analysis.lint_paths([root], root=root, rules=["DRIFT601"])
+    assert codes(fs) == ["DRIFT601"] * 6
+    msgs = "\n".join(f.message for f in fs)
+    assert "fault site 'ghost_site' is registered in faults.SITES" in msgs
+    assert "fault site 'typo_site' is not declared" in msgs
+    assert "fault kind 'bogus_kind' is not declared" in msgs
+    assert ("fault kind 'undocumented_kind' is injectable but "
+            "RESILIENCE.md never mentions it") in msgs
+    assert "chaos scenario 'mystery'" in msgs
+    assert "flight trigger kind 'undocumented_trigger'" in msgs
+
+
+def test_drift601_silent_when_registries_and_docs_agree(tmp_path):
+    root = _drift_tree(tmp_path, fixed=True)
+    assert analysis.lint_paths([root], root=root, rules=["DRIFT601"]) == []
+
+
+def test_drift601_disarmed_without_the_registry(tmp_path):
+    # partial scans (no faults.py in the set) never false-fire dead-site
+    (tmp_path / "a.py").write_text(
+        "def run(faults):\n"
+        '    faults.check("anything_at_all")\n')
+    root = str(tmp_path)
+    assert analysis.lint_paths([root], root=root, rules=["DRIFT601"]) == []
+
+
+# ---------------------------------------------------------------------------
+# MET301 — metric label cardinality
+# ---------------------------------------------------------------------------
+MET301_BAD = '''
+def export(metric, rid, route, x):
+    metric.labels(f"replica-{rid}").set(1)
+    metric.labels(str(rid)).set(1)
+    metric.labels("host", route="{}".format(x)).set(1)
+'''
+
+MET301_FIXED = '''
+def export(metric):
+    metric.labels("decode").set(1)
+    metric.labels("p50", route="health").set(1)
+'''
+
+
+def test_met301_fires_on_unbounded_label_values():
+    fs = lint(MET301_BAD)
+    assert codes(fs) == ["MET301"] * 3
+    assert "an f-string" in fs[0].message
+    assert "`str()` of a runtime value" in fs[1].message
+    assert "`.format()`" in fs[2].message
+
+
+def test_met301_literal_labels_silent():
+    assert lint(MET301_FIXED) == []
+
+
+def test_met301_line_suppression_with_stated_bound():
+    src = ('def f(m, rid):\n'
+           '    # bounded: rids recycle within the replica cap\n'
+           '    m.labels(str(rid)).set(1)  # mxlint: disable=MET301\n')
+    assert lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# ruleset digest: a new rule is a guaranteed cold scan
+# ---------------------------------------------------------------------------
+def test_ruleset_digest_invalidates_warm_cache(tmp_path):
+    """A cache written before a rule existed must never replay: the tool
+    key embeds a digest of every checker's source, so registering a new
+    rule (or editing one) forces re-analysis of every cached file."""
+    import ast as _ast
+    from mxnet_tpu.analysis import core as _core
+    (tmp_path / "a.py").write_text("def f(x):\n    return x\n")
+    cache = str(tmp_path / "cache.json")
+    root = str(tmp_path)
+    assert analysis.lint_paths([root], root=root, cache_path=cache) == []
+    assert analysis.lint_paths([root], root=root, cache_path=cache) == []
+    assert _core.LAST_SCAN_STATS["cache_hits"] == ["a.py"]
+
+    class _Dummy(_core.Checker):
+        rule = "TST999"
+        name = "digest-test-only"
+        help = "fires on any function named f"
+
+        def check(self, src, project=None):
+            for node in _ast.walk(src.tree):
+                if isinstance(node, _ast.FunctionDef) and node.name == "f":
+                    yield src.finding(self.rule, node, "dummy hit")
+
+    _core.register(_Dummy)
+    try:
+        # were the cache replayed, the TST999 finding could never appear:
+        # a stale-clean report from a pre-rule cache
+        fs = analysis.lint_paths([root], root=root, cache_path=cache)
+        assert codes(fs) == ["TST999"]
+        assert _core.LAST_SCAN_STATS["checked"] == ["a.py"]
+    finally:
+        del _core._CHECKERS["TST999"]
+    # restoring the registry moves the digest back: cold once, warm after
+    assert analysis.lint_paths([root], root=root, cache_path=cache) == []
+    assert _core.LAST_SCAN_STATS["checked"] == ["a.py"]
+    assert analysis.lint_paths([root], root=root, cache_path=cache) == []
+    assert _core.LAST_SCAN_STATS["cache_hits"] == ["a.py"]
+
+
+# ---------------------------------------------------------------------------
+# pre-commit wiring: changed-only == full scan for the edited file
+# ---------------------------------------------------------------------------
+def test_changed_only_matches_full_scan_for_edited_file(tmp_path):
+    repo = tmp_path / "r"
+    _git_repo(repo, {"a.py": "def f(x):\n    return x\n",
+                     "pool.py": "def g(x):\n    return x\n"})
+    (repo / "pool.py").write_text(CONC202_BAD)
+    full = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--no-cache",
+        str(repo)).stdout)["findings"]
+    co = json.loads(_run_mxlint(
+        "--json", "--no-baseline", "--no-cache", "--changed-only", "HEAD",
+        "--", str(repo)).stdout)["findings"]
+    assert co and co == [f for f in full if f["path"].endswith("pool.py")]
+    assert {f["rule"] for f in co} == {"CONC202"}
+
+
+def test_precommit_script_gates_the_working_tree():
+    """tools/precommit.sh = the committed hook entry point: changed-only
+    scan vs HEAD, SARIF on stdout, mxlint's exit status."""
+    r = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "precommit.sh"), "HEAD"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+        env={k: v for k, v in os.environ.items() if k != "PYTHONPATH"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no scanned files changed" in r.stdout or '"runs"' in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# v3 warm-gate budget: the new families must not break the warm path
+# ---------------------------------------------------------------------------
+def test_v3_warm_gate_within_budget_of_pre_v3(tmp_path):
+    """The v3 families ride the existing fixpoint and per-file cache; the
+    warm gate (everything cached, only project passes re-run) must stay
+    within 1.5x of the same scan with the v3 checkers unregistered."""
+    from mxnet_tpu.analysis import core as _core
+    paths = [os.path.join(REPO, p) for p in analysis.DEFAULT_SCAN_SET]
+    v3 = ("CONC202", "DRIFT601", "MESH700", "MET301", "RES900", "TAIL800")
+    saved = {r: _core._CHECKERS.pop(r) for r in v3}
+    try:
+        cache = str(tmp_path / "pre.json")
+        analysis.lint_paths(paths, root=REPO, cache_path=cache)
+        pre_warm = []
+        for _ in range(2):
+            analysis.lint_paths(paths, root=REPO, cache_path=cache)
+            assert _core.LAST_SCAN_STATS["checked"] == []
+            pre_warm.append(_core.LAST_SCAN_STATS["wall_s"])
+    finally:
+        _core._CHECKERS.update(saved)
+    cache = str(tmp_path / "v3.json")
+    analysis.lint_paths(paths, root=REPO, cache_path=cache)
+    v3_warm = []
+    for _ in range(2):
+        analysis.lint_paths(paths, root=REPO, cache_path=cache)
+        assert _core.LAST_SCAN_STATS["checked"] == []
+        v3_warm.append(_core.LAST_SCAN_STATS["wall_s"])
+    budget = 1.5 * max(min(pre_warm), 0.05)
+    assert min(v3_warm) <= budget, (
+        f"v3 warm gate {min(v3_warm):.3f}s exceeds 1.5x the pre-v3 warm "
+        f"wall {min(pre_warm):.3f}s")

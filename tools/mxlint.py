@@ -4,9 +4,12 @@ The CI gate for the invariants STATIC_ANALYSIS.md catalogs: host syncs
 under a trace (TPU100), traced-value control flow (TPU101), use-after-
 donate (TPU102) — all three firing through helper/method indirection with a
 ``via:``-chain — unlocked shared mutation (CONC200), lock-order cycles
-(CONC201), metric-name hygiene (MET300), thread lifecycle (THR400),
-classification-swallowing excepts (EXC500), and code-vs-docs config drift
-(ENV600).
+(CONC201), blocking under a lock (CONC202), metric-name hygiene (MET300),
+metric-label cardinality (MET301), thread lifecycle (THR400),
+classification-swallowing excepts (EXC500), code-vs-docs config drift
+(ENV600), mesh/collective axis checking (MESH700), request-path deadline
+discipline (TAIL800), non-atomic persistence writes (RES900), and
+fault/chaos/flight registry drift (DRIFT601).
 
     # gate: scan the default set, fail on anything not in the baseline
     python tools/mxlint.py --check

@@ -1,0 +1,14 @@
+#!/bin/sh
+# Pre-commit hook: lint only the files changed vs a ref (default HEAD),
+# emitting SARIF on stdout alongside the text report. Wire it up either
+# via .pre-commit-config.yaml (the committed config runs this script) or
+# directly:
+#
+#     ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+#
+# Exit status is mxlint's: 0 when the changed files introduce nothing new
+# vs the committed baseline, 1 otherwise. Outside a git checkout the scan
+# silently widens to the full default set (mxlint's own fallback).
+set -eu
+exec python "$(dirname "$0")/mxlint.py" --changed-only "${1:-HEAD}" \
+    --sarif -
