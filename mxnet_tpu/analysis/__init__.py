@@ -30,6 +30,10 @@ Static enforcement of the invariants the rest of the stack is built on
           tmp+fsync+os.replace idiom (split-helper aware)
   DRIFT601 fault/chaos/flight registry drift: SITES/kinds vs call sites
           vs chaos scenarios vs the RESILIENCE/OBSERVABILITY runbooks
+  IR1000-IR1005 hlolint (:mod:`.ir`): IR-level rules over the compile
+          ledger's StableHLO corpus — dropped donation, baked-in weights,
+          f32 creep, host round-trips, collective/mesh mismatch, bucket
+          duplication (``mxlint --ir``; live guard via MXNET_IR_GUARD)
 
 v2 analyzes the scan set as one program: project symbol table + call graph
 (:mod:`.callgraph`), per-function effect summaries propagated to a fixpoint
@@ -65,6 +69,8 @@ from . import mesh_rules   # noqa: F401  (MESH700)
 from . import tail_rules   # noqa: F401  (TAIL800)
 from . import res_rules    # noqa: F401  (RES900)
 from . import drift_rules  # noqa: F401  (DRIFT601)
+from . import ir           # noqa: F401  (IR1000..IR1005 — hlolint)
+from .ir import lint_ir_paths
 
 __all__ = [
     "Checker", "Finding", "SourceFile", "register",
@@ -72,9 +78,17 @@ __all__ = [
     "lint_file", "lint_paths", "LAST_SCAN_STATS",
     "apply_baseline", "load_baseline", "save_baseline",
     "to_sarif", "VERSION", "DEFAULT_SCAN_SET",
+    "lint_ir_paths", "DEFAULT_IR_SCAN_SET",
 ]
 
 #: what `python tools/mxlint.py` scans when given no paths: the package
 #: itself plus the operational CLIs that ride along with it in CI
 DEFAULT_SCAN_SET = ("mxnet_tpu", "tools/chaos_check.py",
                     "tools/metrics_dump.py", "tools/mxlint.py")
+
+#: what ``mxlint --ir`` scans when given no corpus directories: the
+#: committed fixture ledgers — the costmodel corpus (records only, no
+#: retained texts: exercises the missing-text tolerance) and the hlolint
+#: clean corpus (retained texts that must stay silent)
+DEFAULT_IR_SCAN_SET = ("tests/fixtures/costmodel/ledger",
+                       "tests/fixtures/hlolint/clean")

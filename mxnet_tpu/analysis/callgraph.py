@@ -182,8 +182,11 @@ class Project:
     """The scan set as one program: files, symbols, summaries, resolution."""
 
     def __init__(self, sources: Sequence[SourceFile],
-                 root: Optional[str] = None):
+                 root: Optional[str] = None, partial: bool = False):
         self.root = root
+        # a git-scoped subset of the scan set, not the whole program:
+        # cross-artifact drift rules (ENV600/DRIFT601) must not arm
+        self.partial = partial
         self.files: Dict[str, SourceFile] = {s.path: s for s in sources}
         self.modules: Dict[str, ModuleTable] = {}
         self.tables: Dict[str, ModuleTable] = {}   # by path

@@ -340,7 +340,8 @@ def lint_file(filename: str, rules: Optional[Sequence[str]] = None,
 
 def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
                root: Optional[str] = None,
-               cache_path: Optional[str] = None) -> List[Finding]:
+               cache_path: Optional[str] = None,
+               partial: bool = False) -> List[Finding]:
     """Lint every python file under ``paths`` as one program — the
     whole-scan entry point.
 
@@ -348,6 +349,9 @@ def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
     content and dependency summaries are unchanged replay their findings
     without re-analysis (see :mod:`.cache`); the report is identical to a
     cold scan either way. ``LAST_SCAN_STATS`` records the split.
+    ``partial`` marks a git-scoped subset scan (``--changed-only``): the
+    cross-artifact drift rules (ENV600/DRIFT601) stay disarmed, since
+    "token not found in the scanned code" is meaningless against a subset.
     """
     import time
     from .callgraph import Project
@@ -367,7 +371,7 @@ def lint_paths(paths: Sequence[str], rules: Optional[Sequence[str]] = None,
         except SyntaxError as e:
             findings.append(_mx000(filename, root, e))
 
-    project = Project(sources, root=root)
+    project = Project(sources, root=root, partial=partial)
     cached_summaries: Dict[str, Dict] = {}
     if cache is not None:
         for path in sorted(project.files):

@@ -104,7 +104,8 @@ class FaultRegistryDrift(Checker):
             "don't run and dumps nobody can interpret.")
 
     def check_project(self, project) -> Iterable[Finding]:
-        if project.root is None or FAULTS_FILE not in project.files:
+        if project.root is None or FAULTS_FILE not in project.files \
+                or getattr(project, "partial", False):
             return
         faults_src = project.files[FAULTS_FILE]
         res_doc = _doc_mentions(project.root, RESILIENCE_DOC)

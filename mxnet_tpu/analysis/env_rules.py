@@ -43,7 +43,10 @@ DOC_FILES = ("README.md", "OBSERVABILITY.md", "RESILIENCE.md",
 #: by ``config.describe()`` and PERF.md
 KNOB_SCOPES = ("mxnet_tpu/serving/", "mxnet_tpu/resilience/",
                "mxnet_tpu/telemetry/")
-#: presence of this file marks a full scan (the ENV600 arming condition)
+#: presence of this file marks a full scan (the ENV600 arming condition);
+#: a scan flagged ``project.partial`` (git-scoped --changed-only) never
+#: arms even when a diff happens to include it — "not found in the
+#: scanned code" is meaningless against a subset
 GATE_FILE = "mxnet_tpu/config.py"
 
 _KNOB_FULL = re.compile(r"^MXNET_[A-Z0-9_]*[A-Z0-9]$")
@@ -104,7 +107,8 @@ class ConfigDocDrift(Checker):
             "are dashboard holes.")
 
     def check_project(self, project) -> Iterable[Finding]:
-        if project.root is None or GATE_FILE not in project.files:
+        if project.root is None or GATE_FILE not in project.files \
+                or getattr(project, "partial", False):
             return
         docs = _DocIndex(project.root)
         if not docs.docs:

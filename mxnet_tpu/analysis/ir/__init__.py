@@ -1,0 +1,40 @@
+"""mxnet_tpu.analysis.ir — hlolint: IR-level rules over compiled programs.
+
+mxlint v1–v3 exhausted the Python-AST surface; the bugs that still bite are
+only visible in the *compiled program*. This package analyzes the
+canonicalized StableHLO text the compile ledger already produces (PR 10)
+and now retains beside the JSONL records — no MLIR dependency, pure-stdlib
+text parsing (:mod:`.parser`), so ``mxlint --ir`` runs in the same bare
+python as the rest of the linter.
+
+Rules (catalog + rationale in STATIC_ANALYSIS.md):
+
+  IR000   retained module text whose content no longer hashes to its
+          filename fingerprint (corrupt corpus)
+  IR1000  donation requested but dropped by XLA (silent 2x-HBM)
+  IR1001  weight-sized dense constant baked into a serving/train program
+  IR1002  f32 dot/conv inside a bf16/f16/int8-declared program
+  IR1003  infeed/outfeed/host-callback custom_call on the serving path
+  IR1004  replica_groups contradicting the module's or trigger key's mesh
+  IR1005  bucket ladders re-compiling one module per integer dimension
+
+Findings ride the existing Finding/fingerprint/baseline/SARIF machinery,
+anchored to the CompileRecord's site + trigger key. Two consumers:
+``tools/mxlint.py --ir [DIR]`` offline over a ledger corpus, and the
+opt-in live guard (:mod:`.guard`) inside
+``compile_ledger.lower_and_compile`` (MXNET_IR_GUARD=warn|raise).
+"""
+from __future__ import annotations
+
+from .parser import IRModule, canonicalize, fingerprint
+from .corpus import (CompiledProgram, Corpus, IRChecker, lint_corpus,
+                     lint_ir_paths)
+from .guard import IRGuardError, live_findings
+from . import rules        # noqa: F401  (registers IR1000..IR1005)
+
+__all__ = [
+    "IRModule", "canonicalize", "fingerprint",
+    "CompiledProgram", "Corpus", "IRChecker",
+    "lint_corpus", "lint_ir_paths",
+    "IRGuardError", "live_findings",
+]

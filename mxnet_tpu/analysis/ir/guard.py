@@ -1,0 +1,74 @@
+"""Live IR guard: the two rules cheap and grave enough to run per compile.
+
+The offline ``mxlint --ir`` scan finds everything after the fact; this
+module is the subset ``compile_ledger.lower_and_compile`` consults *at
+compile time* (opt-in via MXNET_IR_GUARD=warn|raise) so a dropped donation
+or a baked-in parameter block can never ship silently:
+
+  IR1000  donation requested but no alias survived lowering — a regex count
+          over the entry signature, microseconds on top of a compile that
+          took seconds;
+  IR1001  weight-sized dense constant in a non-eager program — one full
+          parse of text the ledger already holds in memory.
+
+Policy (modes, metrics, flight events, fail-open error handling) lives in
+:mod:`mxnet_tpu.telemetry.compile_ledger` next to the rest of the
+instrumentation; this module is pure mechanism so the analysis package
+stays importable without jax or telemetry.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from . import parser as irparser
+from .rules import BakedWeightsChecker, _fmt_bytes
+
+__all__ = ["IRGuardError", "live_findings"]
+
+
+class IRGuardError(RuntimeError):
+    """Raised (MXNET_IR_GUARD=raise) when a just-compiled program violates a
+    guarded IR rule. Carries the findings as ``(rule, message)`` pairs."""
+
+    def __init__(self, findings: List[Tuple[str, str]], site: str):
+        self.findings = list(findings)
+        self.site = site
+        rules = ",".join(sorted({r for r, _ in findings}))
+        super().__init__(
+            f"IR guard: compile at site={site} violates {rules}: "
+            + "; ".join(m for _, m in findings))
+
+
+def live_findings(text: Optional[str], *, site: str,
+                  donation: Optional[Dict] = None,
+                  check_constants: bool = True) -> List[Tuple[str, str]]:
+    """Guarded-rule violations for one just-compiled program, as
+    ``(rule, message)`` pairs. ``donation`` is the record's
+    ``{"requested": n, "aliased": m}`` summary (already computed for the
+    ledger, so IR1000 costs nothing extra); ``check_constants=False`` skips
+    the IR1001 parse for callers that only want the donation assertion."""
+    out: List[Tuple[str, str]] = []
+    if donation:
+        requested = int(donation.get("requested", 0) or 0)
+        aliased = donation.get("aliased")
+        # aliased absent = lowered text unavailable: no evidence, no fire
+        if requested > 0 and isinstance(aliased, int) and aliased == 0:
+            out.append((
+                "IR1000",
+                f"buffer donation requested for {requested} argument(s) "
+                "but dropped by XLA — no input/output alias survived "
+                "lowering; this executable holds donated inputs and "
+                "outputs live (~2x working set)"))
+    if check_constants and text and not site.startswith("eager"):
+        thr = BakedWeightsChecker.const_max_bytes
+        mod = irparser.IRModule(text)
+        for const in mod.constants:
+            if const.nbytes is not None and const.nbytes >= thr:
+                shape = "x".join(str(d) for d in const.shape)
+                out.append((
+                    "IR1001",
+                    f"dense constant tensor<{shape}x{const.dtype}> "
+                    f"({_fmt_bytes(const.nbytes)}) baked into the "
+                    "executable — params captured by closure instead of "
+                    "passed as arguments"))
+    return out

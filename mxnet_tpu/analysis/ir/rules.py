@@ -1,0 +1,340 @@
+"""hlolint rules IR1000–IR1005: what the compiled program proves.
+
+These six are the bugs mxlint's Python layer structurally cannot see —
+each one is only decidable *after* XLA lowering, on the StableHLO module
+and its CompileRecord:
+
+  IR1000  donation requested, not honored: the record says donate_argnums
+          asked for buffer reuse, the entry function carries no
+          tf.aliasing_output / jax.buffer_donor — XLA dropped every alias
+          and the program holds input AND output buffers live (the silent
+          2x-HBM bug; jax only warns, once, at lower time)
+  IR1001  weights baked into the executable: a dense constant above the
+          byte threshold inside a serving/train program — params captured
+          by closure instead of passed as arguments (the PR 11 lesson:
+          such executables can't share weight buffers, re-compile per
+          checkpoint, and bloat the exec cache)
+  IR1002  f32 creep: dot/conv ops computing entirely in f32/f64 inside a
+          program whose trigger key declared bf16/f16/int8 — the cast got
+          lost somewhere and the MXU runs at half rate
+  IR1003  host round-trip on the serving path: infeed/outfeed/send/recv or
+          a host-callback custom_call inside a latency-budgeted program —
+          every execution blocks on PCIe
+  IR1004  collectives that contradict the topology: replica_groups with
+          duplicate members or members outside the module's device count,
+          or a group program whose trigger key declares a different mesh
+          size than the module was partitioned for
+  IR1005  bucket duplication: many per-bucket programs that are the same
+          module modulo integer literals — quantified shape-polymorphism
+          candidates, cross-checked against the ledger's own dup-waste
+          counter (ROADMAP item 4's refit-vs-rebucket decision input)
+
+Thresholds live as class attributes so tests (and future knobs) can tune
+them without editing rule logic.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding, register
+from .corpus import Corpus, CompiledProgram, IRChecker, mesh_size_from_key
+from .parser import HOST_OPS
+
+__all__ = []
+
+#: sites whose programs sit on a request latency budget — IR1003's scope
+_SERVING_SITE_PREFIXES = ("serving", "decode", "fabric")
+
+#: trigger-key dtypes that declare a reduced-precision program
+_LOW_PRECISION = frozenset(("bfloat16", "bf16", "float16", "f16",
+                            "int8", "i8", "fp8", "f8"))
+
+#: custom_call targets that mean "leave the device": jax host callbacks
+#: and explicit transfer ops. A denylist, not an allowlist — sharding
+#: annotations (@Sharding, @SPMDFullToShardShape, ...) are device-side.
+_HOST_TARGET_RE = re.compile(
+    r"callback|infeed|outfeed|host|send|recv", re.IGNORECASE)
+
+
+def _is_serving_site(site: str) -> bool:
+    return site.startswith(_SERVING_SITE_PREFIXES)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f} {unit}"
+    return f"{n} B"
+
+
+@register
+class DonationDroppedChecker(IRChecker):
+    rule = "IR1000"
+    name = "donation-dropped"
+    help = ("Buffer donation was requested for this compile "
+            "(donate_argnums) but the lowered entry function carries no "
+            "tf.aliasing_output / jax.buffer_donor attribute: XLA dropped "
+            "every alias, so input and output buffers are both held live — "
+            "the silent 2x-HBM bug. jax emits a single lower-time warning "
+            "and nothing at run time; the record is the only durable "
+            "evidence.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        for prog in corpus.programs:
+            for rec in prog.records:
+                don = rec.get("donation")
+                if not isinstance(don, dict):
+                    continue
+                requested = int(don.get("requested", 0) or 0)
+                aliased = don.get("aliased")
+                # aliased absent means the lowered text was unavailable at
+                # compile time: no evidence either way, stay silent
+                if requested > 0 and isinstance(aliased, int) and \
+                        aliased == 0:
+                    yield prog.finding(
+                        self.rule,
+                        f"donation of {requested} argument(s) requested "
+                        "but the compiled program aliases none of them — "
+                        "XLA dropped the donation and this executable "
+                        "holds donated inputs AND outputs live (~2x the "
+                        "working set). Usual causes: donated dtype/shape "
+                        "differs from every output, or the donated value "
+                        "is still read after the call site",
+                        snippet=f"donation requested={requested} aliased=0")
+                    break       # one finding per program, not per record
+
+
+@register
+class BakedWeightsChecker(IRChecker):
+    rule = "IR1001"
+    name = "baked-in-weights"
+    #: dense constants at or above this size are "weights", not tuning
+    #: tables — 64 KiB clears every iota/transcendental lookup jax emits
+    const_max_bytes = 64 * 1024
+
+    help = ("A dense constant of weight-like size is embedded in a "
+            "serving/train program: parameters were captured by closure "
+            "instead of passed as arguments. The executable cannot share "
+            "weight buffers across replicas, must recompile on every "
+            "checkpoint, and bloats the persistent exec cache — the "
+            "params-as-arguments lesson (PR 11), now checked on the "
+            "artifact instead of the source.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        for prog in corpus.programs:
+            mod = prog.module
+            if mod is None or prog.site.startswith("eager"):
+                continue
+            for const in mod.constants:
+                if const.nbytes is not None and \
+                        const.nbytes >= self.const_max_bytes:
+                    shape = "x".join(str(d) for d in const.shape)
+                    yield prog.finding(
+                        self.rule,
+                        f"dense constant tensor<{shape}x{const.dtype}> "
+                        f"({_fmt_bytes(const.nbytes)}) baked into the "
+                        "executable — weight-sized data should be an "
+                        "argument, not a closure capture",
+                        line=const.line,
+                        snippet=f"constant {shape}x{const.dtype}")
+
+
+@register
+class DtypeUpcastChecker(IRChecker):
+    rule = "IR1002"
+    name = "dtype-upcast"
+    help = ("dot/convolution ops computing entirely in f32/f64 inside a "
+            "program whose trigger key declares a reduced precision "
+            "(bf16/f16/int8): a cast was dropped on the way to the matmul "
+            "and the MXU runs at a fraction of its rated throughput while "
+            "doubling activation memory. Mixed operands (bf16 in, f32 "
+            "accumulate) are the intended pattern and stay silent.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        for prog in corpus.programs:
+            mod = prog.module
+            if mod is None:
+                continue
+            declared = str(prog.key.get("dtype", "")).lower()
+            if declared not in _LOW_PRECISION:
+                continue
+            for op in mod.ops:
+                if op.name not in ("dot_general", "dot", "convolution"):
+                    continue
+                operand_dtypes = [t[1] for t in op.operand_types]
+                if operand_dtypes and \
+                        all(d in ("f32", "f64") for d in operand_dtypes):
+                    yield prog.finding(
+                        self.rule,
+                        f"stablehlo.{op.name} computes entirely in "
+                        f"{'/'.join(sorted(set(operand_dtypes)))} but the "
+                        f"trigger key declares dtype={declared} — a "
+                        "downcast was lost and this contraction runs at "
+                        "full precision",
+                        line=op.line,
+                        snippet=f"{op.name} "
+                                f"{'x'.join(sorted(set(operand_dtypes)))}")
+
+
+@register
+class HostRoundTripChecker(IRChecker):
+    rule = "IR1003"
+    name = "host-round-trip"
+    help = ("infeed/outfeed/send/recv or a host-callback custom_call "
+            "inside a serving-path program (serving_*/decode_*/fabric_* "
+            "sites): every execution of this bucket blocks on a device-to-"
+            "host round trip, which no amount of batching amortizes. "
+            "Debug callbacks left in a decode step are the classic "
+            "instance. Sharding-annotation custom_calls are device-side "
+            "and stay silent.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        for prog in corpus.programs:
+            mod = prog.module
+            if mod is None or not _is_serving_site(prog.site):
+                continue
+            for op in mod.ops:
+                if op.name in HOST_OPS:
+                    yield prog.finding(
+                        self.rule,
+                        f"stablehlo.{op.name} in a serving-path program — "
+                        "a host transfer on the request latency budget",
+                        line=op.line, snippet=op.name)
+                elif op.name == "custom_call" and op.custom_target and \
+                        _HOST_TARGET_RE.search(op.custom_target):
+                    yield prog.finding(
+                        self.rule,
+                        f"host-side custom_call @{op.custom_target} in a "
+                        "serving-path program — every execution round-"
+                        "trips to the host (a debug callback left in the "
+                        "compiled graph?)",
+                        line=op.line,
+                        snippet=f"custom_call @{op.custom_target}")
+
+
+@register
+class CollectiveTopologyChecker(IRChecker):
+    rule = "IR1004"
+    name = "collective-topology"
+    help = ("Collectives that contradict the topology they run on: "
+            "replica_groups with duplicate members or members outside the "
+            "module's num_partitions*num_replicas device count (XLA "
+            "rejects or, worse, wraps these at run time), or a program "
+            "whose trigger key declares a mesh of a different size than "
+            "the module was partitioned for — the key lies about what the "
+            "executable does, so routing/cost decisions keyed on it are "
+            "wrong. Single-device all_reduce with a truthful key is a "
+            "legitimate degenerate shard_map and stays silent.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        for prog in corpus.programs:
+            mod = prog.module
+            if mod is None or not mod.collectives:
+                continue
+            devices = mod.device_count
+            key_mesh = mesh_size_from_key(prog.key)
+            if key_mesh is not None and key_mesh != devices:
+                yield prog.finding(
+                    self.rule,
+                    f"trigger key declares a {key_mesh}-device mesh but "
+                    f"the module is compiled for {devices} device(s) "
+                    f"(num_partitions={mod.num_partitions}, num_replicas="
+                    f"{mod.num_replicas}) and contains collectives — the "
+                    "ledger key misdescribes this executable's topology",
+                    snippet=f"key mesh={key_mesh} module devices={devices}")
+            for op in mod.collectives:
+                for g in (op.replica_groups or []):
+                    if len(set(g)) != len(g):
+                        yield prog.finding(
+                            self.rule,
+                            f"stablehlo.{op.name} replica_groups contain a "
+                            f"duplicate participant ({g}) — the collective "
+                            "is malformed",
+                            line=op.line,
+                            snippet=f"{op.name} dup group member")
+                    elif g and max(g) >= devices:
+                        yield prog.finding(
+                            self.rule,
+                            f"stablehlo.{op.name} replica_groups reference "
+                            f"device {max(g)} but the module is compiled "
+                            f"for {devices} device(s) — participants "
+                            "outside the topology",
+                            line=op.line,
+                            snippet=f"{op.name} member>{devices - 1}")
+                    elif key_mesh == 1 and len(g) > 1:
+                        yield prog.finding(
+                            self.rule,
+                            f"stablehlo.{op.name} group spans {len(g)} "
+                            "participants but the trigger key declares a "
+                            "single-device mesh",
+                            line=op.line,
+                            snippet=f"{op.name} group>{1}")
+
+
+_INT_RE = re.compile(r"(?<![\w.])\d+(?![\w.])")
+_HEX_PAYLOAD_RE = re.compile(r'dense<"0x[0-9A-Fa-f]+">')
+_TENSOR_SPEC_RE = re.compile(r"tensor<([^<>]*)>")
+_DIGITS_RE = re.compile(r"\d+")
+
+
+def _shape_normalize(text: str) -> str:
+    """Erase every dimension and integer literal: tensor-type dims
+    (``tensor<8x16xf32>`` — glued to ``x``, so a word-boundary pass alone
+    misses them), standalone integers (slice bounds, bucket sizes), and
+    raw constant payloads. Two programs identical under this map differ
+    only in shapes — the shape-polymorphism candidate."""
+    text = _HEX_PAYLOAD_RE.sub('dense<"0x.."', text)
+    text = _TENSOR_SPEC_RE.sub(
+        lambda m: "tensor<" + _DIGITS_RE.sub("N", m.group(1)) + ">", text)
+    return _INT_RE.sub("N", text)
+
+
+@register
+class BucketDuplicationChecker(IRChecker):
+    rule = "IR1005"
+    name = "bucket-duplication"
+    #: how many same-shape-modulo-integers variants before the compile
+    #: ladder is flagged: the serving default (pow2_buckets up to 32 -> 6
+    #: buckets) is deliberate and stays silent; runaway per-length ladders
+    #: are not
+    min_variants = 8
+
+    help = ("Many compiled programs at one site are the same module modulo "
+            "integer literals — a bucket ladder re-lowering and re-"
+            "compiling one program per shape. Each variant re-spends full "
+            "compile wall time the ledger has already quantified; the "
+            "group is the measured candidate set for shape polymorphism "
+            "(dynamic dims / fewer, coarser buckets). Fires only above "
+            "the serving stack's own default ladder size.")
+
+    def check_corpus(self, corpus: Corpus) -> Iterable[Finding]:
+        groups: Dict[Tuple[str, str, str], List[CompiledProgram]] = {}
+        for prog in corpus.programs:
+            if prog.text is None:
+                continue
+            gkey = (prog.site, str(prog.key.get("endpoint", "")),
+                    _shape_normalize(prog.text))
+            groups.setdefault(gkey, []).append(prog)
+        for (site, endpoint, _), progs in sorted(
+                groups.items(), key=lambda kv: kv[1][0].path):
+            if len(progs) < self.min_variants:
+                continue
+            head, rest = progs[0], progs[1:]
+            respent = sum(
+                float(r.get("lower_s", 0) or 0) +
+                float(r.get("compile_s", 0) or 0)
+                for p in rest for r in p.records)
+            exact_dups = sum(1 for p in progs for r in p.records
+                             if r.get("duplicate"))
+            where = f"site={site}" + (f" endpoint={endpoint}"
+                                      if endpoint else "")
+            yield head.finding(
+                self.rule,
+                f"{len(progs)} compiled variants at {where} are the same "
+                "module modulo integer dimensions — a bucket ladder paying "
+                f"~{respent:.3f}s of lower+compile beyond the first "
+                f"variant ({exact_dups} exact-duplicate recompiles already "
+                "on the ledger's dup-waste counter). Shape-polymorphism / "
+                "coarser-bucket candidate",
+                snippet=f"{len(progs)} variants {where}")

@@ -397,6 +397,24 @@ register("MXNET_COMPILE_LEDGER_DIR", "", str,
 register("MXNET_COMPILE_LEDGER_KEEP", 64, int,
          "Compile ledger: CompileRecords served by recent() — the window "
          "the /compilez page and every flight bundle snapshot.")
+register("MXNET_COMPILE_LEDGER_TEXT_MAX_BYTES", 32 << 20, int,
+         "Compile ledger: byte budget for retained canonicalized module "
+         "texts (module-<fingerprint>.mlir beside the ledger records — the "
+         "offline corpus mxlint --ir and autotune feature extraction "
+         "read). Content-addressed dedup means each distinct program is "
+         "stored once; when the directory's retained texts would exceed "
+         "the budget, new texts are skipped (counted in "
+         "mxtpu_compile_text_retained_total{outcome=over_budget}). "
+         "Negative disables the bound.")
+register("MXNET_IR_GUARD", "", str,
+         "Live IR guard over every lower_and_compile: '' (off — the "
+         "zero-cost donation assertion still counts detections in "
+         "mxtpu_ir_guard_total), 'warn' (check guarded rules IR1000/"
+         "IR1001, emit RuntimeWarning + ir_guard flight event), 'raise' "
+         "(same, then raise IRGuardError so a dropped donation or "
+         "baked-in weights cannot ship). Guard infrastructure errors are "
+         "always fail-open; only a real finding under 'raise' fails the "
+         "compile. Rule catalog: STATIC_ANALYSIS.md.")
 register("MXNET_COMPILE_LEDGER_EAGER", "auto", str,
          "Compile ledger: instrument the eager jit cache ('1'/'0'; 'auto' "
          "follows MXNET_COMPILE_LEDGER_DIR). Instrumentation AOT-compiles "

@@ -311,7 +311,8 @@ class ModelEndpoint:
                 # the lock would just duplicate device compilations)
                 comp = _ledger.lower_and_compile(  # mxlint: disable=CONC202
                     self._infer_fn(), (param_sds,) + in_sds,
-                    site="serving_bucket", key=self._compile_key(bucket))
+                    site="serving_bucket", key=self._compile_key(bucket),
+                    expect_donation=self._donate_inputs())
             self._adopt_compiled(comp)
             self._execs[bucket] = comp
             # attribute the executable's own device footprint (output +

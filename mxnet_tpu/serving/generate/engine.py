@@ -293,7 +293,8 @@ class DecodeEndpoint:
                 comp = _ledger.lower_and_compile(  # mxlint: disable=CONC202
                     jfn, (param_sds,) + arg_sds,
                     site=f"decode_{kind}",
-                    key=self._cost_key(kind, bucket))
+                    key=self._cost_key(kind, bucket),
+                    expect_donation=self._donate_pools())
             self._adopt_compiled(comp)
             cache[bucket] = comp
             mem = _ledger._memory_analysis(comp)

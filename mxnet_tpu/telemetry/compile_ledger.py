@@ -27,7 +27,25 @@ persistent executable cache would bank.
 
 Fingerprints are canonicalized (MLIR location metadata stripped) so the same
 function lowered at the same avals in two different processes hashes
-identically — the property the cross-subprocess stability test pins.
+identically — the property the cross-subprocess stability test pins. The
+canonicalizer itself lives in :mod:`mxnet_tpu.analysis.ir.parser` now
+(shared with hlolint, hardened for nested ``loc(...)`` and string attrs);
+this module delegates.
+
+Two growths ride the same seam (hlolint, see STATIC_ANALYSIS.md):
+
+  - when a ledger directory is set, the canonicalized module *text* is
+    retained beside the records as ``module-<fingerprint>.mlir`` (deduped
+    by content address, byte-bounded by
+    MXNET_COMPILE_LEDGER_TEXT_MAX_BYTES, atomic tmp+rename writes) so
+    ``mxlint --ir`` and autotune feature extraction run offline against
+    the very programs the fleet compiled;
+  - an opt-in live guard (MXNET_IR_GUARD=warn|raise) checks each compile
+    against the guarded IR rules — donation silently dropped by XLA
+    (IR1000), weights baked in as constants (IR1001) — emitting
+    ``mxtpu_ir_guard_total`` and an ``ir_guard`` flight event. Fail-open:
+    guard *infrastructure* errors never fail the compile; only an actual
+    finding under ``raise`` does.
 """
 from __future__ import annotations
 
@@ -37,14 +55,17 @@ import os
 import re
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from .metrics import REGISTRY
+from ..analysis.ir.guard import IRGuardError, live_findings as _ir_findings
+from ..analysis.ir import parser as _irparser
 
-__all__ = ["CompileRecord", "fingerprint_text", "op_histogram",
-           "lower_and_compile", "record", "recent", "summary",
-           "instrument_eager_jit", "eager_active", "ledger_dir",
+__all__ = ["CompileRecord", "IRGuardError", "fingerprint_text",
+           "op_histogram", "lower_and_compile", "record", "recent",
+           "summary", "instrument_eager_jit", "eager_active", "ledger_dir",
            "read_ledger", "reset"]
 
 _RECORDS = REGISTRY.counter(
@@ -66,6 +87,18 @@ _DUP_WASTE = REGISTRY.counter(
     "mxtpu_compile_duplicate_waste_seconds_total",
     "Wall seconds re-spent compiling already-seen programs (the win a "
     "persistent executable cache keyed by StableHLO hash would bank).")
+_IR_GUARD = REGISTRY.counter(
+    "mxtpu_ir_guard_total",
+    "Live IR-guard verdicts per compile, by rule (IR1000 donation-dropped, "
+    "IR1001 baked-in-weights) and outcome (detected = guard off but the "
+    "violation was seen / warn / raise).",
+    labelnames=("rule", "outcome"))
+_TEXT_RETAINED = REGISTRY.counter(
+    "mxtpu_compile_text_retained_total",
+    "Canonicalized StableHLO texts retained beside the ledger, by outcome "
+    "(written / dedup = content address already on disk / over_budget = "
+    "MXNET_COMPILE_LEDGER_TEXT_MAX_BYTES reached / error).",
+    labelnames=("outcome",))
 
 # ring larger than any MXNET_COMPILE_LEDGER_KEEP a page would ask for
 _RING_CAP = 512
@@ -75,7 +108,6 @@ _RING: deque = deque(maxlen=_RING_CAP)
 _SEEN: Dict[str, float] = {}        # fingerprint -> first-seen compile secs
 _SCANNED: Dict[str, int] = {}       # ledger file path -> bytes consumed
 _SCANNED_DIR: Optional[str] = None  # ledger dir the offsets belong to
-_LOC_RE = re.compile(r"\s*loc\([^)]*\)")
 _OP_RE = re.compile(r"\b(?:stablehlo|mhlo|chlo)\.([a-z0-9_]+)\b")
 _LAST_ERRORS: Dict[str, str] = {}   # where -> last swallowed error
 
@@ -123,10 +155,12 @@ def fingerprint_text(text: str) -> str:
     """sha256 of canonicalized StableHLO text. MLIR location metadata
     (``loc(...)`` / ``#loc`` lines) is stripped so the hash depends on the
     program alone, not on where in the host source it was traced from —
-    two processes lowering the same function at the same avals agree."""
-    lines = [ln for ln in text.splitlines() if not ln.lstrip().startswith("#loc")]
-    canon = "\n".join(_LOC_RE.sub("", ln) for ln in lines)
-    return hashlib.sha256(canon.encode("utf-8")).hexdigest()
+    two processes lowering the same function at the same avals agree.
+    Delegates to the shared hardened canonicalizer (balanced parens,
+    string-attr aware — see :mod:`mxnet_tpu.analysis.ir.parser`); for
+    location-free text the result is byte-identical to the original
+    regex pass, so existing content addresses stay valid."""
+    return _irparser.fingerprint(text)
 
 
 def op_histogram(text: str, cap: int = 64) -> Dict[str, int]:
@@ -242,10 +276,116 @@ def _append_jsonl(d: str, rec: Dict):
         pass          # a broken disk must not take down the compile it logs
 
 
+def _retain_text(d: str, fp: str, text: str):
+    """Retain the canonicalized module text as ``module-<fp>.mlir`` beside
+    the ledger records. Content-addressed, so dedup is a stat; the file
+    re-hashes to its own name (``fingerprint_text(contents) == fp``), which
+    is the integrity invariant hlolint's IR000 audits. Byte-bounded by
+    MXNET_COMPILE_LEDGER_TEXT_MAX_BYTES over the directory's retained
+    texts, and written tmp+rename (no O_APPEND: unlike the record stream
+    this is a whole file, and a torn module text would fail its own
+    content address)."""
+    canon = _irparser.canonicalize(text)
+    path = os.path.join(d, f"module-{fp}.mlir")
+    if os.path.exists(path):
+        _TEXT_RETAINED.labels("dedup").inc()
+        return
+    data = canon.encode("utf-8")
+    budget = int(_cfg("MXNET_COMPILE_LEDGER_TEXT_MAX_BYTES", 32 << 20))
+    if budget >= 0:
+        used = 0
+        for n in os.listdir(d):
+            if n.startswith("module-") and n.endswith(".mlir"):
+                try:
+                    used += os.path.getsize(os.path.join(d, n))
+                except OSError:
+                    continue
+        if used + len(data) > budget:
+            _TEXT_RETAINED.labels("over_budget").inc()
+            return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _TEXT_RETAINED.labels("written").inc()
+
+
+def _donation_summary(compiled, text: Optional[str],
+                      expect_donation: bool) -> Optional[Dict[str, int]]:
+    """``{"requested": n, "aliased": m}`` for a just-compiled executable,
+    or None when nothing was donated. ``requested`` comes from the
+    executable's own ``donate_argnums`` (present whether or not XLA kept
+    the aliases), with the caller's ``expect_donation`` declaration as the
+    floor — a site that *intends* donation but compiled a function with no
+    donate_argnums is exactly the regression the guard exists to catch.
+    ``aliased`` counts entry arguments whose alias survived into the text
+    (``tf.aliasing_output`` / ``jax.buffer_donor``); omitted when the text
+    was unavailable so IR1000 never fires on missing evidence."""
+    requested = 0
+    try:
+        donated = getattr(compiled, "donate_argnums", None) or ()
+        requested = len(tuple(donated))
+    except Exception as e:
+        _note("donation", e)
+    if expect_donation and requested == 0:
+        requested = 1
+    if requested <= 0:
+        return None
+    out = {"requested": requested}
+    if text is not None:
+        out["aliased"] = _irparser.count_aliased_args(text)
+    return out
+
+
+def _guard_mode() -> str:
+    mode = str(_cfg("MXNET_IR_GUARD", "") or "").strip().lower()
+    return mode if mode in ("warn", "raise") else ""
+
+
+def _run_ir_guard(site: str, key: Optional[Dict], text: Optional[str],
+                  donation: Optional[Dict[str, int]]) -> List:
+    """Evaluate the guarded IR rules against one fresh compile and emit
+    metrics / flight event / warning. Returns the findings so the caller
+    can raise *outside* this function — everything in here is fail-open
+    (guard breakage must never fail a compile), but a real finding under
+    MXNET_IR_GUARD=raise must."""
+    mode = _guard_mode()
+    # the donation assertion is metrics-free of cost (the summary already
+    # exists for the record) so it runs even with the guard off — a
+    # dropped donation always shows up in mxtpu_ir_guard_total
+    donation_bad = bool(donation and donation.get("requested", 0) > 0
+                        and donation.get("aliased", -1) == 0)
+    if not mode and not donation_bad:
+        return []
+    findings = _ir_findings(text, site=site, donation=donation,
+                            check_constants=bool(mode))
+    if not findings:
+        return []
+    outcome = mode or "detected"
+    for rule, message in findings:
+        try:
+            _IR_GUARD.labels(rule, outcome).inc()
+        except Exception as e:
+            _note("ir_guard_metric", e)
+        warnings.warn(f"[{rule}] compile at site={site}: {message}",
+                      RuntimeWarning, stacklevel=3)
+    try:
+        from . import flight as _flight
+        _flight.trigger("ir_guard", site=site, outcome=outcome,
+                        rules=",".join(sorted({r for r, _ in findings})),
+                        key={str(k): v for k, v in (key or {}).items()})
+    except Exception as e:
+        _note("ir_guard_flight", e)
+    return findings if mode == "raise" else []
+
+
 def record(site: str, fingerprint: Optional[str], lower_s: float,
            compile_s: float, key: Optional[Dict[str, Any]] = None,
            compiled=None, cache_hit: bool = False,
-           ops: Optional[Dict[str, int]] = None) -> CompileRecord:
+           ops: Optional[Dict[str, int]] = None,
+           donation: Optional[Dict[str, int]] = None) -> CompileRecord:
     """Emit one CompileRecord (ring + metrics + JSONL). Never raises.
 
     ``cache_hit=True`` marks an executable answered by the persistent cache
@@ -253,7 +393,11 @@ def record(site: str, fingerprint: Optional[str], lower_s: float,
     duplicates and never charge ``mxtpu_compile_duplicate_waste_seconds_total``
     — nothing was re-spent, the fleet's copy was reused. ``ops`` is the
     optional :func:`op_histogram` of the lowered module — the cost model's
-    program features."""
+    program features. ``donation`` is the optional
+    ``{"requested": n, "aliased": m}`` summary: how many arguments the
+    caller asked to donate vs how many aliases actually survived lowering —
+    the durable evidence hlolint's IR1000 reads (the lowered text itself
+    carries *no trace* of a dropped donation)."""
     rec = CompileRecord(
         ts=time.time(), pid=os.getpid(), site=str(site),
         fingerprint=fingerprint,
@@ -263,6 +407,8 @@ def record(site: str, fingerprint: Optional[str], lower_s: float,
     )
     if ops:
         rec["ops"] = {str(k): int(v) for k, v in ops.items()}
+    if donation:
+        rec["donation"] = {str(k): int(v) for k, v in donation.items()}
     if compiled is not None:
         rec.update(_cost_analysis(compiled))
         rec.update(_memory_analysis(compiled))
@@ -295,18 +441,28 @@ def record(site: str, fingerprint: Optional[str], lower_s: float,
 
 def lower_and_compile(jfn, args, *, site: str,
                       key: Optional[Dict[str, Any]] = None,
-                      kwargs: Optional[Dict] = None):
+                      kwargs: Optional[Dict] = None,
+                      expect_donation: bool = False):
     """The one-stop instrumentation for an AOT compile site: time
     ``jfn.lower(*args)``, fingerprint the lowered StableHLO, consult the
     persistent executable cache (``MXNET_EXEC_CACHE_DIR``), and only on a
     miss time ``.compile()`` and populate the cache. Emits the record
     (``cache_hit`` says which path ran) and returns the executable. Ledger
-    and cache failures never fail the compile."""
+    and cache failures never fail the compile.
+
+    ``expect_donation=True`` declares the site requested buffer donation
+    (serving endpoints pass their platform decision): the record then
+    carries the ``donation`` requested/aliased summary and the IR guard's
+    donation assertion is armed. With MXNET_IR_GUARD=raise a guarded-rule
+    violation raises :class:`IRGuardError` — the one deliberate exception
+    to fail-open, and it fires only after the record, metrics, and flight
+    event are already emitted, so the evidence outlives the refusal."""
     t0 = time.perf_counter()
     lowered = jfn.lower(*args, **(kwargs or {}))
     t1 = time.perf_counter()
     fp = None
     ops = None
+    text = None
     try:
         text = lowered.as_text()
         fp = fingerprint_text(text)
@@ -335,11 +491,31 @@ def lower_and_compile(jfn, args, *, site: str,
             _xcache.store(ckey, compiled)
         except Exception as e:
             _note("exec_cache_store", e)
+    donation = None
+    try:
+        donation = _donation_summary(compiled, text, expect_donation)
+    except Exception as e:
+        _note("donation", e)
     try:
         record(site, fp, lower_s=t1 - t0, compile_s=t3 - t2, key=key,
-               compiled=compiled, cache_hit=cache_hit, ops=ops)
+               compiled=compiled, cache_hit=cache_hit, ops=ops,
+               donation=donation)
     except Exception as e:
         _note("record", e)
+    d = ledger_dir()
+    if d and fp is not None and text is not None:
+        try:
+            os.makedirs(d, exist_ok=True)
+            _retain_text(d, fp, text)
+        except Exception as e:
+            _note("retain_text", e)
+    raising = []
+    try:
+        raising = _run_ir_guard(site, key, text, donation)
+    except Exception as e:
+        _note("ir_guard", e)
+    if raising:
+        raise IRGuardError(raising, site)
     return compiled
 
 

@@ -39,6 +39,13 @@ warnings.filterwarnings("ignore", message=".*Some donated buffers were not usabl
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly with -m slow")
+
+
 @pytest.fixture
 def ctx():
     import mxnet_tpu as mx

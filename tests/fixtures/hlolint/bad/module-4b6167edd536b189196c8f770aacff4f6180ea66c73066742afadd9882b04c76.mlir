@@ -1,0 +1,6 @@
+module @jit__lambda_ attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x16xf32>, %arg1: tensor<32x16xf32>) -> (tensor<32x16xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.dot_general %arg1, %arg0, contracting_dims = [1] x [0], precision = [HIGHEST, HIGHEST] : (tensor<32x16xf32>, tensor<16x16xf32>) -> tensor<32x16xf32>
+    return %0 : tensor<32x16xf32>
+  }
+}

@@ -1,0 +1,11 @@
+module @jit_step attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4xi32>) -> (tensor<4xi32> {jax.result_info = ""}) {
+    %c = stablehlo.constant dense<1> : tensor<i32>
+    %0 = stablehlo.broadcast_in_dim %c, dims = [] : (tensor<i32>) -> tensor<4xi32>
+    %1 = stablehlo.add %arg0, %0 : tensor<4xi32>
+    %c_0 = stablehlo.constant dense<94517227968816> : tensor<i64>
+    %2 = stablehlo.custom_call @xla_python_cpu_callback(%c_0, %1) {api_version = 2 : i32, backend_config = "94517227968816", mhlo.sharding = "{maximal device=0}", operand_layouts = [dense<> : tensor<0xindex>, dense<0> : tensor<1xindex>], result_layouts = [dense<0> : tensor<1xindex>]} : (tensor<i64>, tensor<4xi32>) -> tuple<tensor<4xi32>>
+    %3 = stablehlo.get_tuple_element %2[0] : (tuple<tensor<4xi32>>) -> tensor<4xi32>
+    return %3 : tensor<4xi32>
+  }
+}

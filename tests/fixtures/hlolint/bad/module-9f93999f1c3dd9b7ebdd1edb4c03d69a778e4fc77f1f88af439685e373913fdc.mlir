@@ -1,0 +1,7 @@
+module @jit__lambda_ attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4x128xf32>) -> (tensor<4x128xf32> {jax.result_info = ""}) {
+    %cst = stablehlo.constant dense<5.000000e-01> : tensor<128x128xf32>
+    %0 = stablehlo.dot_general %arg0, %cst, contracting_dims = [1] x [0], precision = [HIGHEST, HIGHEST] : (tensor<4x128xf32>, tensor<128x128xf32>) -> tensor<4x128xf32>
+    return %0 : tensor<4x128xf32>
+  }
+}

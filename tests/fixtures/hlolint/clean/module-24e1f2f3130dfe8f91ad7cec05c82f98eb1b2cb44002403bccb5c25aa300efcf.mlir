@@ -1,0 +1,9 @@
+module @jit__lambda_ attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x16xf32>, %arg1: tensor<1x16xf32>) -> (tensor<1x16xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.dot_general %arg1, %arg0, contracting_dims = [1] x [0], precision = [HIGHEST, HIGHEST] : (tensor<1x16xf32>, tensor<16x16xf32>) -> tensor<1x16xf32>
+    %cst = stablehlo.constant dense<3.000000e+00> : tensor<f32>
+    %1 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<f32>) -> tensor<1x16xf32>
+    %2 = stablehlo.multiply %0, %1 : tensor<1x16xf32>
+    return %2 : tensor<1x16xf32>
+  }
+}

@@ -1,0 +1,8 @@
+module @jit_step attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4xi32>) -> (tensor<4xi32> {jax.result_info = ""}) {
+    %c = stablehlo.constant dense<1> : tensor<i32>
+    %0 = stablehlo.broadcast_in_dim %c, dims = [] : (tensor<i32>) -> tensor<4xi32>
+    %1 = stablehlo.add %arg0, %0 : tensor<4xi32>
+    return %1 : tensor<4xi32>
+  }
+}

@@ -1,0 +1,8 @@
+module @jit__lambda_ attributes {mhlo.num_partitions = 1 : i32, mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<8x128xf32> {tf.aliasing_output = 0 : i32}) -> (tensor<8x128xf32> {jax.result_info = ""}) {
+    %cst = stablehlo.constant dense<2.000000e+00> : tensor<f32>
+    %0 = stablehlo.broadcast_in_dim %cst, dims = [] : (tensor<f32>) -> tensor<8x128xf32>
+    %1 = stablehlo.multiply %arg0, %0 : tensor<8x128xf32>
+    return %1 : tensor<8x128xf32>
+  }
+}

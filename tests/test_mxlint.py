@@ -933,6 +933,20 @@ def test_env600_gated_off_on_partial_scans(tmp_path):
     assert fs == []          # no config.py in the scan set: rule disarmed
 
 
+def test_env600_gated_off_when_scan_flagged_partial(tmp_path):
+    """A --changed-only diff that happens to include config.py + a doc
+    must not arm the drift rules: against a subset, "token not found in
+    the scanned code" is a statement about the diff, not the code. The
+    regression: a PR touching a knob and its doc row drowned the
+    pre-commit hook in stale-doc findings for every metric the diff
+    didn't contain."""
+    _env_tree(tmp_path)      # writes the tree (full-scan result unused)
+    fs = analysis.lint_paths([str(tmp_path / "mxnet_tpu")],
+                             root=str(tmp_path), rules=["ENV600"],
+                             partial=True)
+    assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # SARIF 2.1.0 output
 # ---------------------------------------------------------------------------

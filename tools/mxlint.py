@@ -9,7 +9,10 @@ metric-label cardinality (MET301), thread lifecycle (THR400),
 classification-swallowing excepts (EXC500), code-vs-docs config drift
 (ENV600), mesh/collective axis checking (MESH700), request-path deadline
 discipline (TAIL800), non-atomic persistence writes (RES900), and
-fault/chaos/flight registry drift (DRIFT601).
+fault/chaos/flight registry drift (DRIFT601) — plus, with ``--ir``, the
+hlolint rules over compiled StableHLO corpora (IR1000 donation-dropped,
+IR1001 baked-in-weights, IR1002 dtype-upcast, IR1003 host round-trip,
+IR1004 collective-topology, IR1005 bucket-duplication).
 
     # gate: scan the default set, fail on anything not in the baseline
     python tools/mxlint.py --check
@@ -31,6 +34,14 @@ fault/chaos/flight registry drift (DRIFT601).
 
     # one rule only, ignore the baseline
     python tools/mxlint.py --rules CONC200 --no-baseline mxnet_tpu/serving
+
+    # IR mode: scan compile-ledger corpora (ledger-*.jsonl records +
+    # retained module-<fingerprint>.mlir texts) with the IR rules; paths
+    # are corpus DIRECTORIES, the baseline defaults to
+    # tools/mxlint_ir_baseline.json (committed empty — IR findings are
+    # fixed, not baselined)
+    python tools/mxlint.py --ir /tmp/ledger
+    python tools/mxlint.py --ir --check
 
 Full scans keep an incremental cache (.mxlint_cache.json, mtime+content
 keyed): unchanged files with unchanged dependency summaries replay their
@@ -71,6 +82,7 @@ if "mxnet_tpu" not in sys.modules:
 from mxnet_tpu import analysis  # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "mxlint_baseline.json")
+DEFAULT_IR_BASELINE = os.path.join(REPO, "tools", "mxlint_ir_baseline.json")
 DEFAULT_CACHE = os.path.join(REPO, ".mxlint_cache.json")
 
 
@@ -161,9 +173,15 @@ def main(argv=None):
                     metavar="REF",
                     help="scan only files changed vs REF (default HEAD) "
                          "per git diff --name-only; full scan outside git")
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+    ap.add_argument("--ir", action="store_true",
+                    help="IR mode: paths are compile-ledger corpus "
+                         "directories (ledger-*.jsonl + module-*.mlir); "
+                         "runs the IR rules (default corpora: "
+                         + " ".join(analysis.DEFAULT_IR_SCAN_SET) + ")")
+    ap.add_argument("--baseline", default=None,
                     help="baseline ledger path (default tools/"
-                         "mxlint_baseline.json)")
+                         "mxlint_baseline.json; tools/mxlint_ir_baseline"
+                         ".json with --ir)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline: report every finding as new")
     ap.add_argument("--update-baseline", action="store_true",
@@ -187,9 +205,19 @@ def main(argv=None):
         return 0
 
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    if args.baseline is None:
+        args.baseline = DEFAULT_IR_BASELINE if args.ir else DEFAULT_BASELINE
+    if args.ir:
+        # corpus scans are cheap joins over small JSONL + text files: no
+        # incremental cache, no git scoping — every run is a cold scan
+        paths = _resolve_paths(args.paths
+                               or list(analysis.DEFAULT_IR_SCAN_SET))
+        findings = analysis.lint_ir_paths(paths, rules=rules, root=REPO)
+        return _report(args, findings, ir=True)
     if args.cache is None and not args.paths:
         args.cache = DEFAULT_CACHE
     paths = _resolve_paths(args.paths or list(analysis.DEFAULT_SCAN_SET))
+    partial = False
     if args.changed_only is not None:
         subset = changed_files(args.changed_only, paths)
         if subset is None:
@@ -197,14 +225,20 @@ def main(argv=None):
                   "running the full scan", file=sys.stderr)
         else:
             paths = subset
+            partial = True
             if not paths:
                 print("mxlint: no scanned files changed vs "
                       f"{args.changed_only}")
                 return 0
     cache_path = None if args.no_cache else args.cache
     findings = analysis.lint_paths(paths, rules=rules, root=REPO,
-                                   cache_path=cache_path)
+                                   cache_path=cache_path, partial=partial)
+    return _report(args, findings)
 
+
+def _report(args, findings, ir=False):
+    """Shared back half of both modes: SARIF, baseline apply/update, the
+    text/JSON report, and the gate exit code."""
     if args.sarif:
         doc = analysis.to_sarif(findings, analysis.all_checkers(),
                                 analysis.VERSION)
@@ -225,8 +259,6 @@ def main(argv=None):
         args.baseline)
     new, matched, stale = analysis.apply_baseline(findings, baseline)
 
-    stats = analysis.LAST_SCAN_STATS
-    nfiles = len(stats["checked"]) + len(stats["cache_hits"])
     if args.sarif == "-":
         pass                      # SARIF owns stdout; exit code still gates
     elif args.json:
@@ -241,12 +273,19 @@ def main(argv=None):
                   "still in the ledger — run --update-baseline):")
             for b in stale:
                 print(f"    {b.path}: {b.rule} {b.message[:70]}")
-        cached = len(stats["cache_hits"])
-        cache_note = f", {cached} from cache" if cached else ""
-        print(f"mxlint: {len(findings)} finding(s) "
-              f"({len(matched)} baselined, {len(new)} new, "
-              f"{len(stale)} stale) across {nfiles} file(s)"
-              f"{cache_note}")
+        if ir:
+            print(f"mxlint --ir: {len(findings)} finding(s) "
+                  f"({len(matched)} baselined, {len(new)} new, "
+                  f"{len(stale)} stale)")
+        else:
+            stats = analysis.LAST_SCAN_STATS
+            nfiles = len(stats["checked"]) + len(stats["cache_hits"])
+            cached = len(stats["cache_hits"])
+            cache_note = f", {cached} from cache" if cached else ""
+            print(f"mxlint: {len(findings)} finding(s) "
+                  f"({len(matched)} baselined, {len(new)} new, "
+                  f"{len(stale)} stale) across {nfiles} file(s)"
+                  f"{cache_note}")
 
     if new:
         return 1

@@ -9,6 +9,12 @@
 # Exit status is mxlint's: 0 when the changed files introduce nothing new
 # vs the committed baseline, 1 otherwise. Outside a git checkout the scan
 # silently widens to the full default set (mxlint's own fallback).
+#
+# Two passes: the Python scan over changed files, then the IR scan over
+# the committed fixture corpora (cheap — small JSONL + text joins) with
+# its always-empty baseline, so an edited fixture or IR rule fails the
+# same gate CI runs.
 set -eu
-exec python "$(dirname "$0")/mxlint.py" --changed-only "${1:-HEAD}" \
+python "$(dirname "$0")/mxlint.py" --changed-only "${1:-HEAD}" \
     --sarif -
+exec python "$(dirname "$0")/mxlint.py" --ir --check
